@@ -1,0 +1,28 @@
+"""summerset_tpu — a TPU-native multi-group state machine replication framework.
+
+A brand-new framework with the capabilities of josehu07/summerset (a
+protocol-generic replicated KV store supporting many SMR/consensus protocols),
+re-designed TPU-first: per-slot consensus state machines are lifted into
+struct-of-arrays JAX state batched over ``[num_groups, population, log_window]``
+and stepped in lockstep by a jitted kernel under ``vmap`` / ``shard_map`` on the
+ICI mesh.  Reed-Solomon GF(2^8) coding runs as a Pallas kernel.  The durable
+logger, KV state machine, client I/O and manager oracle run host-side behind
+channel-style interfaces (asyncio + a C++ WAL core).
+
+Layer map (mirrors reference src/ layout; see SURVEY.md §1):
+
+- ``utils``      — leaf helpers (bitmap, config, timers, keyrange, linreg, ...)
+- ``ops``        — device kernels (GF(2^8) RS coding, per-group PRNG)
+- ``core``       — the batched lockstep engine: network model, protocol SPI,
+                   mesh sharding
+- ``protocols``  — vectorized protocol kernels (MultiPaxos, Raft, EPaxos,
+                   RSPaxos, CRaft, Crossword, QuorumLeases, Bodega, ChainRep,
+                   SimplePush, RepNothing)
+- ``server``     — host runtime (state machine, WAL storage, external API,
+                   control, heartbeater, lease manager, replica process)
+- ``manager``    — cluster manager oracle (reigner / reactor)
+- ``client``     — client library (endpoint, stubs, drivers, bench / tester /
+                   repl / mess utilities)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,31 @@
+"""Leaf utility layer — no dependencies on other summerset_tpu modules.
+
+Mirrors the reference's ``src/utils/`` public surface (SURVEY.md §2.1):
+Bitmap, SummersetError, Timer, RespondersConf/KeyRangeMap, Stopwatch,
+LinearRegressor/PerfModel, QdiscInfo, safe TCP framing, config parsing and
+the ``pf_*`` logging helpers.
+"""
+
+from .errors import SummersetError, logged_err
+from .bitmap import Bitmap
+from .config import config_field, parsed_config
+from .keyrange import KeyRangeMap, RespondersConf
+from .linreg import LinearRegressor, PerfModel
+from .stopwatch import Stopwatch
+from .timer import Timer
+from .qdisc import QdiscInfo
+
+__all__ = [
+    "SummersetError",
+    "logged_err",
+    "Bitmap",
+    "config_field",
+    "parsed_config",
+    "KeyRangeMap",
+    "RespondersConf",
+    "LinearRegressor",
+    "PerfModel",
+    "Stopwatch",
+    "Timer",
+    "QdiscInfo",
+]

@@ -1,0 +1,138 @@
+"""Fixed-size bitmap keyed by replica ID, host class + device helpers.
+
+Parity: reference ``src/utils/bitmap.rs:63-146`` (``Bitmap::new/set/get/
+count/flip/union/iter``) — used for quorum ack tallies, peer-alive sets and
+erasure-shard maps.
+
+TPU-side, bitmaps over populations ≤ 32 are packed into ``uint32`` lanes so a
+``[G, R, W]`` array of ack-sets is a single int array; quorum tally is
+``lax.population_count``.  The device helpers here are thin, jit-friendly
+functions over such packed lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+
+from .errors import SummersetError
+
+MAX_POPULATION = 32  # packed into one uint32 lane on device
+
+
+class Bitmap:
+    """Host-side fixed-size bitset keyed by small integer replica IDs."""
+
+    def __init__(self, size: int, ones: bool = False):
+        if size <= 0:
+            raise SummersetError(f"invalid bitmap size {size}")
+        self._size = size
+        self._bits: int = (1 << size) - 1 if ones else 0
+
+    @classmethod
+    def from_ids(cls, size: int, ids) -> "Bitmap":
+        bm = cls(size)
+        for i in ids:
+            bm.set(i)
+        return bm
+
+    @classmethod
+    def from_u32(cls, size: int, packed: int) -> "Bitmap":
+        bm = cls(size)
+        bm._bits = packed & ((1 << size) - 1)
+        return bm
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def _check(self, idx: int) -> None:
+        if not 0 <= idx < self._size:
+            raise SummersetError(f"index {idx} out of bound {self._size}")
+
+    def set(self, idx: int) -> None:
+        self._check(idx)
+        self._bits |= 1 << idx
+
+    def clear(self, idx: int) -> None:
+        self._check(idx)
+        self._bits &= ~(1 << idx)
+
+    def get(self, idx: int) -> bool:
+        self._check(idx)
+        return bool(self._bits >> idx & 1)
+
+    def count(self) -> int:
+        return self._bits.bit_count()
+
+    def flip(self) -> None:
+        self._bits = ~self._bits & ((1 << self._size) - 1)
+
+    def union(self, other: "Bitmap") -> None:
+        if other._size != self._size:
+            raise SummersetError("bitmap size mismatch")
+        self._bits |= other._bits
+
+    def clear_all(self) -> None:
+        self._bits = 0
+
+    def set_all(self) -> None:
+        self._bits = (1 << self._size) - 1
+
+    def iter_ones(self) -> Iterator[int]:
+        for i in range(self._size):
+            if self._bits >> i & 1:
+                yield i
+
+    def to_list(self) -> List[bool]:
+        return [bool(self._bits >> i & 1) for i in range(self._size)]
+
+    def to_u32(self) -> int:
+        return self._bits
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Bitmap)
+            and other._size == self._size
+            and other._bits == self._bits
+        )
+
+    def __hash__(self) -> int:
+        return hash((self._size, self._bits))
+
+    def __repr__(self) -> str:
+        return f"Bitmap({self._size}; {{{','.join(map(str, self.iter_ones()))}}})"
+
+
+# ---------------------------------------------------------------------------
+# Device-side packed-bitmap helpers (uint32 lanes, population <= 32)
+# ---------------------------------------------------------------------------
+
+
+def bits_full(population) -> jnp.ndarray:
+    """All-ones mask for a population (jit-safe for static population)."""
+    return jnp.uint32((1 << population) - 1)
+
+
+def bit_of(idx) -> jnp.ndarray:
+    """``1 << idx`` as uint32; idx may be a traced int array."""
+    return jnp.left_shift(jnp.uint32(1), idx.astype(jnp.uint32) if hasattr(idx, "astype") else jnp.uint32(idx))
+
+
+def bit_set(lane, idx):
+    return jnp.bitwise_or(lane, bit_of(idx))
+
+
+def bit_clear(lane, idx):
+    return jnp.bitwise_and(lane, jnp.bitwise_not(bit_of(idx)))
+
+
+def bit_get(lane, idx):
+    return jnp.bitwise_and(jnp.right_shift(lane, idx), 1).astype(jnp.bool_)
+
+
+def popcount(lane):
+    """Set-bit count per lane element — the vectorized quorum tally."""
+    return jax.lax.population_count(lane.astype(jnp.uint32)).astype(jnp.int32)

@@ -1,0 +1,97 @@
+"""Length-prefixed message framing over asyncio TCP, with retrying bind/connect.
+
+Parity: reference ``src/utils/safetcp.rs`` — 8-byte big-endian length prefix +
+serialized body (``safe_tcp_read:31`` / ``safe_tcp_write:105``), plus
+``tcp_bind_with_retry`` / ``tcp_connect_with_retry``.  The reference's
+cancellation-safe partial-read buffers map to asyncio's ``readexactly``;
+its non-blocking would-block write contract maps to ``drain()``.
+
+Serialization: the reference uses bincode over serde structs.  Here messages
+are plain Python objects (dataclasses / tuples / dicts) encoded with pickle —
+acceptable for a trusted research cluster, and symmetric across all three
+planes (client/server data, server p2p, control).  The frame format (8-byte BE
+length + body) is preserved so wire-level tooling carries over.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import pickle
+import struct
+from typing import Any, Tuple
+
+from .errors import SummersetError
+
+_LEN = struct.Struct(">Q")
+
+# Refuse absurd frames (reference caps values at 16MB; give headroom).
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def encode_frame(obj: Any) -> bytes:
+    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return _LEN.pack(len(body)) + body
+
+
+async def send_msg(writer: asyncio.StreamWriter, obj: Any) -> None:
+    writer.write(encode_frame(obj))
+    await writer.drain()
+
+
+async def recv_msg(reader: asyncio.StreamReader) -> Any:
+    hdr = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(hdr)
+    if length > MAX_FRAME:
+        raise SummersetError(f"frame length {length} exceeds cap {MAX_FRAME}")
+    body = await reader.readexactly(length)
+    return pickle.loads(body)
+
+
+def send_msg_sync(sock, obj: Any) -> None:
+    """Blocking-socket variant (used by simple CLI tools)."""
+    sock.sendall(encode_frame(obj))
+
+
+def recv_msg_sync(sock) -> Any:
+    def read_exact(n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise SummersetError("connection closed mid-frame")
+            buf += chunk
+        return buf
+
+    (length,) = _LEN.unpack(read_exact(_LEN.size))
+    if length > MAX_FRAME:
+        raise SummersetError(f"frame length {length} exceeds cap {MAX_FRAME}")
+    return pickle.loads(read_exact(length))
+
+
+async def tcp_bind_with_retry(
+    host: str, port: int, handler, retries: int = 10, delay: float = 0.2
+) -> asyncio.base_events.Server:
+    """Bind a TCP server, retrying on transient EADDRINUSE."""
+    for attempt in range(retries + 1):
+        try:
+            return await asyncio.start_server(handler, host, port)
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE or attempt == retries:
+                raise
+            await asyncio.sleep(delay)
+    raise SummersetError("unreachable")
+
+
+async def tcp_connect_with_retry(
+    host: str, port: int, retries: int = 30, delay: float = 0.2
+) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+    """Connect to a TCP server, retrying while it comes up."""
+    for attempt in range(retries + 1):
+        try:
+            return await asyncio.open_connection(host, port)
+        except OSError:
+            if attempt == retries:
+                raise
+            await asyncio.sleep(delay)
+    raise SummersetError("unreachable")

@@ -1,0 +1,54 @@
+"""Multi-record step-timestamp stopwatch for perf breakdowns.
+
+Parity: reference ``src/utils/stopwatch.rs`` (``record_now:35``,
+``summarize:91``) — per-slot step timestamping used by leaders to print
+durable-log / accept-reply / quorum / exec stage breakdowns.  The device
+analog records tick counters per stage; this host class aggregates either.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Stopwatch:
+    def __init__(self):
+        # record id -> list of (step index, timestamp)
+        self._records: Dict[int, List[Tuple[int, float]]] = {}
+
+    def record_now(self, rec_id: int, step: int, ts: Optional[float] = None) -> None:
+        self._records.setdefault(rec_id, []).append(
+            (step, time.monotonic() if ts is None else ts)
+        )
+
+    def remove(self, rec_id: int) -> None:
+        self._records.pop(rec_id, None)
+
+    def remove_all(self) -> None:
+        self._records.clear()
+
+    def has_record(self, rec_id: int) -> bool:
+        return rec_id in self._records
+
+    def summarize(self, num_steps: int) -> List[Tuple[float, float]]:
+        """Mean/stdev of the interval before each step 1..num_steps.
+
+        Returns a list of (mean_us, stdev_us) of step[i] - step[i-1] across
+        all records that contain both steps, in microseconds.
+        """
+        out: List[Tuple[float, float]] = []
+        for step in range(1, num_steps + 1):
+            deltas: List[float] = []
+            for rec in self._records.values():
+                by_step = dict(rec)
+                if step in by_step and step - 1 in by_step:
+                    deltas.append((by_step[step] - by_step[step - 1]) * 1e6)
+            if deltas:
+                mean = sum(deltas) / len(deltas)
+                var = sum((d - mean) ** 2 for d in deltas) / len(deltas)
+                out.append((mean, math.sqrt(var)))
+            else:
+                out.append((0.0, 0.0))
+        return out

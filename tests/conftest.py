@@ -1,0 +1,17 @@
+"""Test config: force an 8-device virtual CPU platform before jax imports.
+
+Multi-chip sharding (mesh over group/replica axes) is exercised on a virtual
+8-device CPU mesh, per the driver contract; real-TPU runs happen in bench.py.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,306 @@
+"""Unit tests for the utils layer (parity with reference inline mod tests)."""
+
+import asyncio
+import dataclasses
+import math
+
+import pytest
+
+from summerset_tpu.utils import (
+    Bitmap,
+    KeyRangeMap,
+    LinearRegressor,
+    PerfModel,
+    QdiscInfo,
+    RespondersConf,
+    Stopwatch,
+    SummersetError,
+    Timer,
+    parsed_config,
+)
+from summerset_tpu.utils.config import config_to_str
+
+
+# ---------------------------------------------------------------- bitmap ----
+class TestBitmap:
+    def test_set_get_count(self):
+        bm = Bitmap(5)
+        assert bm.count() == 0
+        bm.set(0)
+        bm.set(3)
+        assert bm.get(0) and bm.get(3) and not bm.get(1)
+        assert bm.count() == 2
+
+    def test_ones_flip_union(self):
+        bm = Bitmap(4, ones=True)
+        assert bm.count() == 4
+        bm.flip()
+        assert bm.count() == 0
+        other = Bitmap.from_ids(4, [1, 2])
+        bm.union(other)
+        assert sorted(bm.iter_ones()) == [1, 2]
+
+    def test_bounds(self):
+        bm = Bitmap(3)
+        with pytest.raises(SummersetError):
+            bm.set(3)
+        with pytest.raises(SummersetError):
+            Bitmap(0)
+
+    def test_u32_roundtrip(self):
+        bm = Bitmap.from_ids(7, [0, 2, 6])
+        assert Bitmap.from_u32(7, bm.to_u32()) == bm
+
+    def test_device_helpers(self):
+        import jax.numpy as jnp
+
+        from summerset_tpu.utils.bitmap import bit_get, bit_set, popcount
+
+        lane = jnp.zeros((4,), jnp.uint32)
+        lane = bit_set(lane, jnp.array([0, 1, 2, 3]))
+        assert popcount(lane).tolist() == [1, 1, 1, 1]
+        lane = bit_set(lane, jnp.array([3, 3, 3, 3]))
+        assert popcount(lane).tolist() == [2, 2, 2, 1]
+        assert bit_get(lane, 3).tolist() == [True, True, True, True]
+        assert bit_get(lane, 0).tolist() == [True, False, False, False]
+
+
+# ---------------------------------------------------------------- config ----
+@dataclasses.dataclass
+class _Cfg:
+    batch_interval_ms: float = 1.0
+    max_batch_size: int = 5000
+    logger_sync: bool = False
+    backer_path: str = "/tmp/x.wal"
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = parsed_config(_Cfg, None)
+        assert cfg.max_batch_size == 5000
+
+    def test_overrides_plus_sep(self):
+        cfg = parsed_config(_Cfg, "max_batch_size=10+logger_sync=true+backer_path='/a'")
+        assert cfg.max_batch_size == 10
+        assert cfg.logger_sync is True
+        assert cfg.backer_path == "/a"
+        assert cfg.batch_interval_ms == 1.0
+
+    def test_int_to_float_coercion(self):
+        cfg = parsed_config(_Cfg, "batch_interval_ms=2")
+        assert cfg.batch_interval_ms == 2.0
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(SummersetError):
+            parsed_config(_Cfg, "nope=1")
+
+    def test_type_mismatch_rejected(self):
+        with pytest.raises(SummersetError):
+            parsed_config(_Cfg, "max_batch_size='abc'")
+        with pytest.raises(SummersetError):
+            parsed_config(_Cfg, "logger_sync=3")
+
+    def test_roundtrip(self):
+        cfg = parsed_config(_Cfg, "max_batch_size=7")
+        cfg2 = parsed_config(_Cfg, config_to_str(cfg))
+        assert cfg2 == cfg
+
+    def test_plus_inside_quoted_value(self):
+        cfg = parsed_config(_Cfg, "backer_path='/tmp/run+1/x.wal'+max_batch_size=9")
+        assert cfg.backer_path == "/tmp/run+1/x.wal"
+        assert cfg.max_batch_size == 9
+        # and the roundtrip survives it
+        assert parsed_config(_Cfg, config_to_str(cfg)) == cfg
+
+
+# -------------------------------------------------------------- keyrange ----
+class TestKeyRange:
+    def test_full_and_point_lookup(self):
+        m = KeyRangeMap()
+        m.full_range("all")
+        assert m.get("anything") == "all"
+        m.insert("b", "d", "mid")
+        assert m.get("a") == "all"
+        assert m.get("b") == "mid"
+        assert m.get("c") == "mid"
+        assert m.get("d") == "all"
+
+    def test_overwrite_splits(self):
+        m = KeyRangeMap()
+        m.insert("a", "z", 1)
+        m.insert("f", "h", 2)
+        assert m.get("e") == 1
+        assert m.get("f") == 2
+        assert m.get("g") == 2
+        assert m.get("h") == 1
+        assert m.get("z") is None
+
+    def test_unbounded_end(self):
+        m = KeyRangeMap()
+        m.insert("m", None, "hi")
+        assert m.get("zzz") == "hi"
+        assert m.get("a") is None
+        m.insert("p", "q", "mid")
+        assert m.get("o") == "hi"
+        assert m.get("p") == "mid"
+        assert m.get("q") == "hi"
+
+    def test_responders_conf(self):
+        rc = RespondersConf(5)
+        rc.set_leader(1)
+        assert rc.is_leader(1) and not rc.is_leader(0)
+        rc.set_responders(("a", "m"), Bitmap.from_ids(5, [1, 2]))
+        assert rc.is_responder_by_key("b", 2)
+        assert not rc.is_responder_by_key("z", 2)
+        with pytest.raises(SummersetError):
+            rc.set_responders(None, Bitmap.from_ids(4, [0]))
+
+
+# ----------------------------------------------------------------- timer ----
+class TestTimer:
+    def test_kickoff_explode(self):
+        async def run():
+            t = Timer()
+            t.kickoff(0.05)
+            assert not t.exploded
+            await asyncio.sleep(0.1)
+            assert t.exploded
+
+        asyncio.run(run())
+
+    def test_cancel_prevents(self):
+        async def run():
+            t = Timer()
+            t.kickoff(0.05)
+            t.cancel()
+            await asyncio.sleep(0.1)
+            assert not t.exploded
+
+        asyncio.run(run())
+
+    def test_restart_resets(self):
+        async def run():
+            t = Timer()
+            t.kickoff(0.08)
+            await asyncio.sleep(0.05)
+            t.kickoff(0.08)
+            await asyncio.sleep(0.05)
+            assert not t.exploded
+            await asyncio.sleep(0.06)
+            assert t.exploded
+
+        asyncio.run(run())
+
+    def test_extend_adds_to_deadline(self):
+        async def run():
+            t = Timer()
+            t.kickoff(0.06)
+            await asyncio.sleep(0.01)
+            t.extend(0.05)  # deadline now ~0.11 from start
+            await asyncio.sleep(0.07)
+            assert not t.exploded
+            await asyncio.sleep(0.05)
+            assert t.exploded
+
+        asyncio.run(run())
+
+    def test_callback(self):
+        fired = []
+
+        async def run():
+            t = Timer(explode_callback=lambda: fired.append(1))
+            t.kickoff(0.03)
+            await asyncio.sleep(0.08)
+
+        asyncio.run(run())
+        assert fired == [1]
+
+
+# ------------------------------------------------------------- stopwatch ----
+class TestStopwatch:
+    def test_summarize(self):
+        sw = Stopwatch()
+        for rec in range(3):
+            sw.record_now(rec, 0, ts=0.0)
+            sw.record_now(rec, 1, ts=0.001 * (rec + 1))
+            sw.record_now(rec, 2, ts=0.001 * (rec + 1) + 0.002)
+        stats = sw.summarize(2)
+        assert math.isclose(stats[0][0], 2000.0, rel_tol=1e-6)  # mean of 1/2/3 ms
+        assert math.isclose(stats[1][0], 2000.0, rel_tol=1e-6)
+        assert stats[1][1] == pytest.approx(0.0, abs=1e-6)
+        sw.remove_all()
+        assert not sw.has_record(0)
+
+
+# ---------------------------------------------------------------- linreg ----
+class TestLinReg:
+    def test_perfect_fit(self):
+        lr = LinearRegressor()
+        for x in range(10):
+            lr.append_sample(float(x), float(x), 3.0 + 2.0 * x)
+        alpha, beta = lr.calc_model()
+        assert alpha == pytest.approx(3.0)
+        assert beta == pytest.approx(2.0)
+        pm = PerfModel()
+        pm.update(alpha, beta)
+        assert pm.predict(10.0) == pytest.approx(23.0)
+
+    def test_underdetermined(self):
+        lr = LinearRegressor()
+        assert lr.calc_model() is None
+        lr.append_sample(0.0, 1.0, 1.0)
+        assert lr.calc_model() is None
+
+    def test_discard(self):
+        lr = LinearRegressor()
+        for x in range(5):
+            lr.append_sample(float(x), float(x), float(x))
+        lr.discard_before(3.0)
+        assert len(lr._samples) == 2
+
+
+# ----------------------------------------------------------------- qdisc ----
+class TestQdisc:
+    def test_parse_netem(self):
+        out = (
+            "qdisc netem 8001: root refcnt 2 limit 1000 "
+            "delay 25ms 5ms rate 10Gbit\n"
+        )
+        qi = QdiscInfo()
+        assert qi.parse_output(out)
+        assert qi.delay_ms == pytest.approx(25.0)
+        assert qi.jitter_ms == pytest.approx(5.0)
+        assert qi.rate_gbps == pytest.approx(10.0)
+
+    def test_parse_absent(self):
+        qi = QdiscInfo()
+        assert not qi.parse_output("qdisc mq 0: root\n")
+
+
+# --------------------------------------------------------------- safetcp ----
+class TestSafeTcp:
+    def test_roundtrip(self):
+        from summerset_tpu.utils.safetcp import recv_msg, send_msg
+
+        async def run():
+            got = []
+            done = asyncio.Event()
+
+            async def handler(reader, writer):
+                got.append(await recv_msg(reader))
+                await send_msg(writer, {"reply": got[0]})
+                done.set()
+
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            await send_msg(writer, ("put", "k", "v" * 1000))
+            reply = await recv_msg(reader)
+            await done.wait()
+            writer.close()
+            server.close()
+            return got, reply
+
+        got, reply = asyncio.run(run())
+        assert got == [("put", "k", "v" * 1000)]
+        assert reply == {"reply": ("put", "k", "v" * 1000)}
