@@ -1,0 +1,23 @@
+"""The batched lockstep engine: protocol SPI, network model, tick engine,
+mesh sharding.
+
+This is the TPU-native replacement for the reference's tokio runtime +
+TransportHub mesh (``src/server/transport.rs``): instead of one async event
+loop per replica process exchanging TCP frames, thousands of replica groups
+live as struct-of-arrays JAX state and exchange fixed-width message records
+through a pure-functional network model, stepped in lockstep by one jitted
+kernel per tick.
+"""
+
+from .protocol import ProtocolKernel, StepEffects
+from .netmodel import NetConfig, NetModel, ControlInputs
+from .engine import Engine
+
+__all__ = [
+    "ProtocolKernel",
+    "StepEffects",
+    "NetConfig",
+    "NetModel",
+    "ControlInputs",
+    "Engine",
+]

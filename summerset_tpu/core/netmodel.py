@@ -1,0 +1,212 @@
+"""Pure-functional lockstep network model.
+
+The TPU-native replacement for the reference's peer-to-peer TCP mesh
+(``TransportHub``, ``src/server/transport.rs``) *and* for its netem-based
+WAN emulation (``scripts/utils/net.py`` applying ``tc qdisc netem`` delay /
+jitter / rate per veth interface — SURVEY.md §4.3): message delivery is a
+tensor transform, link delay is a delay-line buffer measured in ticks, and
+packet loss / partitions / paused replicas are masks applied to the ``flags``
+lane of every message record.
+
+Reliability semantics: the reference treats TCP as an infinitely-retried
+reliable FIFO channel (``transport.rs:3-7``).  Here a *delivered* tick-`t`
+outbox arrives exactly once at tick ``t + delay``; a *masked* message is
+lost forever (the analog of a TCP connection reset mid-flight) — protocols
+must tolerate loss via their retry machinery (go-back-N accept streams,
+heartbeat-carried state), which the kernels implement.  Per-link FIFO
+ordering holds because jitter is drawn per-source-per-tick and bounded, and
+all protocol streams carry cumulative frontiers, so reordering within the
+jitter window is harmless.
+
+Delivery orientation: outbox per-pair fields are ``[G, R_src, R_dst]``; the
+inbox presents them transposed to ``[G, R_dst, R_src]`` so that receiver
+code indexes axis 1 = self, axis 2 = sender.  Broadcast window lanes
+``[G, R_src, W]`` are delivered unchanged (receiver indexes axis 1 by
+sender).  When the replica axis is sharded over the mesh, this transpose
+lowers to an all-to-all over ICI (see ``core/sharding.py``).
+
+Per-tick call order (driven by the engine):
+
+1. ``netstate, inbox = net.pop(netstate, ctrl)``   — messages due this tick
+2. ``state, outbox, fx = kernel.step(state, inbox, inputs)``
+3. ``netstate = net.push(netstate, outbox, ctrl)`` — enqueue + advance tick
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import prng
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class NetConfig:
+    """Static network emulation parameters (all in ticks / probabilities)."""
+
+    delay_ticks: int = 1      # uniform base one-way delay (>= 1)
+    max_delay_ticks: int = 1  # delay-line depth D (auto-raised to fit jitter)
+    jitter_ticks: int = 0     # per-(source, tick) extra delay in [0, jitter]
+    drop_rate: float = 0.0    # iid per-message loss probability
+
+    def __post_init__(self):
+        if self.delay_ticks < 1:
+            raise ValueError("delay_ticks must be >= 1")
+        if self.max_delay_ticks < self.delay_ticks + self.jitter_ticks:
+            object.__setattr__(
+                self, "max_delay_ticks", self.delay_ticks + self.jitter_ticks
+            )
+
+
+@dataclasses.dataclass
+class ControlInputs:
+    """Per-tick fault-injection masks (the manager-oracle pause/partition
+    analog; reference ``clusman.rs`` pause/resume and tc-netem partitions).
+
+    ``alive``:   [G, R] bool — False freezes a replica (pause): it sends
+                 nothing, receives nothing, and its state does not advance.
+    ``link_up``: [G, R, R] bool — False drops messages src->dst (partition).
+    """
+
+    alive: Any = None
+    link_up: Any = None
+
+    @staticmethod
+    def all_up(G: int, R: int) -> "ControlInputs":
+        return ControlInputs(
+            alive=jnp.ones((G, R), jnp.bool_),
+            link_up=jnp.ones((G, R, R), jnp.bool_),
+        )
+
+
+class NetModel:
+    """Delay-line message delivery with loss/partition masking.
+
+    Netstate: ``bufs`` — per-field arrays of shape ``[D, ...field...]`` where
+    slot ``(cursor + d) % D`` holds messages due ``d`` ticks from now; a
+    ``cursor``; and a PRNG lane.  With the default ``D == 1`` (uniform 1-tick
+    delay, no jitter) pop/push degenerate to a buffer swap + transpose that
+    XLA fuses into the step kernel.
+    """
+
+    def __init__(self, cfg: NetConfig, num_groups: int, population: int,
+                 broadcast_lanes: frozenset):
+        self.cfg = cfg
+        self.G = num_groups
+        self.R = population
+        self.broadcast_lanes = broadcast_lanes
+
+    def init_netstate(self, zero_outbox: Pytree, seed: int = 17) -> Pytree:
+        D = self.cfg.max_delay_ticks
+        bufs = jax.tree.map(
+            lambda x: jnp.zeros((D,) + x.shape, x.dtype), zero_outbox
+        )
+        return {
+            "bufs": bufs,
+            "cursor": jnp.int32(0),
+            # absolute tick of the last enqueued delivery per source; keeps
+            # jittered due-slots strictly increasing (FIFO, no clobbering)
+            "last_due": jnp.zeros((self.G, self.R), jnp.int32),
+            "tick": jnp.int32(0),
+            "rng": prng.seed_state(17 + seed, (self.G, self.R, self.R)),
+        }
+
+    def pop(
+        self, netstate: Pytree, ctrl: Optional[ControlInputs] = None
+    ) -> Tuple[Pytree, Pytree]:
+        """Dequeue the messages due this tick, oriented for receivers."""
+        D = self.cfg.max_delay_ticks
+        cursor = netstate["cursor"]
+        bufs = netstate["bufs"]
+        if D == 1:
+            raw = {k: b[0] for k, b in bufs.items()}
+        else:
+            raw = {k: b[cursor] for k, b in bufs.items()}
+            bufs = {
+                k: b.at[cursor].set(jnp.zeros_like(b[0]))
+                for k, b in bufs.items()
+            }
+
+        # receiver-side mask: a replica paused *now* receives nothing
+        flags = raw["flags"]
+        if ctrl is not None and ctrl.alive is not None:
+            flags = jnp.where(ctrl.alive[:, None, :], flags, jnp.uint32(0))
+        raw = dict(raw, flags=flags)
+
+        inbox = {
+            k: (v if k in self.broadcast_lanes else jnp.swapaxes(v, 1, 2))
+            for k, v in raw.items()
+        }
+        return dict(netstate, bufs=bufs), inbox
+
+    def push(
+        self,
+        netstate: Pytree,
+        outbox: Pytree,
+        ctrl: Optional[ControlInputs] = None,
+    ) -> Pytree:
+        """Enqueue this tick's outbox with sender-side masking; advance tick."""
+        cfg = self.cfg
+        D = cfg.max_delay_ticks
+        bufs = netstate["bufs"]
+        cursor = netstate["cursor"]
+        rng = netstate["rng"]
+
+        flags = outbox["flags"]
+        mask = jnp.ones(flags.shape, jnp.bool_)
+        if ctrl is not None and ctrl.alive is not None:
+            mask &= ctrl.alive[:, :, None]  # dead source sends nothing
+        if ctrl is not None and ctrl.link_up is not None:
+            mask &= ctrl.link_up
+        if cfg.drop_rate > 0.0:
+            rng, u = prng.uniform_unit(rng)
+            mask &= u >= cfg.drop_rate
+        outbox = dict(outbox, flags=jnp.where(mask, flags, jnp.uint32(0)))
+
+        tick = netstate["tick"]
+        last_due = netstate["last_due"]
+        if D == 1:
+            bufs = {k: b.at[0].set(outbox[k]) for k, b in bufs.items()}
+        else:
+            # Jitter per (group, source) — not per link — so a source's
+            # broadcast window lanes land in the same delay slot as its
+            # per-pair records and receivers never see torn messages.
+            delay = jnp.full((self.G, self.R), cfg.delay_ticks, jnp.int32)
+            if cfg.jitter_ticks > 0:
+                rng_src = rng[:, :, 0]
+                rng_nxt, extra = prng.uniform_int(
+                    rng_src, 0, cfg.jitter_ticks + 1
+                )
+                rng = rng.at[:, :, 0].set(rng_nxt)
+                delay = delay + extra
+            # Clamp the absolute due tick to be strictly after the source's
+            # previous one (FIFO + no slot collisions: an in-flight message
+            # is never clobbered by a later send) and within the ring.
+            due_abs = jnp.clip(
+                jnp.maximum(tick + delay, last_due + 1), tick + 1, tick + D
+            )
+            last_due = due_abs
+            due = (cursor + (due_abs - tick)) % D  # [G, R_src]
+            arange_d = jnp.arange(D, dtype=jnp.int32)
+
+            def enqueue(buf, field):
+                # buf: [D, G, R_src, ...]; one-hot over D on the source's due
+                # slot, broadcast over trailing dims (dst and/or window).
+                oh = arange_d[:, None, None] == due[None]  # [D, G, R_src]
+                oh = oh.reshape(oh.shape + (1,) * (field.ndim - 2))
+                return jnp.where(oh, field[None], buf)
+
+            bufs = {k: enqueue(bufs[k], outbox[k]) for k in outbox}
+
+        return {
+            "bufs": bufs,
+            "cursor": (cursor + 1) % jnp.int32(max(D, 1)),
+            "last_due": last_due,
+            "tick": tick + 1,
+            "rng": rng,
+        }

@@ -1,0 +1,119 @@
+"""Protocol SPI for vectorized lockstep consensus kernels.
+
+This is the TPU-native analog of the reference's ``GenericReplica`` trait
+(``src/server/replica.rs:16-42``): where the reference dispatches one
+``tokio::select!`` event loop per replica process, a :class:`ProtocolKernel`
+defines pure functions over batched state — ``init_state`` builds the
+struct-of-arrays pytree for ``[num_groups, population]`` replicas, and
+``step`` advances every replica of every group by one lockstep tick.
+
+Design rules (required for masking / sharding to work uniformly):
+
+- every state leaf has leading dims ``[G, R]`` (group, replica);
+- every outbox leaf is either a per-directed-pair field ``[G, R_src, R_dst]``
+  (delivered transposed to ``[G, R_dst, R_src]``) or a broadcast window lane
+  ``[G, R_src, W]`` named in ``broadcast_lanes`` (delivered as-is; receivers
+  index axis 1 by sender);
+- the outbox must contain a uint32 ``flags`` per-pair field; the network
+  model zeroes ``flags`` on dead/partitioned/dropped links and consumers
+  must gate every read on it;
+- no data-dependent Python control flow: everything is masked updates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, FrozenSet, Tuple
+
+import jax
+
+Pytree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StepEffects:
+    """Per-tick observables extracted by the engine.
+
+    ``commit_bar``/``exec_bar``: ``[G, R]`` int32 snapshots after the tick.
+    ``extra``: protocol-specific dict of arrays (e.g. read results, lease
+    status) — must be fixed-shape.
+    """
+
+    commit_bar: Any
+    exec_bar: Any
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class ProtocolKernel:
+    """Base class for vectorized protocol kernels.
+
+    Subclasses are constructed with static geometry (``num_groups``,
+    ``population``, ``window``) plus a protocol config dataclass, and are
+    hashable/static from JAX's perspective — all dynamic data lives in the
+    state pytree.
+    """
+
+    name: str = "generic"
+    # outbox leaves that are [G, R_src, W] broadcast lanes (not per-pair)
+    broadcast_lanes: FrozenSet[str] = frozenset()
+
+    def __init__(self, num_groups: int, population: int, window: int):
+        if population < 1 or population > 32:
+            raise ValueError("population must be in [1, 32] (uint32 bitmap lanes)")
+        self.num_groups = num_groups
+        self.population = population
+        self.window = window
+
+    # -- geometry shorthands -------------------------------------------------
+    @property
+    def G(self) -> int:
+        return self.num_groups
+
+    @property
+    def R(self) -> int:
+        return self.population
+
+    @property
+    def W(self) -> int:
+        return self.window
+
+    @property
+    def quorum(self) -> int:
+        return self.population // 2 + 1
+
+    # -- SPI -----------------------------------------------------------------
+    def init_state(self, seed: int = 0) -> Pytree:
+        raise NotImplementedError
+
+    def zero_outbox(self) -> Pytree:
+        """An all-invalid outbox (flags == 0); defines the outbox structure."""
+        raise NotImplementedError
+
+    def step(
+        self, state: Pytree, inbox: Pytree, inputs: Dict[str, Any]
+    ) -> Tuple[Pytree, Pytree, StepEffects]:
+        """Advance one lockstep tick.
+
+        ``inbox`` has the same structure as ``zero_outbox`` but with per-pair
+        fields transposed to ``[G, R_dst, R_src]``.  ``inputs`` carries host
+        inputs for this tick (client proposals, exec floor, ...).
+        """
+        raise NotImplementedError
+
+    # JAX static-argument support: kernels are static per (class, geometry,
+    # config) so jitted steps cache correctly.  Subclasses store their config
+    # dataclass as ``self.config`` so it participates in the cache key.
+    def _static_key(self) -> tuple:
+        cfg = getattr(self, "config", None)
+        cfg_key = dataclasses.astuple(cfg) if dataclasses.is_dataclass(cfg) else cfg
+        return (type(self), self.num_groups, self.population, self.window, cfg_key)
+
+    def __hash__(self) -> int:
+        return hash(self._static_key())
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ProtocolKernel)
+            and self._static_key() == other._static_key()
+        )

@@ -1,0 +1,1 @@
+"""Device kernels and low-level op helpers (Pallas GF(2^8) RS coding, PRNG)."""

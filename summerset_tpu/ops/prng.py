@@ -1,0 +1,50 @@
+"""Cheap per-(group, replica) counter-based PRNG for timeout jitter.
+
+Parity: the reference randomizes per-peer hear-timeouts from a configured
+range (``src/server/heartbeat.rs:96-116``); in the lockstep design every
+(group, replica) carries a uint32 LCG state advanced inside the jitted step,
+so elections de-synchronize across the batch without host involvement.
+
+A full counter-based Threefry (jax.random) would be overkill here: jitter
+quality requirements are "don't let all replicas time out on the same tick",
+which a 32-bit LCG with multiplier 1664525 (Numerical Recipes) satisfies at
+~4 ops per draw on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_MULT = jnp.uint32(1664525)
+_INC = jnp.uint32(1013904223)
+
+
+def seed_state(seed: int, shape) -> jnp.ndarray:
+    """Deterministic distinct uint32 seeds for an array of generators."""
+    n = 1
+    for d in shape:
+        n *= d
+    base = jnp.arange(n, dtype=jnp.uint32).reshape(shape)
+    return (base * jnp.uint32(2654435761) + jnp.uint32(seed)) | jnp.uint32(1)
+
+
+def lcg_next(state: jnp.ndarray) -> jnp.ndarray:
+    return state * _MULT + _INC
+
+
+def uniform_int(state: jnp.ndarray, lo, hi):
+    """Draw ints in [lo, hi) elementwise; returns (new_state, draws).
+
+    ``lo``/``hi`` may be scalars or arrays broadcastable to ``state.shape``.
+    Uses the high-entropy upper bits of the LCG state.
+    """
+    nxt = lcg_next(state)
+    span = jnp.asarray(hi - lo, jnp.uint32)
+    draw = (nxt >> jnp.uint32(8)) % jnp.maximum(span, jnp.uint32(1))
+    return nxt, (jnp.asarray(lo, jnp.int32) + draw.astype(jnp.int32))
+
+
+def uniform_unit(state: jnp.ndarray):
+    """Draw floats in [0, 1); returns (new_state, draws)."""
+    nxt = lcg_next(state)
+    return nxt, (nxt >> jnp.uint32(8)).astype(jnp.float32) * (1.0 / (1 << 24))

@@ -1,0 +1,43 @@
+"""Vectorized protocol kernels + the SmrProtocol factory.
+
+Parity: reference ``src/protocols/`` — 11 protocol modules dispatched by the
+``SmrProtocol`` enum (``src/protocols/mod.rs:63-280``).  Here each protocol
+is a :class:`~summerset_tpu.core.protocol.ProtocolKernel` subclass stepping
+``[num_groups, population]`` replicas in lockstep; the factory maps protocol
+names to kernel classes.
+"""
+
+from typing import Dict, Type
+
+from ..core.protocol import ProtocolKernel
+
+
+_REGISTRY: Dict[str, Type[ProtocolKernel]] = {}
+
+
+def register_protocol(name: str):
+    def deco(cls):
+        _REGISTRY[name.lower()] = cls
+        cls.name = name
+        return cls
+
+    return deco
+
+
+def protocol_names():
+    return sorted(_REGISTRY)
+
+
+def make_protocol(name: str, *args, **kwargs) -> ProtocolKernel:
+    """Factory dispatch (parity: ``SmrProtocol`` enum construction)."""
+    try:
+        cls = _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unrecognized protocol name '{name}'; have {protocol_names()}"
+        ) from None
+    return cls(*args, **kwargs)
+
+
+# import protocol modules for registration side effects
+from . import multipaxos  # noqa: E402,F401

@@ -1,0 +1,98 @@
+"""Shared helpers for vectorized consensus kernels.
+
+These encode the recurring shapes of lockstep SMR: ballot arithmetic,
+ring-window range covers, per-sender message selection, and k-th-largest
+quorum tallies.  All functions are jit-safe elementwise/vector ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NULL_VAL = jnp.int32(0)   # reserved value id: no-op filler
+NO_SLOT = jnp.int32(-1)   # empty window position marker
+
+
+# ----------------------------------------------------------------- ballots --
+def make_greater_ballot(bal_max, rid):
+    """Next ballot for `rid` above `bal_max`: ``(round+1) << 8 | id``.
+
+    Parity: reference ballot composition ``(base << 8) | id``
+    (``src/protocols/multipaxos/mod.rs:553-561``) — uniqueness per
+    (round, replica) makes ballot comparison a total order with owner
+    recoverable via ``bal & 0xff``.
+    """
+    return (((bal_max >> 8) + 1) << 8) | rid
+
+
+def ballot_owner(bal):
+    return bal & 0xFF
+
+
+def initial_ballot(rid):
+    return (1 << 8) | rid
+
+
+# ------------------------------------------------------------- ring window --
+def range_cover(lo, hi, window: int):
+    """Cover of absolute-slot range [lo, hi) on a size-`window` ring.
+
+    ``lo``/``hi``: int32 arrays [...]; returns ``(mask, abs_slots)`` of shape
+    ``[..., W]`` where position ``p`` holds absolute slot
+    ``lo + ((p - lo) mod W)`` and ``mask`` selects those below ``hi``.
+    Requires ``hi - lo <= W`` (guaranteed by the log-window guard).
+    """
+    p = jnp.arange(window, dtype=jnp.int32)
+    lo_e = lo[..., None]
+    abs_slots = lo_e + ((p - lo_e) % window)
+    mask = abs_slots < hi[..., None]
+    return mask, abs_slots
+
+
+# ------------------------------------------------------------ msg selection --
+def best_by_ballot(flags, bit, bal_field):
+    """Among senders with `bit` set in flags, pick the max-ballot one.
+
+    ``flags``/``bal_field``: [G, R, R_src].  Returns ``(ok, bal, src)`` each
+    [G, R]: ok = any valid sender, bal = its ballot, src = its index.
+    """
+    valid = (flags & jnp.uint32(bit)) != 0
+    eff = jnp.where(valid, bal_field, jnp.int32(-1))
+    best = eff.max(axis=2)
+    src = eff.argmax(axis=2).astype(jnp.int32)
+    return best >= 0, best, src
+
+
+def take_src(field, src):
+    """Gather per-sender scalar field [G, R, R_src] at src [G, R] -> [G, R]."""
+    return jnp.take_along_axis(field, src[..., None], axis=2)[..., 0]
+
+
+def take_lane(lane, src):
+    """Gather broadcast window lane [G, R_src, W] at src [G, R] -> [G, R, W]."""
+    G = lane.shape[0]
+    return lane[jnp.arange(G)[:, None], src]
+
+
+# ------------------------------------------------------------ quorum tally --
+def kth_largest(values, k: int):
+    """k-th largest along the last axis (k>=1): the quorum-frontier tally.
+
+    For cumulative-ack replication, ``kth_largest(frontiers, quorum)`` is the
+    highest slot bound such that >= quorum replicas acked everything below it
+    — the vectorized form of the reference's per-slot quorum count
+    (``multipaxos/messages.rs:370-442``) under FIFO range streams.
+    """
+    r = values.shape[-1]
+    return jnp.sort(values, axis=-1)[..., r - k]
+
+
+def dst_onehot(src, R: int):
+    """[G, R] sender index -> [G, R, R_dst] bool one-hot (for reply routing)."""
+    return jnp.arange(R, dtype=jnp.int32)[None, None, :] == src[..., None]
+
+
+def not_self(G: int, R: int):
+    """[G, R_src, R_dst] mask: True off-diagonal (no self-sends)."""
+    eye = jnp.eye(R, dtype=jnp.bool_)
+    return jnp.broadcast_to(~eye[None, :, :], (G, R, R))

@@ -1,0 +1,576 @@
+"""Vectorized MultiPaxos: batched multi-decree Paxos stepped in lockstep.
+
+Parity target: reference ``src/protocols/multipaxos/`` (SURVEY.md §2.5) —
+slot-wise instances with ``(round << 8) | id`` ballots, bulk Prepare from a
+trigger slot with per-slot value adoption, Accept quorum tally, commit/exec
+bars advanced in order, leader step-up on heartbeat timeout, snapshot-style
+log GC bounded by the min peer exec bar (``snap_bar``,
+``multipaxos/mod.rs:470-478``).
+
+TPU-first redesign (NOT a port of the tokio event loop):
+
+- **State** is struct-of-arrays over ``[G groups, R replicas]`` with a
+  ``W``-slot ring log window per replica (``win_abs/win_bal/win_val``).
+  Values are int32 *references* into a host-side payload store — the device
+  runs the control plane of consensus; bulky request batches never touch HBM
+  (SURVEY.md §7 hard part (b)).
+- **Replication is per-peer go-back-N range streams with cumulative acks**:
+  the leader keeps a ``next_idx`` send cursor per peer; followers maintain a
+  contiguous voting run ``[vote_from, vote_bar)`` at their current ballot and
+  ack with their durable frontier; a gap triggers a NACK with a rewind hint.
+  The reference's per-slot ack bitmap tally (``messages.rs:370-442``)
+  becomes a k-th-largest over R cumulative frontiers — O(R log R) vector ops
+  per group per tick instead of per-slot scatter/gather, which is what makes
+  the quorum tally MXU/VPU-friendly.
+- **Commit propagation rides heartbeats** (the reference's CommitSlot WAL
+  entry + urgent CommitNotice, ``durability.rs:148``): followers advance
+  ``commit_bar`` to ``min(leader commit_bar, own voted frontier)`` only when
+  their voting run is at the leader's ballot — the vote-at-ballot-b condition
+  that makes heartbeat commit safe.
+- **Leader election**: per-replica jittered countdowns (reference randomized
+  hear-timeouts, ``heartbeat.rs:96-116``) -> candidate broadcasts Prepare
+  with ``trigger = commit_bar`` (``leadership.rs:113-134``); followers reply
+  with their voted window (broadcast lanes ``bw_abs/bw_bal/bw_val``), the
+  candidate adopts max-ballot values per slot, fills holes with no-ops, and
+  re-proposes the tail at its ballot (``messages.rs:87`` semantics).
+
+Known deviation from the reference: message loss here means silent drop (the
+netmodel's masks), so liveness machinery (candidate re-Prepare each tick,
+per-peer retry countdown with go-back-to-matched-frontier) is built into the
+kernel rather than delegated to TCP retransmission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.protocol import ProtocolKernel, StepEffects
+from ..ops import prng
+from ..utils.bitmap import popcount
+from . import register_protocol
+from .common import (
+    NO_SLOT,
+    NULL_VAL,
+    best_by_ballot,
+    dst_onehot,
+    initial_ballot,
+    kth_largest,
+    make_greater_ballot,
+    not_self,
+    range_cover,
+    take_lane,
+    take_src,
+)
+
+# message flag bits
+ACCEPT = 1
+ACCEPT_REPLY = 2
+HEARTBEAT = 4
+HB_REPLY = 8
+PREPARE = 16
+PREPARE_REPLY = 32
+AR_NACK = 64  # modifier on ACCEPT_REPLY: sender saw a gap; rewind to ar_hint
+SNAPSHOT = 128  # install-snapshot: jump a >window-behind follower forward
+
+
+@dataclasses.dataclass
+class ReplicaConfigMultiPaxos:
+    """Static per-run knobs (parity: ``ReplicaConfigMultiPaxos``,
+    ``multipaxos/mod.rs:49-120``, re-expressed in ticks)."""
+
+    max_proposals_per_tick: int = 16    # client batch intake per group/tick
+    chunk_size: int = 64                # max Accept slots per peer per tick
+                                        # (parity: msg_chunk_size)
+    hb_send_interval: int = 1           # leader heartbeat period (ticks)
+    hear_timeout_lo: int = 30           # election timeout jitter range
+    hear_timeout_hi: int = 60
+    retry_interval: int = 8             # go-back-N resend countdown
+    dur_lag: int = 0                    # WAL ack lag in slots/tick (0=instant)
+    exec_follows_commit: bool = True    # device-only mode: exec == commit
+    init_leader: int = 0                # warm-start leader id; -1 = cold elect
+
+
+@register_protocol("MultiPaxos")
+class MultiPaxosKernel(ProtocolKernel):
+    broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val"})
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigMultiPaxos | None = None,
+    ):
+        super().__init__(num_groups, population, window)
+        self.config = config or ReplicaConfigMultiPaxos()
+        if self.config.max_proposals_per_tick > window // 2:
+            raise ValueError("max_proposals_per_tick must be <= window/2")
+        # an Accept range never exceeds the ring window
+        self._chunk = min(self.config.chunk_size, window)
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, seed: int = 0):
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
+        i32 = jnp.int32
+        zeros = lambda *shape: jnp.zeros(shape, i32)  # noqa: E731
+        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+
+        rng = prng.seed_state(seed, (G, R))
+        rng, hb_cnt = prng.uniform_int(
+            rng, cfg.hear_timeout_lo, cfg.hear_timeout_hi
+        )
+
+        st = {
+            "bal_max": zeros(G, R),
+            "bal_prepared": zeros(G, R),
+            "bal_prep_sent": zeros(G, R),
+            "leader": jnp.full((G, R), -1, i32),
+            "prep_trigger": zeros(G, R),
+            "prep_acks": jnp.zeros((G, R), jnp.uint32),
+            "prep_hi": zeros(G, R),
+            "next_slot": zeros(G, R),
+            "commit_bar": zeros(G, R),
+            "exec_bar": zeros(G, R),
+            "vote_bal": zeros(G, R),
+            "vote_from": zeros(G, R),
+            "vote_bar": zeros(G, R),
+            "dur_bar": zeros(G, R),
+            "hb_cnt": hb_cnt,
+            "hb_send_cnt": zeros(G, R),
+            "rng": rng,
+            "next_idx": zeros(G, R, R),
+            "match_f": zeros(G, R, R),
+            "match_from": zeros(G, R, R),
+            "match_bal": zeros(G, R, R),
+            "retry_cnt": jnp.full((G, R, R), cfg.retry_interval, i32),
+            "peer_exec": zeros(G, R, R),
+            "win_abs": jnp.full((G, R, W), NO_SLOT, i32),
+            "win_bal": zeros(G, R, W),
+            "win_val": jnp.full((G, R, W), NULL_VAL, i32),
+        }
+
+        if cfg.init_leader >= 0:
+            L = cfg.init_leader
+            bal0 = int(initial_ballot(jnp.int32(L)))
+            is_l = rid == L
+            st["bal_max"] = jnp.full((G, R), bal0, i32)
+            st["bal_prepared"] = jnp.where(is_l, bal0, 0)
+            st["bal_prep_sent"] = jnp.where(is_l, bal0, 0)
+            st["leader"] = jnp.full((G, R), L, i32)
+            st["vote_bal"] = jnp.full((G, R), bal0, i32)
+        return st
+
+    # ---------------------------------------------------------------- outbox
+    def zero_outbox(self):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        return {
+            "flags": jnp.zeros((G, R, R), jnp.uint32),
+            "acc_bal": pair(), "acc_lo": pair(), "acc_hi": pair(),
+            "ar_bal": pair(), "ar_from": pair(), "ar_f": pair(),
+            "ar_hint": pair(),
+            "hb_bal": pair(), "hb_cbar": pair(), "hb_ebar": pair(),
+            "hbr_ebar": pair(),
+            "prp_bal": pair(), "prp_trigger": pair(),
+            "prr_bal": pair(), "prr_hi": pair(),
+            "snp_bal": pair(), "snp_to": pair(),
+            "bw_abs": jnp.zeros((G, R, W), i32),
+            "bw_bal": jnp.zeros((G, R, W), i32),
+            "bw_val": jnp.zeros((G, R, W), i32),
+        }
+
+    # ------------------------------------------------------------------ step
+    def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
+        i32 = jnp.int32
+        s = dict(state)
+        flags = inbox["flags"]
+        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+
+        # =========== 1. HEARTBEAT ingest (leader liveness + commit notice)
+        hb_ok, hb_bal, hb_src = best_by_ballot(flags, HEARTBEAT, inbox["hb_bal"])
+        hb_ok &= hb_bal >= s["bal_max"]
+        s["leader"] = jnp.where(hb_ok, hb_src, s["leader"])
+        s["bal_max"] = jnp.where(hb_ok, hb_bal, s["bal_max"])
+        s["rng"], reload = prng.uniform_int(
+            s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
+        )
+        s["hb_cnt"] = jnp.where(hb_ok, reload, s["hb_cnt"])
+        # follower commit advance: only when voting at the leader's ballot
+        # with a run reaching back to (at or below) our commit bar
+        hb_cbar = take_src(inbox["hb_cbar"], hb_src)
+        can_commit = (
+            hb_ok
+            & (s["vote_bal"] == hb_bal)
+            & (s["vote_from"] <= s["commit_bar"])
+        )
+        s["commit_bar"] = jnp.where(
+            can_commit,
+            jnp.maximum(s["commit_bar"], jnp.minimum(hb_cbar, s["vote_bar"])),
+            s["commit_bar"],
+        )
+        hb_reply_to = hb_ok  # reply routing computed in send phase
+
+        # =========== 2. PREPARE ingest (promise + voted-window reply)
+        p_ok, p_bal, p_src = best_by_ballot(flags, PREPARE, inbox["prp_bal"])
+        p_ok &= p_bal >= s["bal_max"]
+        s["bal_max"] = jnp.where(p_ok, p_bal, s["bal_max"])
+        s["leader"] = jnp.where(p_ok, p_src, s["leader"])
+        # also reset the election countdown: someone is actively campaigning
+        s["hb_cnt"] = jnp.where(p_ok, reload, s["hb_cnt"])
+        voted_extent = jnp.max(
+            jnp.where(s["win_bal"] > 0, s["win_abs"] + 1, 0), axis=2
+        )
+        prr_hi_out = voted_extent
+
+        # =========== 2b. SNAPSHOT ingest (install: jump forward)
+        # The reference never discards log a peer still needs (conservative
+        # snap_bar, mod.rs:470-478) at the cost of unbounded memory; fixed
+        # ring windows instead bound capacity by the leader's own exec bar
+        # and laggards get a Raft-style install-snapshot (state itself is
+        # transferred host-side; the device installs the bars).
+        sn_ok, sn_bal, sn_src = best_by_ballot(flags, SNAPSHOT, inbox["snp_bal"])
+        sn_ok &= sn_bal >= s["bal_max"]
+        sn_to = take_src(inbox["snp_to"], sn_src)
+        sn_adv = sn_ok & (sn_to > s["commit_bar"])
+        s["bal_max"] = jnp.where(sn_ok, sn_bal, s["bal_max"])
+        s["leader"] = jnp.where(sn_ok, sn_src, s["leader"])
+        s["hb_cnt"] = jnp.where(sn_ok, reload, s["hb_cnt"])
+        s["commit_bar"] = jnp.where(sn_adv, sn_to, s["commit_bar"])
+        s["exec_bar"] = jnp.where(sn_adv, jnp.maximum(s["exec_bar"], sn_to), s["exec_bar"])
+        s["vote_bal"] = jnp.where(sn_adv, sn_bal, s["vote_bal"])
+        s["vote_from"] = jnp.where(sn_adv, sn_to, s["vote_from"])
+        s["vote_bar"] = jnp.where(sn_adv, sn_to, s["vote_bar"])
+        s["dur_bar"] = jnp.where(sn_adv, sn_to, s["dur_bar"])
+        # drop window entries below the install point (now host-state)
+        stale_win = sn_adv[..., None] & (s["win_abs"] < sn_to[..., None])
+        s["win_abs"] = jnp.where(stale_win, NO_SLOT, s["win_abs"])
+        s["win_bal"] = jnp.where(stale_win, 0, s["win_bal"])
+
+        # =========== 3. ACCEPT ingest (acceptor voting run)
+        a_ok, a_bal, a_src = best_by_ballot(flags, ACCEPT, inbox["acc_bal"])
+        a_ok &= a_bal >= s["bal_max"]
+        a_lo = take_src(inbox["acc_lo"], a_src)
+        a_hi = take_src(inbox["acc_hi"], a_src)
+        s["bal_max"] = jnp.where(a_ok, a_bal, s["bal_max"])
+        s["leader"] = jnp.where(a_ok, a_src, s["leader"])
+        s["hb_cnt"] = jnp.where(a_ok, reload, s["hb_cnt"])
+
+        same_run = a_ok & (s["vote_bal"] == a_bal)
+        new_run = a_ok & (s["vote_bal"] != a_bal)
+        # same-ballot: contiguity with the run (overlap or adjacency)
+        run_merge = same_run & (a_lo <= s["vote_bar"]) & (a_hi >= s["vote_from"])
+        gap = same_run & (a_lo > s["vote_bar"])
+        apply_rng = run_merge | new_run
+
+        # window writes for the applied range, values from the sender's lane
+        m_acc, abs_acc = range_cover(a_lo, a_hi, W)
+        m_acc &= apply_rng[..., None]
+        lane_val = take_lane(inbox["bw_val"], a_src)
+        s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
+        s["win_bal"] = jnp.where(m_acc, a_bal[..., None], s["win_bal"])
+        s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
+
+        s["vote_from"] = jnp.where(
+            new_run, a_lo, jnp.where(run_merge, jnp.minimum(s["vote_from"], a_lo), s["vote_from"])
+        )
+        s["vote_bar"] = jnp.where(
+            new_run, a_hi, jnp.where(run_merge, jnp.maximum(s["vote_bar"], a_hi), s["vote_bar"])
+        )
+        s["vote_bal"] = jnp.where(a_ok & apply_rng, a_bal, s["vote_bal"])
+        # a new run that starts above our commit bar leaves a hole -> nack
+        # so the leader rewinds and backfills [commit_bar, lo)
+        nack = gap | (new_run & (a_lo > s["commit_bar"]))
+        nack_hint = jnp.where(gap, s["vote_bar"], s["commit_bar"])
+
+        # =========== 4. ACCEPT_REPLY ingest (leader match bookkeeping)
+        ar_valid = (flags & ACCEPT_REPLY) != 0
+        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (s["bal_prepared"] > 0)
+        ar_mine = ar_valid & (inbox["ar_bal"] == s["bal_max"][..., None]) & i_am_leader[..., None]
+        prog = ar_mine & (inbox["ar_f"] > s["match_f"])
+        s["match_f"] = jnp.where(ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"])
+        s["match_from"] = jnp.where(ar_mine, inbox["ar_from"], s["match_from"])
+        s["match_bal"] = jnp.where(ar_mine, inbox["ar_bal"], s["match_bal"])
+        ar_nacked = ar_mine & ((flags & AR_NACK) != 0)
+        s["next_idx"] = jnp.where(
+            ar_nacked, jnp.minimum(s["next_idx"], inbox["ar_hint"]), s["next_idx"]
+        )
+        s["retry_cnt"] = jnp.where(
+            prog | ar_nacked, cfg.retry_interval, s["retry_cnt"]
+        )
+
+        # =========== 5. HB_REPLY ingest (peer exec bars for snap_bar GC)
+        hbr_valid = (flags & HB_REPLY) != 0
+        s["peer_exec"] = jnp.where(
+            hbr_valid, jnp.maximum(s["peer_exec"], inbox["hbr_ebar"]), s["peer_exec"]
+        )
+
+        # =========== 6. PREPARE_REPLY ingest (candidate tally + adoption)
+        candidate = (s["bal_prep_sent"] == s["bal_max"]) & (
+            s["bal_prepared"] != s["bal_max"]
+        )
+        pr_valid = (flags & PREPARE_REPLY) != 0
+        pr_mine = pr_valid & (inbox["prr_bal"] == s["bal_prep_sent"][..., None]) & candidate[..., None]
+        trig = s["prep_trigger"]
+        # ack tally + voted-extent max, reduced over the sender axis
+        src_bits = (jnp.uint32(1) << jnp.arange(R, dtype=jnp.uint32))[None, None, :]
+        s["prep_acks"] = s["prep_acks"] | jnp.where(
+            pr_mine, src_bits, jnp.uint32(0)
+        ).sum(axis=2, dtype=jnp.uint32)
+        s["prep_hi"] = jnp.maximum(
+            s["prep_hi"],
+            jnp.max(jnp.where(pr_mine, inbox["prr_hi"], 0), axis=2),
+        )
+        # per-slot max-ballot value adoption across all replying senders,
+        # vectorized over [G, R, R_src, W] (classic Paxos adoption rule)
+        _, abs_ad = range_cover(trig, trig + W, W)  # [G, R, W]; mask is all-True
+        lane_abs = inbox["bw_abs"][:, None, :, :]  # [G, 1, R_src, W]
+        lane_bal = inbox["bw_bal"][:, None, :, :]
+        lane_val = inbox["bw_val"][:, None, :, :]
+        in_rng = abs_ad[:, :, None, :] < jnp.minimum(
+            inbox["prr_hi"], trig[..., None] + W
+        )[..., None]
+        ok = (
+            pr_mine[..., None]
+            & (lane_abs == abs_ad[:, :, None, :])
+            & (lane_bal > 0)
+            & in_rng
+        )
+        eff_bal = jnp.where(ok, lane_bal, 0)  # [G, R, R_src, W]
+        best_bal = eff_bal.max(axis=2)  # [G, R, W]
+        best_src = eff_bal.argmax(axis=2)[:, :, None, :]
+        best_val = jnp.take_along_axis(
+            jnp.broadcast_to(lane_val, eff_bal.shape), best_src, axis=2
+        )[:, :, 0, :]
+        adopt = (best_bal > 0) & (
+            (s["win_abs"] != abs_ad) | (best_bal > s["win_bal"])
+        )
+        s["win_abs"] = jnp.where(adopt, abs_ad, s["win_abs"])
+        s["win_bal"] = jnp.where(adopt, best_bal, s["win_bal"])
+        s["win_val"] = jnp.where(adopt, best_val, s["win_val"])
+
+        # =========== 7. election timeout -> campaign
+        active_leader = i_am_leader & (s["leader"] == rid)
+        s["hb_cnt"] = jnp.where(active_leader, s["hb_cnt"], s["hb_cnt"] - 1)
+        explode = (~active_leader) & (s["hb_cnt"] <= 0)
+        new_bal = make_greater_ballot(s["bal_max"], rid)
+        s["bal_max"] = jnp.where(explode, new_bal, s["bal_max"])
+        s["bal_prep_sent"] = jnp.where(explode, new_bal, s["bal_prep_sent"])
+        s["prep_trigger"] = jnp.where(explode, s["commit_bar"], s["prep_trigger"])
+        s["prep_acks"] = jnp.where(
+            explode, jnp.uint32(1) << rid.astype(jnp.uint32), s["prep_acks"]
+        )
+        s["prep_hi"] = jnp.where(
+            explode, jnp.maximum(voted_extent, s["commit_bar"]), s["prep_hi"]
+        )
+        s["leader"] = jnp.where(explode, rid, s["leader"])
+        s["rng"], reload2 = prng.uniform_int(
+            s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
+        )
+        s["hb_cnt"] = jnp.where(explode, reload2, s["hb_cnt"])
+        candidate = (candidate | explode) & (
+            s["bal_prep_sent"] == s["bal_max"]
+        )
+
+        # =========== 8. candidate -> leader on prepare quorum
+        # A candidate whose window cannot hold the voted tail it would have
+        # to re-propose (> W behind the frontier) must yield: proposing
+        # unseen slots would overwrite committed values.  It stops
+        # campaigning; a more current replica wins and snapshots it forward.
+        behind = candidate & (s["prep_hi"] - s["prep_trigger"] > W)
+        s["bal_prep_sent"] = jnp.where(behind, 0, s["bal_prep_sent"])
+        candidate &= ~behind
+        win = candidate & (popcount(s["prep_acks"]) >= self.quorum)
+        trig = s["prep_trigger"]
+        nslot = jnp.maximum(s["prep_hi"], s["commit_bar"])
+        m_re, abs_re = range_cover(trig, nslot, W)
+        m_re &= win[..., None]
+        hole = m_re & (s["win_abs"] != abs_re)
+        s["win_val"] = jnp.where(hole, NULL_VAL, s["win_val"])
+        s["win_abs"] = jnp.where(m_re, abs_re, s["win_abs"])
+        s["win_bal"] = jnp.where(m_re, s["bal_max"][..., None], s["win_bal"])
+        s["bal_prepared"] = jnp.where(win, s["bal_max"], s["bal_prepared"])
+        s["next_slot"] = jnp.where(win, nslot, s["next_slot"])
+        s["next_idx"] = jnp.where(win[..., None], trig[..., None], s["next_idx"])
+        s["match_bal"] = jnp.where(win[..., None], 0, s["match_bal"])
+        s["match_f"] = jnp.where(win[..., None], 0, s["match_f"])
+        s["vote_bal"] = jnp.where(win, s["bal_max"], s["vote_bal"])
+        s["vote_from"] = jnp.where(win, trig, s["vote_from"])
+        s["vote_bar"] = jnp.where(win, nslot, s["vote_bar"])
+        s["hb_send_cnt"] = jnp.where(win, 0, s["hb_send_cnt"])
+
+        # =========== 9. leader proposals (client batch intake)
+        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (s["bal_prepared"] > 0)
+        active_leader = i_am_leader & (s["leader"] == rid)
+        # ring capacity is bounded by the leader's own exec bar (own window
+        # reuse safety); laggards beyond it are healed via SNAPSHOT sends,
+        # not by stalling the group (availability > reference's conservative
+        # all-peers-executed GC rule).
+        space = jnp.maximum(s["exec_bar"] + W - s["next_slot"], 0)
+        n_prop = jnp.broadcast_to(
+            inputs["n_proposals"][:, None].astype(i32), (G, R)
+        )
+        n_new = jnp.where(
+            active_leader,
+            jnp.minimum(jnp.minimum(n_prop, space), cfg.max_proposals_per_tick),
+            0,
+        )
+        vbase = jnp.broadcast_to(inputs["value_base"][:, None].astype(i32), (G, R))
+        m_new, abs_new = range_cover(s["next_slot"], s["next_slot"] + n_new, W)
+        # value id for the i-th new proposal = value_base + (abs - next_slot)
+        new_vals = vbase[..., None] + (abs_new - s["next_slot"][..., None])
+        s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
+        s["win_bal"] = jnp.where(m_new, s["bal_max"][..., None], s["win_bal"])
+        s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
+        s["next_slot"] = s["next_slot"] + n_new
+        s["vote_bar"] = jnp.where(active_leader, s["next_slot"], s["vote_bar"])
+
+        # =========== 10. durability + leader commit tally + exec
+        if cfg.dur_lag > 0:
+            s["dur_bar"] = jnp.minimum(s["vote_bar"], s["dur_bar"] + cfg.dur_lag)
+        else:
+            s["dur_bar"] = s["vote_bar"]
+
+        # per-peer ballot-matched frontiers; own column = own durable frontier
+        peer_f = jnp.where(
+            (s["match_bal"] == s["bal_max"][..., None])
+            & (s["match_from"] <= s["commit_bar"][..., None]),
+            s["match_f"],
+            0,
+        )
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        peer_f = jnp.where(eye, s["dur_bar"][..., None], peer_f)
+        q_f = kth_largest(peer_f, self.quorum)
+        s["commit_bar"] = jnp.where(
+            active_leader,
+            jnp.clip(q_f, s["commit_bar"], s["next_slot"]),
+            s["commit_bar"],
+        )
+
+        if cfg.exec_follows_commit:
+            s["exec_bar"] = s["commit_bar"]
+        else:
+            s["exec_bar"] = jnp.maximum(
+                s["exec_bar"],
+                jnp.minimum(s["commit_bar"], inputs["exec_floor"].astype(i32)),
+            )
+
+        # =========== 11. build outbox
+        out = self.zero_outbox()
+        oflags = out["flags"]
+        ns_mask = not_self(G, R)
+
+        # ACCEPT streams: per-peer go-back-N with retry rewind
+        stale = (
+            active_leader[..., None]
+            & ns_mask
+            & (s["next_idx"] > jnp.maximum(s["match_f"], s["prep_trigger"][..., None]))
+        )
+        s["retry_cnt"] = jnp.where(stale, s["retry_cnt"] - 1, cfg.retry_interval)
+        rewind = stale & (s["retry_cnt"] <= 0)
+        matched_ok = s["match_bal"] == s["bal_max"][..., None]
+        s["next_idx"] = jnp.where(
+            rewind,
+            jnp.where(matched_ok, s["match_f"], s["prep_trigger"][..., None]),
+            s["next_idx"],
+        )
+        s["retry_cnt"] = jnp.where(rewind, cfg.retry_interval, s["retry_cnt"])
+
+        # peers fallen below the leader's window get an install-snapshot
+        # jump to the leader's exec bar (which is always in-window by the
+        # proposal guard), then the accept stream resumes from there
+        too_behind = (
+            active_leader[..., None]
+            & ns_mask
+            & (s["next_idx"] < (s["next_slot"] - W)[..., None])
+        )
+        oflags = oflags | jnp.where(too_behind, jnp.uint32(SNAPSHOT), 0)
+        out["snp_bal"] = jnp.where(too_behind, s["bal_max"][..., None], 0)
+        out["snp_to"] = jnp.where(too_behind, s["exec_bar"][..., None], 0)
+        s["next_idx"] = jnp.where(
+            too_behind, s["exec_bar"][..., None], s["next_idx"]
+        )
+
+        snd_lo = s["next_idx"]
+        snd_hi = jnp.minimum(
+            s["next_slot"][..., None], snd_lo + self._chunk
+        )
+        do_acc = active_leader[..., None] & ns_mask & (snd_hi > snd_lo)
+        oflags = oflags | jnp.where(do_acc, jnp.uint32(ACCEPT), 0)
+        out["acc_bal"] = jnp.where(do_acc, s["bal_max"][..., None], 0)
+        out["acc_lo"] = jnp.where(do_acc, snd_lo, 0)
+        out["acc_hi"] = jnp.where(do_acc, snd_hi, 0)
+        s["next_idx"] = jnp.where(do_acc, snd_hi, s["next_idx"])
+
+        # HEARTBEAT: leader every hb_send_interval ticks
+        s["hb_send_cnt"] = jnp.where(
+            active_leader, s["hb_send_cnt"] - 1, cfg.hb_send_interval
+        )
+        do_hb = (active_leader & (s["hb_send_cnt"] <= 0))[..., None] & ns_mask
+        s["hb_send_cnt"] = jnp.where(
+            active_leader & (s["hb_send_cnt"] <= 0),
+            cfg.hb_send_interval,
+            s["hb_send_cnt"],
+        )
+        oflags = oflags | jnp.where(do_hb, jnp.uint32(HEARTBEAT), 0)
+        out["hb_bal"] = jnp.where(do_hb, s["bal_max"][..., None], 0)
+        out["hb_cbar"] = jnp.where(do_hb, s["commit_bar"][..., None], 0)
+        out["hb_ebar"] = jnp.where(do_hb, s["exec_bar"][..., None], 0)
+
+        # HB_REPLY: to the heartbeat sender
+        do_hbr = hb_reply_to[..., None] & dst_onehot(hb_src, R) & ns_mask
+        oflags = oflags | jnp.where(do_hbr, jnp.uint32(HB_REPLY), 0)
+        out["hbr_ebar"] = jnp.where(do_hbr, s["exec_bar"][..., None], 0)
+
+        # ACCEPT_REPLY: follower acks its durable frontier to its leader
+        is_follower = (
+            (s["leader"] >= 0)
+            & (s["leader"] != rid)
+            & (s["vote_bal"] == s["bal_max"])
+            & (s["vote_bal"] > 0)
+        )
+        do_ar = is_follower[..., None] & dst_onehot(s["leader"], R) & ns_mask
+        oflags = oflags | jnp.where(do_ar, jnp.uint32(ACCEPT_REPLY), 0)
+        out["ar_bal"] = jnp.where(do_ar, s["vote_bal"][..., None], 0)
+        out["ar_from"] = jnp.where(do_ar, s["vote_from"][..., None], 0)
+        out["ar_f"] = jnp.where(do_ar, s["dur_bar"][..., None], 0)
+        do_nack = do_ar & nack[..., None]
+        oflags = oflags | jnp.where(do_nack, jnp.uint32(AR_NACK), 0)
+        out["ar_hint"] = jnp.where(do_nack, nack_hint[..., None], 0)
+
+        # PREPARE: candidates campaign every tick (loss-tolerant)
+        do_prp = candidate[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_prp, jnp.uint32(PREPARE), 0)
+        out["prp_bal"] = jnp.where(do_prp, s["bal_prep_sent"][..., None], 0)
+        out["prp_trigger"] = jnp.where(do_prp, s["prep_trigger"][..., None], 0)
+
+        # PREPARE_REPLY: to the campaigner we just promised
+        do_prr = p_ok[..., None] & dst_onehot(p_src, R) & ns_mask
+        oflags = oflags | jnp.where(do_prr, jnp.uint32(PREPARE_REPLY), 0)
+        out["prr_bal"] = jnp.where(do_prr, p_bal[..., None], 0)
+        out["prr_hi"] = jnp.where(do_prr, prr_hi_out[..., None], 0)
+
+        # broadcast window lanes: voted log content (consumed by both
+        # ACCEPT receivers and PREPARE_REPLY adopters)
+        out["bw_abs"] = s["win_abs"]
+        out["bw_bal"] = s["win_bal"]
+        out["bw_val"] = s["win_val"]
+        out["flags"] = oflags
+
+        fx = StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": jnp.max(n_new, axis=1),
+                "is_leader": active_leader,
+                "bal_max": s["bal_max"],
+            },
+        )
+        return s, out, fx

@@ -1,17 +1,23 @@
-"""Test config: force an 8-device virtual CPU platform before jax imports.
+"""Test config: force a hermetic 8-device virtual CPU platform.
 
-Multi-chip sharding (mesh over group/replica axes) is exercised on a virtual
-8-device CPU mesh, per the driver contract; real-TPU runs happen in bench.py.
+The environment injects an axon TPU site hook (via PYTHONPATH
+sitecustomize) that imports jax at interpreter startup with
+JAX_PLATFORMS=axon; first use of that backend dials the TPU tunnel.  Env
+vars are therefore too late here — but no *backend* has been initialized
+yet when conftest loads, so flipping the jax config programmatically pins
+the whole test session to 8 virtual CPU devices, immune to TPU tunnel
+state.
+
+Multi-chip sharding (mesh over group/replica axes) is exercised on the
+virtual CPU mesh per the driver contract; real-TPU runs happen in bench.py.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
