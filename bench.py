@@ -1,0 +1,74 @@
+"""Headline benchmark: batched MultiPaxos commit throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.json north star): >= 10M committed log slots/sec across
+4096 five-replica MultiPaxos groups on a TPU v5e-8; this runs on however
+many chips are visible (one under the axon tunnel) and reports per-run
+throughput, with vs_baseline = value / 10e6.
+
+The workload mirrors the reference's open-loop bench client at unlimited
+frequency (summerset_client/src/clients/bench.rs) with the host I/O plane
+detached: every tick each group is offered `P` new commands; the measured
+quantity is committed consensus slots (quorum-replicated, in-order) per
+wall-clock second.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from summerset_tpu.core import Engine
+from summerset_tpu.protocols import make_protocol
+from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
+
+GROUPS = 4096
+POPULATION = 5
+WINDOW = 64
+PROPOSALS_PER_TICK = 16
+WARMUP_TICKS = 64
+TICKS = 2048
+BASELINE = 10_000_000.0
+
+
+def main():
+    cfg = ReplicaConfigMultiPaxos(
+        max_proposals_per_tick=PROPOSALS_PER_TICK,
+        chunk_size=PROPOSALS_PER_TICK * 2,
+    )
+    kernel = make_protocol("multipaxos", GROUPS, POPULATION, WINDOW, cfg)
+    eng = Engine(kernel)
+    state, ns = eng.init()
+
+    # warmup: compile + reach steady state
+    state, ns = eng.run_synthetic(state, ns, WARMUP_TICKS, PROPOSALS_PER_TICK)
+    start = np.asarray(state["commit_bar"]).max(axis=1).sum()
+
+    t0 = time.perf_counter()
+    state, ns = eng.run_synthetic(state, ns, TICKS, PROPOSALS_PER_TICK)
+    jax.block_until_ready(state["commit_bar"])
+    dt = time.perf_counter() - t0
+
+    end = np.asarray(state["commit_bar"]).max(axis=1).sum()
+    committed = float(end - start)
+    rate = committed / dt
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"committed slots/sec, MultiPaxos {POPULATION}-replica x "
+                    f"{GROUPS} groups, 1 chip ({jax.devices()[0].platform})"
+                ),
+                "value": round(rate, 1),
+                "unit": "slots/sec",
+                "vs_baseline": round(rate / BASELINE, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -110,6 +110,21 @@ def _tick(
             return jnp.where(m, new, old)
 
         new_state = jax.tree.map(freeze, new_state, state)
+
+        # effects must reflect the freeze: bars mirror the frozen state and
+        # per-replica event extras are zeroed (a paused replica has no
+        # events this tick)
+        def mask_extra(leaf):
+            if leaf.ndim >= 2 and leaf.shape[:2] == alive.shape:
+                m = alive.reshape(alive.shape + (1,) * (leaf.ndim - 2))
+                return jnp.where(m, leaf, jnp.zeros_like(leaf))
+            return leaf
+
+        fx = StepEffects(
+            commit_bar=new_state["commit_bar"],
+            exec_bar=new_state["exec_bar"],
+            extra={k: mask_extra(v) for k, v in fx.extra.items()},
+        )
     netstate = net.push(netstate, outbox, ctrl)
     return new_state, netstate, fx
 

@@ -9,7 +9,9 @@ struct-of-arrays pytree for ``[num_groups, population]`` replicas, and
 
 Design rules (required for masking / sharding to work uniformly):
 
-- every state leaf has leading dims ``[G, R]`` (group, replica);
+- every state leaf has leading dims ``[G, R]`` (group, replica), and the
+  state dict must contain int32 ``commit_bar``/``exec_bar`` leaves (the
+  engine mirrors them into effects when masking paused replicas);
 - every outbox leaf is either a per-directed-pair field ``[G, R_src, R_dst]``
   (delivered transposed to ``[G, R_dst, R_src]``) or a broadcast window lane
   ``[G, R_src, W]`` named in ``broadcast_lanes`` (delivered as-is; receivers

@@ -264,10 +264,22 @@ class MultiPaxosKernel(ProtocolKernel):
         s["hb_cnt"] = jnp.where(a_ok, reload, s["hb_cnt"])
 
         same_run = a_ok & (s["vote_bal"] == a_bal)
-        new_run = a_ok & (s["vote_bal"] != a_bal)
+        # a range entirely below the current run (leader backfilling after a
+        # NACK rewind in chunks smaller than the hole) RESETS the run to it:
+        # shrinking the claimed frontier is always safe, and the following
+        # chunks re-merge up to the old frontier
+        run_reset = (a_ok & (s["vote_bal"] != a_bal)) | (
+            same_run & (a_hi < s["vote_from"])
+        )
         # same-ballot: contiguity with the run (overlap or adjacency)
-        run_merge = same_run & (a_lo <= s["vote_bar"]) & (a_hi >= s["vote_from"])
-        gap = same_run & (a_lo > s["vote_bar"])
+        run_merge = (
+            same_run
+            & (a_lo <= s["vote_bar"])
+            & (a_hi >= s["vote_from"])
+            & ~run_reset
+        )
+        gap = same_run & (a_lo > s["vote_bar"]) & ~run_reset
+        new_run = run_reset
         apply_rng = run_merge | new_run
 
         # window writes for the applied range, values from the sender's lane
@@ -359,7 +371,13 @@ class MultiPaxosKernel(ProtocolKernel):
         # =========== 7. election timeout -> campaign
         active_leader = i_am_leader & (s["leader"] == rid)
         s["hb_cnt"] = jnp.where(active_leader, s["hb_cnt"], s["hb_cnt"] - 1)
-        explode = (~active_leader) & (s["hb_cnt"] <= 0)
+        # a replica whose voted tail spans more than the window past its
+        # commit bar cannot safely lead (it would have to re-propose slots
+        # it cannot hold) — it skips candidacy without inflating its ballot,
+        # staying receptive to the current leader's backfill/snapshot heal
+        viable = voted_extent - s["commit_bar"] <= W
+        explode = (~active_leader) & (s["hb_cnt"] <= 0) & viable
+        timer_out = (~active_leader) & (s["hb_cnt"] <= 0)
         new_bal = make_greater_ballot(s["bal_max"], rid)
         s["bal_max"] = jnp.where(explode, new_bal, s["bal_max"])
         s["bal_prep_sent"] = jnp.where(explode, new_bal, s["bal_prep_sent"])
@@ -374,7 +392,7 @@ class MultiPaxosKernel(ProtocolKernel):
         s["rng"], reload2 = prng.uniform_int(
             s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
         )
-        s["hb_cnt"] = jnp.where(explode, reload2, s["hb_cnt"])
+        s["hb_cnt"] = jnp.where(timer_out, reload2, s["hb_cnt"])
         candidate = (candidate | explode) & (
             s["bal_prep_sent"] == s["bal_max"]
         )
@@ -564,13 +582,23 @@ class MultiPaxosKernel(ProtocolKernel):
         out["bw_val"] = s["win_val"]
         out["flags"] = oflags
 
+        # conservative min-exec over the group (the reference's snap_bar,
+        # mod.rs:470-478): the host WAL/payload store may GC below it —
+        # every replica has executed those slots
+        eye_max = jnp.where(
+            jnp.eye(R, dtype=jnp.bool_)[None],
+            jnp.iinfo(jnp.int32).max,
+            s["peer_exec"],
+        )
+        snap_bar = jnp.minimum(jnp.min(eye_max, axis=2), s["exec_bar"])
+
         fx = StepEffects(
             commit_bar=s["commit_bar"],
             exec_bar=s["exec_bar"],
             extra={
-                "n_accepted": jnp.max(n_new, axis=1),
+                "n_accepted": n_new,  # per [G, R]; engine masks paused rows
                 "is_leader": active_leader,
-                "bal_max": s["bal_max"],
+                "snap_bar": snap_bar,
             },
         )
         return s, out, fx
