@@ -41,3 +41,4 @@ def make_protocol(name: str, *args, **kwargs) -> ProtocolKernel:
 
 # import protocol modules for registration side effects
 from . import multipaxos  # noqa: E402,F401
+from . import raft  # noqa: E402,F401

@@ -1,0 +1,655 @@
+"""Vectorized Raft: batched term-based SMR stepped in lockstep.
+
+Parity target: reference ``src/protocols/raft/`` (SURVEY.md §2.5) — ATC'14
+Raft with terms, roles Follower/Candidate/Leader (``raft/mod.rs:237-253``),
+``AppendEntries``/``RequestVote`` with conflict-index backtracking
+(``PeerMsg``, ``raft/mod.rs:203-235``), durable ``curr_term``/``voted_for``
+metadata (``raft/mod.rs:144-176``), log-matching recovery, and snapshotting
+with log discard (``raft/snapshot.rs``).
+
+TPU-first redesign (NOT a port of the tokio event loop):
+
+- State is struct-of-arrays over ``[G groups, R replicas]`` with a ``W``-slot
+  ring log window (``win_abs/win_term/win_val``).  Values are int32
+  references into a host-side payload store, same as the MultiPaxos kernel.
+- **AppendEntries is a per-peer go-back-N range stream**: the leader keeps a
+  ``next_idx`` cursor per peer and sends ``[lo, hi)`` chunks with the term of
+  entry ``lo-1`` (``prev_log_term``); the follower's prev-check certifies the
+  whole prefix via the Log Matching Property, so its certified frontier
+  ``match_bar`` jumps to ``hi`` without run-contiguity bookkeeping.  A prev
+  mismatch NACKs with a rewind hint (conflict backtracking,
+  ``raft/messages.rs`` conflict-index reply): hint = own ``log_end`` when the
+  range starts past the log, else own ``commit_bar`` (committed prefix
+  matches any leader by Leader Completeness — one-shot rewind instead of the
+  reference's per-term walk).
+- **Elections**: randomized per-replica countdowns; a candidate bumps its
+  term, votes for itself, and re-broadcasts RequestVote every tick (loss
+  tolerance); voters grant at most one vote per term, gated on the
+  up-to-date check ``(last_term, log_end)``; quorum grants -> leader.
+- **Commit rule**: k-th-largest over durably-acked match frontiers, allowed
+  only once at least one *current-term* entry is replicated
+  (``q > own_from`` where ``own_from`` = log length at election) — the
+  vectorized form of Raft's commit-only-current-term rule (Fig. 8 safety).
+- Heartbeat = empty AppendEntries carrying ``leader_commit`` (the reference
+  separates a heartbeat module; Raft folds them naturally).
+- Followers too far behind the leader's ring window receive an
+  install-snapshot jump (``SNAPSHOT``), the analog of the reference's
+  snapshot transfer; the snapshot body itself lives host-side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..core.protocol import ProtocolKernel, StepEffects
+from ..ops import prng
+from ..utils.bitmap import popcount
+from . import register_protocol
+from .common import (
+    NO_SLOT,
+    NULL_VAL,
+    best_by_ballot,
+    dst_onehot,
+    kth_largest,
+    not_self,
+    range_cover,
+    take_lane,
+    take_src,
+)
+
+# message flag bits
+AE = 1            # AppendEntries (empty range = heartbeat)
+AE_REPLY = 2
+AR_NACK = 4       # modifier on AE_REPLY: prev-check failed; rewind to hint
+REQVOTE = 8
+VOTE_REPLY = 16
+VOTE_GRANT = 32   # modifier on VOTE_REPLY
+SNAPSHOT = 64     # install-snapshot: jump a >window-behind follower forward
+
+
+@dataclasses.dataclass
+class ReplicaConfigRaft:
+    """Static per-run knobs (parity: ``ReplicaConfigRaft``,
+    ``raft/mod.rs:46-97``, re-expressed in ticks)."""
+
+    max_proposals_per_tick: int = 16    # client batch intake per group/tick
+    chunk_size: int = 64                # max AE slots per peer per tick
+    hb_send_interval: int = 1           # leader heartbeat period (ticks)
+    hear_timeout_lo: int = 30           # election timeout jitter range
+    hear_timeout_hi: int = 60
+    retry_interval: int = 8             # go-back-N resend countdown
+    dur_lag: int = 0                    # WAL ack lag (0 = instant durability)
+    exec_follows_commit: bool = True    # device-only mode: exec == commit
+    init_leader: int = 0                # warm-start leader id; -1 = cold elect
+
+
+def _gather_slot(win_abs, win_field, slot):
+    """Look up ``slot`` in a ring window: ``[..., W]`` at ``[...]`` int32.
+
+    Returns ``(ok, value)`` where ok = the window currently holds that
+    absolute slot.  Negative slots never match (``win_abs`` init is -1 but
+    position ``W-1`` could hold a real slot; the explicit ``slot >= 0`` guard
+    covers it).
+    """
+    W = win_abs.shape[-1]
+    pos = slot % W
+    a = jnp.take_along_axis(win_abs, pos[..., None], axis=-1)[..., 0]
+    v = jnp.take_along_axis(win_field, pos[..., None], axis=-1)[..., 0]
+    return (a == slot) & (slot >= 0), v
+
+
+@register_protocol("Raft")
+class RaftKernel(ProtocolKernel):
+    broadcast_lanes = frozenset({"bw_abs", "bw_term", "bw_val"})
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigRaft | None = None,
+    ):
+        super().__init__(num_groups, population, window)
+        self.config = config or ReplicaConfigRaft()
+        if self.config.max_proposals_per_tick > window // 2:
+            raise ValueError("max_proposals_per_tick must be <= window/2")
+        self._chunk = min(self.config.chunk_size, window)
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, seed: int = 0):
+        G, R = self.G, self.R
+        W = self.W
+        cfg = self.config
+        i32 = jnp.int32
+        zeros = lambda *shape: jnp.zeros(shape, i32)  # noqa: E731
+        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+
+        rng = prng.seed_state(seed, (G, R))
+        rng, hb_cnt = prng.uniform_int(
+            rng, cfg.hear_timeout_lo, cfg.hear_timeout_hi
+        )
+
+        st = {
+            "term": zeros(G, R),
+            "voted_for": jnp.full((G, R), -1, i32),
+            "cand_term": jnp.full((G, R), -1, i32),
+            "grants": jnp.zeros((G, R), jnp.uint32),
+            "is_leader": jnp.zeros((G, R), jnp.bool_),
+            "leader": jnp.full((G, R), -1, i32),
+            "own_from": zeros(G, R),
+            "log_end": zeros(G, R),
+            "last_term": zeros(G, R),
+            "match_bar": zeros(G, R),
+            "commit_bar": zeros(G, R),
+            "exec_bar": zeros(G, R),
+            "dur_bar": zeros(G, R),
+            "hb_cnt": hb_cnt,
+            "hb_send_cnt": zeros(G, R),
+            "rng": rng,
+            "next_idx": zeros(G, R, R),
+            "match_f": zeros(G, R, R),
+            "retry_cnt": jnp.full((G, R, R), cfg.retry_interval, i32),
+            "peer_exec": zeros(G, R, R),
+            "win_abs": jnp.full((G, R, W), NO_SLOT, i32),
+            "win_term": zeros(G, R, W),
+            "win_val": jnp.full((G, R, W), 0, i32),
+        }
+
+        if cfg.init_leader >= 0:
+            L = cfg.init_leader
+            is_l = rid == L
+            st["term"] = jnp.ones((G, R), i32)
+            st["voted_for"] = jnp.full((G, R), L, i32)
+            st["is_leader"] = is_l
+            st["leader"] = jnp.full((G, R), L, i32)
+        return st
+
+    # ---------------------------------------------------------------- outbox
+    def zero_outbox(self):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        return {
+            "flags": jnp.zeros((G, R, R), jnp.uint32),
+            "ae_term": pair(), "ae_lo": pair(), "ae_hi": pair(),
+            "ae_prev": pair(), "ae_cbar": pair(),
+            "ar_term": pair(), "ar_f": pair(), "ar_hint": pair(),
+            "ar_ebar": pair(),
+            "rv_term": pair(), "rv_lidx": pair(), "rv_lterm": pair(),
+            "vr_term": pair(),
+            "snp_term": pair(), "snp_to": pair(), "snp_lterm": pair(),
+            "bw_abs": jnp.zeros((G, R, W), i32),
+            "bw_term": jnp.zeros((G, R, W), i32),
+            "bw_val": jnp.zeros((G, R, W), i32),
+        }
+
+    # ------------------------------------------------------------------ step
+    def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
+        i32 = jnp.int32
+        s = dict(state)
+        flags = inbox["flags"]
+        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+        src_bits = (jnp.uint32(1) << jnp.arange(R, dtype=jnp.uint32))[
+            None, None, :
+        ]
+
+        def best_by(bit, field):
+            return best_by_ballot(flags, bit, field)
+
+        s["rng"], reload = prng.uniform_int(
+            s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
+        )
+
+        # =========== 1. REQVOTE ingest (vote granting; may bump term)
+        rv_ok, rv_term, rv_src = best_by(REQVOTE, inbox["rv_term"])
+        higher = rv_ok & (rv_term > s["term"])
+        s["voted_for"] = jnp.where(higher, -1, s["voted_for"])
+        s["is_leader"] &= ~higher
+        s["cand_term"] = jnp.where(higher, -1, s["cand_term"])
+        s["term"] = jnp.where(higher, rv_term, s["term"])
+        # any term change invalidates the per-(leader, term) certification
+        # behind match_bar; commit_bar is the safe floor (Leader Completeness)
+        s["match_bar"] = jnp.where(higher, s["commit_bar"], s["match_bar"])
+        rv_lidx = take_src(inbox["rv_lidx"], rv_src)
+        rv_lterm = take_src(inbox["rv_lterm"], rv_src)
+        uptodate = (rv_lterm > s["last_term"]) | (
+            (rv_lterm == s["last_term"]) & (rv_lidx >= s["log_end"])
+        )
+        can_vote = (
+            rv_ok
+            & (rv_term == s["term"])
+            & ((s["voted_for"] < 0) | (s["voted_for"] == rv_src))
+            & uptodate
+            & ~s["is_leader"]
+        )
+        s["voted_for"] = jnp.where(can_vote, rv_src, s["voted_for"])
+        s["hb_cnt"] = jnp.where(can_vote, reload, s["hb_cnt"])
+
+        # =========== 2. VOTE_REPLY ingest (candidate tally)
+        vr_valid = (flags & VOTE_REPLY) != 0
+        vr_grant = (
+            vr_valid
+            & ((flags & VOTE_GRANT) != 0)
+            & (inbox["vr_term"] == s["term"][..., None])
+        )
+        s["grants"] = s["grants"] | jnp.where(
+            vr_grant, src_bits, jnp.uint32(0)
+        ).sum(axis=2, dtype=jnp.uint32)
+
+        # =========== 3. AE ingest (prev-check, entry write, commit notice)
+        a_ok, a_term, a_src = best_by(AE, inbox["ae_term"])
+        a_ok &= a_term >= s["term"]
+        # a leader never yields to an equal-term AE (impossible by election
+        # safety); a candidate at the same term steps down to the winner
+        a_ok &= (a_term > s["term"]) | ~s["is_leader"]
+        old_term = s["term"]
+        newterm = a_ok & (a_term > old_term)
+        s["voted_for"] = jnp.where(newterm, -1, s["voted_for"])
+        s["term"] = jnp.where(a_ok, a_term, s["term"])
+        # certified-match frontier resets to the committed prefix whenever
+        # the (leader, term) authority changes (Leader Completeness makes
+        # commit_bar a safe floor under any future leader)
+        s["match_bar"] = jnp.where(
+            a_ok & (newterm | (s["leader"] != a_src)),
+            s["commit_bar"],
+            s["match_bar"],
+        )
+        s["is_leader"] &= ~a_ok
+        s["cand_term"] = jnp.where(a_ok, -1, s["cand_term"])
+        s["leader"] = jnp.where(a_ok, a_src, s["leader"])
+        s["hb_cnt"] = jnp.where(a_ok, reload, s["hb_cnt"])
+
+        a_lo = take_src(inbox["ae_lo"], a_src)
+        a_hi = take_src(inbox["ae_hi"], a_src)
+        a_prev = take_src(inbox["ae_prev"], a_src)
+        a_cbar = take_src(inbox["ae_cbar"], a_src)
+
+        prev_in_win, own_pterm = _gather_slot(
+            s["win_abs"], s["win_term"], a_lo - 1
+        )
+        prev_ok = (
+            (a_lo <= s["commit_bar"])
+            | (prev_in_win & (own_pterm == a_prev) & (a_lo - 1 < s["log_end"]))
+        )
+        gap = a_ok & (a_lo > s["log_end"])
+        acc = a_ok & ~gap & prev_ok
+        rej = a_ok & ~gap & ~prev_ok
+        nack = gap | rej
+        # conflict backtrack hint: log_end for past-the-end, commit_bar for
+        # term mismatch (one-shot rewind; the committed prefix always matches)
+        nack_hint = jnp.where(gap, s["log_end"], s["commit_bar"])
+
+        m_acc, abs_acc = range_cover(a_lo, a_hi, W)
+        m_acc &= acc[..., None]
+        lane_term = take_lane(inbox["bw_term"], a_src)
+        lane_val = take_lane(inbox["bw_val"], a_src)
+        conflict = (
+            m_acc
+            & (s["win_abs"] == abs_acc)
+            & (s["win_term"] != lane_term)
+            & (abs_acc < s["log_end"][..., None])
+        )
+        any_conflict = conflict.any(axis=2)
+        s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
+        s["win_term"] = jnp.where(m_acc, lane_term, s["win_term"])
+        s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
+        # Raft truncation rule: a conflicting entry and all that follow are
+        # deleted; the written range replaces them, so log_end = hi on
+        # conflict, else extend-only
+        s["log_end"] = jnp.where(
+            acc,
+            jnp.where(
+                any_conflict, a_hi, jnp.maximum(s["log_end"], a_hi)
+            ),
+            s["log_end"],
+        )
+        s["dur_bar"] = jnp.minimum(s["dur_bar"], s["log_end"])
+        s["match_bar"] = jnp.where(
+            acc, jnp.maximum(s["match_bar"], a_hi), s["match_bar"]
+        )
+        s["commit_bar"] = jnp.where(
+            acc,
+            jnp.maximum(
+                s["commit_bar"], jnp.minimum(a_cbar, s["match_bar"])
+            ),
+            s["commit_bar"],
+        )
+        lt_ok, lt_term = _gather_slot(
+            s["win_abs"], s["win_term"], s["log_end"] - 1
+        )
+        s["last_term"] = jnp.where(
+            acc,
+            jnp.where(
+                s["log_end"] > 0,
+                jnp.where(lt_ok, lt_term, s["last_term"]),
+                0,
+            ),
+            s["last_term"],
+        )
+
+        # =========== 3b. SNAPSHOT ingest (install: jump forward)
+        sn_ok, sn_term, sn_src = best_by(SNAPSHOT, inbox["snp_term"])
+        sn_ok &= sn_term >= s["term"]
+        sn_ok &= (sn_term > s["term"]) | ~s["is_leader"]
+        sn_to = take_src(inbox["snp_to"], sn_src)
+        sn_lterm = take_src(inbox["snp_lterm"], sn_src)
+        sn_new = sn_ok & (sn_term > s["term"])
+        s["voted_for"] = jnp.where(sn_new, -1, s["voted_for"])
+        # authority change without install (sn_to <= commit_bar) still
+        # invalidates match_bar certification
+        s["match_bar"] = jnp.where(
+            sn_ok & (sn_new | (s["leader"] != sn_src)),
+            s["commit_bar"],
+            s["match_bar"],
+        )
+        s["term"] = jnp.where(sn_ok, sn_term, s["term"])
+        s["is_leader"] &= ~sn_ok
+        s["cand_term"] = jnp.where(sn_ok, -1, s["cand_term"])
+        s["leader"] = jnp.where(sn_ok, sn_src, s["leader"])
+        s["hb_cnt"] = jnp.where(sn_ok, reload, s["hb_cnt"])
+        sn_adv = sn_ok & (sn_to > s["commit_bar"])
+        s["commit_bar"] = jnp.where(sn_adv, sn_to, s["commit_bar"])
+        s["exec_bar"] = jnp.where(
+            sn_adv, jnp.maximum(s["exec_bar"], sn_to), s["exec_bar"]
+        )
+        s["log_end"] = jnp.where(
+            sn_adv, jnp.maximum(s["log_end"], sn_to), s["log_end"]
+        )
+        s["match_bar"] = jnp.where(sn_adv, sn_to, s["match_bar"])
+        s["dur_bar"] = jnp.where(
+            sn_adv, jnp.maximum(s["dur_bar"], sn_to), s["dur_bar"]
+        )
+        s["last_term"] = jnp.where(
+            sn_adv & (s["log_end"] == sn_to), sn_lterm, s["last_term"]
+        )
+        stale_win = sn_adv[..., None] & (s["win_abs"] < sn_to[..., None])
+        s["win_abs"] = jnp.where(stale_win, NO_SLOT, s["win_abs"])
+        s["win_term"] = jnp.where(stale_win, 0, s["win_term"])
+
+        # =========== 4. AE_REPLY ingest (leader match bookkeeping)
+        ar_valid = (flags & AE_REPLY) != 0
+        ar_mine = (
+            ar_valid
+            & (inbox["ar_term"] == s["term"][..., None])
+            & s["is_leader"][..., None]
+        )
+        prog = ar_mine & (inbox["ar_f"] > s["match_f"])
+        s["match_f"] = jnp.where(
+            ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"]
+        )
+        ar_nacked = ar_mine & ((flags & AR_NACK) != 0)
+        s["next_idx"] = jnp.where(
+            ar_nacked,
+            jnp.minimum(s["next_idx"], inbox["ar_hint"]),
+            s["next_idx"],
+        )
+        s["retry_cnt"] = jnp.where(
+            prog | ar_nacked, cfg.retry_interval, s["retry_cnt"]
+        )
+        s["peer_exec"] = jnp.where(
+            ar_valid,
+            jnp.maximum(s["peer_exec"], inbox["ar_ebar"]),
+            s["peer_exec"],
+        )
+
+        # higher terms piggybacked on replies force step-down
+        reply_tmax = jnp.maximum(
+            jnp.max(jnp.where(vr_valid, inbox["vr_term"], 0), axis=2),
+            jnp.max(jnp.where(ar_valid, inbox["ar_term"], 0), axis=2),
+        )
+        stepdown = reply_tmax > s["term"]
+        s["term"] = jnp.where(stepdown, reply_tmax, s["term"])
+        s["voted_for"] = jnp.where(stepdown, -1, s["voted_for"])
+        s["is_leader"] &= ~stepdown
+        s["cand_term"] = jnp.where(stepdown, -1, s["cand_term"])
+        s["match_bar"] = jnp.where(stepdown, s["commit_bar"], s["match_bar"])
+
+        # =========== 5. election timeout -> campaign
+        s["hb_cnt"] = jnp.where(
+            s["is_leader"], s["hb_cnt"], s["hb_cnt"] - 1
+        )
+        # viability guard (cf. multipaxos `viable`): a replica whose log tail
+        # already fills its ring window could never append the current-term
+        # entry the commit rule needs (space stays 0) — it skips candidacy
+        # without inflating its term, staying receptive to a heal
+        viable = s["log_end"] - s["exec_bar"] < W
+        timer_out = ~s["is_leader"] & (s["hb_cnt"] <= 0)
+        explode = timer_out & viable
+        s["term"] = jnp.where(explode, s["term"] + 1, s["term"])
+        s["match_bar"] = jnp.where(explode, s["commit_bar"], s["match_bar"])
+        s["voted_for"] = jnp.where(explode, rid, s["voted_for"])
+        s["cand_term"] = jnp.where(explode, s["term"], s["cand_term"])
+        s["grants"] = jnp.where(
+            explode,
+            jnp.uint32(1) << rid.astype(jnp.uint32),
+            s["grants"],
+        )
+        s["leader"] = jnp.where(explode, -1, s["leader"])
+        s["rng"], reload2 = prng.uniform_int(
+            s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
+        )
+        s["hb_cnt"] = jnp.where(timer_out, reload2, s["hb_cnt"])
+        candidate = ~s["is_leader"] & (s["cand_term"] == s["term"])
+
+        # =========== 6. candidate -> leader on vote quorum
+        win = candidate & (popcount(s["grants"]) >= self.quorum)
+        s["is_leader"] |= win
+        s["leader"] = jnp.where(win, rid, s["leader"])
+        s["own_from"] = jnp.where(win, s["log_end"], s["own_from"])
+        s["match_bar"] = jnp.where(win, s["log_end"], s["match_bar"])
+        s["next_idx"] = jnp.where(
+            win[..., None], s["log_end"][..., None], s["next_idx"]
+        )
+        s["match_f"] = jnp.where(win[..., None], 0, s["match_f"])
+        s["retry_cnt"] = jnp.where(
+            win[..., None], cfg.retry_interval, s["retry_cnt"]
+        )
+        s["hb_send_cnt"] = jnp.where(win, 0, s["hb_send_cnt"])
+        candidate &= ~win
+
+        # =========== 7. leader appends: term no-op, then client proposals
+        lead = s["is_leader"]
+        space = jnp.maximum(s["exec_bar"] + W - s["log_end"], 0)
+        # current-term no-op: a fresh leader with an uncommitted predecessor
+        # tail appends one no-op so the commit rule (q_f > own_from) can fire
+        # even with zero client load (standard Raft practice; the reference
+        # instead relies on incoming client traffic)
+        need_noop = (
+            lead
+            & (s["log_end"] == s["own_from"])
+            & (s["commit_bar"] < s["log_end"])
+            & (space > 0)
+        )
+        n_noop = need_noop.astype(i32)
+        m_np, abs_np = range_cover(s["log_end"], s["log_end"] + n_noop, W)
+        s["win_abs"] = jnp.where(m_np, abs_np, s["win_abs"])
+        s["win_term"] = jnp.where(m_np, s["term"][..., None], s["win_term"])
+        s["win_val"] = jnp.where(m_np, NULL_VAL, s["win_val"])
+        s["log_end"] = s["log_end"] + n_noop
+        s["last_term"] = jnp.where(need_noop, s["term"], s["last_term"])
+        space = space - n_noop
+        n_prop = jnp.broadcast_to(
+            inputs["n_proposals"][:, None].astype(i32), (G, R)
+        )
+        n_new = jnp.where(
+            lead,
+            jnp.minimum(
+                jnp.minimum(n_prop, space), cfg.max_proposals_per_tick
+            ),
+            0,
+        )
+        vbase = jnp.broadcast_to(
+            inputs["value_base"][:, None].astype(i32), (G, R)
+        )
+        m_new, abs_new = range_cover(
+            s["log_end"], s["log_end"] + n_new, W
+        )
+        new_vals = vbase[..., None] + (abs_new - s["log_end"][..., None])
+        s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
+        s["win_term"] = jnp.where(m_new, s["term"][..., None], s["win_term"])
+        s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
+        s["log_end"] = s["log_end"] + n_new
+        s["last_term"] = jnp.where(n_new > 0, s["term"], s["last_term"])
+        s["match_bar"] = jnp.where(lead, s["log_end"], s["match_bar"])
+
+        # =========== 8. durability + leader commit tally + exec
+        if cfg.dur_lag > 0:
+            s["dur_bar"] = jnp.minimum(
+                s["log_end"], s["dur_bar"] + cfg.dur_lag
+            )
+        else:
+            s["dur_bar"] = s["log_end"]
+
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        peer_f = jnp.where(eye, s["dur_bar"][..., None], s["match_f"])
+        q_f = kth_largest(peer_f, self.quorum)
+        # commit-only-current-term: at least one own-term entry replicated
+        can_commit = lead & (q_f > s["own_from"])
+        s["commit_bar"] = jnp.where(
+            can_commit,
+            jnp.clip(q_f, s["commit_bar"], s["log_end"]),
+            s["commit_bar"],
+        )
+
+        if cfg.exec_follows_commit:
+            s["exec_bar"] = s["commit_bar"]
+        else:
+            s["exec_bar"] = jnp.maximum(
+                s["exec_bar"],
+                jnp.minimum(
+                    s["commit_bar"], inputs["exec_floor"].astype(i32)
+                ),
+            )
+
+        # =========== 9. build outbox
+        out = self.zero_outbox()
+        oflags = out["flags"]
+        ns_mask = not_self(G, R)
+
+        # AE streams: go-back-N with retry rewind
+        stale = (
+            lead[..., None] & ns_mask & (s["next_idx"] > s["match_f"])
+        )
+        s["retry_cnt"] = jnp.where(
+            stale, s["retry_cnt"] - 1, cfg.retry_interval
+        )
+        rewind = stale & (s["retry_cnt"] <= 0)
+        s["next_idx"] = jnp.where(rewind, s["match_f"], s["next_idx"])
+        s["retry_cnt"] = jnp.where(
+            rewind, cfg.retry_interval, s["retry_cnt"]
+        )
+
+        # peers fallen below the ring window get an install-snapshot jump
+        too_behind = (
+            lead[..., None]
+            & ns_mask
+            & (s["next_idx"] < (s["log_end"] - W)[..., None])
+        )
+        snap_lt_ok, snap_lterm = _gather_slot(
+            s["win_abs"], s["win_term"], s["exec_bar"] - 1
+        )
+        oflags = oflags | jnp.where(too_behind, jnp.uint32(SNAPSHOT), 0)
+        out["snp_term"] = jnp.where(too_behind, s["term"][..., None], 0)
+        out["snp_to"] = jnp.where(too_behind, s["exec_bar"][..., None], 0)
+        out["snp_lterm"] = jnp.where(
+            too_behind,
+            jnp.where(snap_lt_ok, snap_lterm, s["last_term"])[..., None],
+            0,
+        )
+        s["next_idx"] = jnp.where(
+            too_behind, s["exec_bar"][..., None], s["next_idx"]
+        )
+
+        # heartbeat cadence: empty AE when nothing to replicate
+        s["hb_send_cnt"] = jnp.where(
+            lead, s["hb_send_cnt"] - 1, cfg.hb_send_interval
+        )
+        hb_fire = lead & (s["hb_send_cnt"] <= 0)
+        s["hb_send_cnt"] = jnp.where(
+            hb_fire, cfg.hb_send_interval, s["hb_send_cnt"]
+        )
+
+        snd_lo = s["next_idx"]
+        snd_hi = jnp.minimum(s["log_end"][..., None], snd_lo + self._chunk)
+        have_data = snd_hi > snd_lo
+        do_ae = (
+            lead[..., None]
+            & ns_mask
+            & (have_data | hb_fire[..., None])
+            & ~too_behind
+        )
+        snd_hi = jnp.maximum(snd_hi, snd_lo)  # empty heartbeat: hi == lo
+        # prev_log_term at lo-1 from own window (always in-window because
+        # too_behind peers were snapshotted past this branch)
+        prev_ok_l, prev_t = _gather_slot(
+            jnp.broadcast_to(s["win_abs"][:, :, None, :], (G, R, R, W)),
+            jnp.broadcast_to(s["win_term"][:, :, None, :], (G, R, R, W)),
+            snd_lo - 1,
+        )
+        oflags = oflags | jnp.where(do_ae, jnp.uint32(AE), 0)
+        out["ae_term"] = jnp.where(do_ae, s["term"][..., None], 0)
+        out["ae_lo"] = jnp.where(do_ae, snd_lo, 0)
+        out["ae_hi"] = jnp.where(do_ae, snd_hi, 0)
+        out["ae_prev"] = jnp.where(do_ae & prev_ok_l, prev_t, 0)
+        out["ae_cbar"] = jnp.where(do_ae, s["commit_bar"][..., None], 0)
+        s["next_idx"] = jnp.where(do_ae, snd_hi, s["next_idx"])
+
+        # AE_REPLY: follower acks its durable certified frontier
+        is_follower = (
+            (s["leader"] >= 0) & (s["leader"] != rid) & ~s["is_leader"]
+        )
+        do_ar = is_follower[..., None] & dst_onehot(s["leader"], R) & ns_mask
+        oflags = oflags | jnp.where(do_ar, jnp.uint32(AE_REPLY), 0)
+        out["ar_term"] = jnp.where(do_ar, s["term"][..., None], 0)
+        out["ar_f"] = jnp.where(
+            do_ar,
+            jnp.minimum(s["match_bar"], s["dur_bar"])[..., None],
+            0,
+        )
+        out["ar_ebar"] = jnp.where(do_ar, s["exec_bar"][..., None], 0)
+        do_nack = do_ar & nack[..., None]
+        oflags = oflags | jnp.where(do_nack, jnp.uint32(AR_NACK), 0)
+        out["ar_hint"] = jnp.where(do_nack, nack_hint[..., None], 0)
+
+        # REQVOTE: candidates campaign every tick (loss-tolerant)
+        do_rv = candidate[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_rv, jnp.uint32(REQVOTE), 0)
+        out["rv_term"] = jnp.where(do_rv, s["term"][..., None], 0)
+        out["rv_lidx"] = jnp.where(do_rv, s["log_end"][..., None], 0)
+        out["rv_lterm"] = jnp.where(do_rv, s["last_term"][..., None], 0)
+
+        # VOTE_REPLY: to the candidate we just heard (grant bit if granted)
+        do_vr = rv_ok[..., None] & dst_onehot(rv_src, R) & ns_mask
+        oflags = oflags | jnp.where(do_vr, jnp.uint32(VOTE_REPLY), 0)
+        oflags = oflags | jnp.where(
+            do_vr & can_vote[..., None], jnp.uint32(VOTE_GRANT), 0
+        )
+        out["vr_term"] = jnp.where(do_vr, s["term"][..., None], 0)
+
+        # broadcast window lanes: log content for AE receivers
+        out["bw_abs"] = s["win_abs"]
+        out["bw_term"] = s["win_term"]
+        out["bw_val"] = s["win_val"]
+        out["flags"] = oflags
+
+        # conservative min-exec over the group (snap_bar GC rule)
+        eye_max = jnp.where(
+            eye, jnp.iinfo(jnp.int32).max, s["peer_exec"]
+        )
+        snap_bar = jnp.minimum(jnp.min(eye_max, axis=2), s["exec_bar"])
+
+        fx = StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": n_new,
+                "is_leader": s["is_leader"] & (s["leader"] == rid),
+                "snap_bar": snap_bar,
+            },
+        )
+        return s, out, fx

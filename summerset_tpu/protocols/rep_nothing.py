@@ -1,0 +1,114 @@
+"""Vectorized RepNothing: no replication, the baseline protocol.
+
+Parity target: reference ``src/protocols/rep_nothing/`` (SURVEY.md §2.5) —
+log the request batch locally (WAL append), execute, reply.  No peer
+messages at all; population may be > 1 but replicas never talk (each serves
+its own clients independently in the reference; here the group's proposal
+stream lands on replica 0, the "serving node").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from ..core.protocol import ProtocolKernel, StepEffects
+from . import register_protocol
+from .common import NO_SLOT, range_cover
+
+
+@dataclasses.dataclass
+class ReplicaConfigRepNothing:
+    """Parity: ``ReplicaConfigRepNothing`` (``rep_nothing/mod.rs``) —
+    batching + WAL sync knobs, re-expressed in ticks."""
+
+    max_proposals_per_tick: int = 16
+    dur_lag: int = 0                  # WAL ack lag in slots/tick (0=instant)
+    exec_follows_commit: bool = True
+
+
+@register_protocol("RepNothing")
+class RepNothingKernel(ProtocolKernel):
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigRepNothing | None = None,
+    ):
+        super().__init__(num_groups, population, window)
+        self.config = config or ReplicaConfigRepNothing()
+        if self.config.max_proposals_per_tick > window:
+            raise ValueError("max_proposals_per_tick must be <= window")
+
+    def init_state(self, seed: int = 0):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        zeros = lambda *shape: jnp.zeros(shape, i32)  # noqa: E731
+        return {
+            "next_slot": zeros(G, R),
+            "dur_bar": zeros(G, R),
+            "commit_bar": zeros(G, R),
+            "exec_bar": zeros(G, R),
+            "win_abs": jnp.full((G, R, W), NO_SLOT, i32),
+            "win_val": zeros(G, R, W),
+        }
+
+    def zero_outbox(self):
+        G, R = self.G, self.R
+        return {"flags": jnp.zeros((G, R, R), jnp.uint32)}
+
+    def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
+        i32 = jnp.int32
+        s = dict(state)
+        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+
+        serving = rid == 0
+        space = jnp.maximum(s["exec_bar"] + W - s["next_slot"], 0)
+        n_prop = jnp.broadcast_to(
+            inputs["n_proposals"][:, None].astype(i32), (G, R)
+        )
+        n_new = jnp.where(
+            serving,
+            jnp.minimum(jnp.minimum(n_prop, space), cfg.max_proposals_per_tick),
+            0,
+        )
+        vbase = jnp.broadcast_to(
+            inputs["value_base"][:, None].astype(i32), (G, R)
+        )
+        m_new, abs_new = range_cover(s["next_slot"], s["next_slot"] + n_new, W)
+        s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
+        s["win_val"] = jnp.where(
+            m_new, vbase[..., None] + (abs_new - s["next_slot"][..., None]),
+            s["win_val"],
+        )
+        s["next_slot"] = s["next_slot"] + n_new
+
+        if cfg.dur_lag > 0:
+            s["dur_bar"] = jnp.minimum(s["next_slot"], s["dur_bar"] + cfg.dur_lag)
+        else:
+            s["dur_bar"] = s["next_slot"]
+        s["commit_bar"] = s["dur_bar"]
+
+        if cfg.exec_follows_commit:
+            s["exec_bar"] = s["commit_bar"]
+        else:
+            s["exec_bar"] = jnp.maximum(
+                s["exec_bar"],
+                jnp.minimum(s["commit_bar"], inputs["exec_floor"].astype(i32)),
+            )
+
+        fx = StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": n_new,
+                "is_leader": serving,
+                "snap_bar": s["exec_bar"],
+            },
+        )
+        return s, self.zero_outbox(), fx

@@ -1,0 +1,59 @@
+"""Protocol-agnostic SMR test harness helpers shared by the per-protocol
+kernel test suites (the analog of the reference tester's checked_get/
+checked_put assertion machinery, ``summerset_client/src/clients/tester.rs``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def run_segment(eng, state, ns, ticks, n_prop=0, alive=None, link_up=None,
+                base_start=0):
+    """Run `ticks` ticks with constant control masks; returns (state, ns, fx).
+
+    Proposal value ids are ``(base_start + tick) * P + i`` so that in a
+    from-slot-0 run the committed value of slot s is s (checkable).
+    """
+    G = eng.kernel.G
+    P = eng.kernel.config.max_proposals_per_tick
+    t = jnp.arange(ticks, dtype=jnp.int32)
+    seq = {
+        "n_proposals": jnp.full((ticks, G), n_prop, jnp.int32),
+        "value_base": jnp.broadcast_to(
+            ((base_start + t) * P)[:, None], (ticks, G)
+        ),
+    }
+    if alive is not None:
+        seq["alive"] = jnp.broadcast_to(alive, (ticks,) + alive.shape)
+    if link_up is not None:
+        seq["link_up"] = jnp.broadcast_to(link_up, (ticks,) + link_up.shape)
+    return eng.run_ticks(state, ns, seq)
+
+
+def committed_values(state, g, r, window, val_key="win_val"):
+    """Map {slot: value} of committed slots still inside r's window."""
+    cb = int(state["commit_bar"][g, r])
+    out = {}
+    abs_ = np.asarray(state["win_abs"][g, r])
+    val = np.asarray(state[val_key][g, r])
+    for p in range(window):
+        a = int(abs_[p])
+        if 0 <= a < cb:
+            out[a] = int(val[p])
+    return out
+
+
+def check_agreement(state, G, R, W, val_key="win_val"):
+    """No two replicas commit different values for the same slot."""
+    for g in range(G):
+        merged = {}
+        for r in range(R):
+            vals = committed_values(state, g, r, W, val_key=val_key)
+            for slot, v in vals.items():
+                if slot in merged:
+                    assert merged[slot] == v, (
+                        f"group {g} slot {slot}: {merged[slot]} != {v}"
+                    )
+                else:
+                    merged[slot] = v
+    return True
