@@ -1,0 +1,200 @@
+"""Vectorized Chain Replication (partial, no fault tolerance).
+
+Parity target: reference ``src/protocols/chain_rep/`` (SURVEY.md §2.5) —
+head -> tail ``Propagate`` of write batches down a fixed chain ordered by
+replica id, reads served at the tail, per-node ``prop_bar``/``exec_bar``
+(``chain_rep/mod.rs:148-156``).  Like the reference, node failure handling
+is out of scope ("partial, no fault tolerance").
+
+TPU-first shape: each node runs a go-back-N range stream to its successor
+(position ``rid + 1``); the tail's durable frontier is the commit point and
+acks ripple back up the chain as a cumulative ``commit_bar`` carried on
+ACK messages (the reference's reply propagation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from ..core.protocol import ProtocolKernel, StepEffects
+from . import register_protocol
+from .common import (
+    NO_SLOT,
+    advance_durability,
+    advance_exec,
+    client_intake,
+    range_cover,
+    take_lane,
+    take_src,
+)
+
+PROP = 1   # Propagate range down-chain
+ACK = 2    # cumulative ack up-chain (carries committed frontier)
+
+
+@dataclasses.dataclass
+class ReplicaConfigChainRep:
+    """Parity: ``ReplicaConfigChainRep`` (``chain_rep/mod.rs``)."""
+
+    max_proposals_per_tick: int = 16
+    chunk_size: int = 64
+    retry_interval: int = 8
+    dur_lag: int = 0
+    exec_follows_commit: bool = True
+
+
+@register_protocol("ChainRep")
+class ChainRepKernel(ProtocolKernel):
+    broadcast_lanes = frozenset({"bw_abs", "bw_val"})
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigChainRep | None = None,
+    ):
+        super().__init__(num_groups, population, window)
+        self.config = config or ReplicaConfigChainRep()
+        if self.config.max_proposals_per_tick > window // 2:
+            raise ValueError("max_proposals_per_tick must be <= window/2")
+        self._chunk = min(self.config.chunk_size, window)
+
+    def init_state(self, seed: int = 0):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        zeros = lambda *shape: jnp.zeros(shape, i32)  # noqa: E731
+        return {
+            "prop_bar": zeros(G, R),   # contiguous received/appended frontier
+            "dur_bar": zeros(G, R),
+            "commit_bar": zeros(G, R),
+            "exec_bar": zeros(G, R),
+            "next_idx": zeros(G, R),   # send cursor toward successor
+            "match_f": zeros(G, R),    # successor's acked frontier
+            "retry_cnt": jnp.full((G, R), self.config.retry_interval, i32),
+            "win_abs": jnp.full((G, R, W), NO_SLOT, i32),
+            "win_val": zeros(G, R, W),
+        }
+
+    def zero_outbox(self):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        return {
+            "flags": jnp.zeros((G, R, R), jnp.uint32),
+            "pp_lo": pair(), "pp_hi": pair(),
+            "ak_f": pair(), "ak_cbar": pair(),
+            "bw_abs": jnp.zeros((G, R, W), i32),
+            "bw_val": jnp.zeros((G, R, W), i32),
+        }
+
+    def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
+        i32 = jnp.int32
+        s = dict(state)
+        flags = inbox["flags"]
+        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+        is_head = rid == 0
+        is_tail = rid == R - 1
+
+        # ---- PROP ingest (from predecessor): contiguous range accept
+        p_valid = (flags & PROP) != 0
+        p_src = jnp.argmax(p_valid, axis=2).astype(i32)
+        p_ok = p_valid.any(axis=2) & ~is_head & (p_src == rid - 1)
+        p_lo = take_src(inbox["pp_lo"], p_src)
+        p_hi = take_src(inbox["pp_hi"], p_src)
+        acc = p_ok & (p_lo <= s["prop_bar"]) & (p_hi > s["prop_bar"])
+        m_acc, abs_acc = range_cover(p_lo, p_hi, W)
+        m_acc &= acc[..., None]
+        lane_val = take_lane(inbox["bw_val"], p_src)
+        s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
+        s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
+        s["prop_bar"] = jnp.where(
+            acc, jnp.maximum(s["prop_bar"], p_hi), s["prop_bar"]
+        )
+
+        # ---- ACK ingest (from successor): acked frontier + commit ripple
+        a_valid = (flags & ACK) != 0
+        a_src = jnp.argmax(a_valid, axis=2).astype(i32)
+        a_ok = a_valid.any(axis=2) & ~is_tail & (a_src == rid + 1)
+        a_f = take_src(inbox["ak_f"], a_src)
+        a_cbar = take_src(inbox["ak_cbar"], a_src)
+        prog = a_ok & (a_f > s["match_f"])
+        s["match_f"] = jnp.where(a_ok, jnp.maximum(s["match_f"], a_f), s["match_f"])
+        s["retry_cnt"] = jnp.where(prog, cfg.retry_interval, s["retry_cnt"])
+        up_commit = jnp.where(a_ok, a_cbar, 0)
+
+        # ---- head proposals
+        n_new, m_new, abs_new, new_vals = client_intake(
+            s, inputs, is_head, cfg.max_proposals_per_tick, W,
+            frontier="prop_bar",
+        )
+        s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
+        s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
+        s["prop_bar"] = s["prop_bar"] + n_new
+
+        # ---- durability + commit
+        s["dur_bar"] = advance_durability(s, cfg.dur_lag, frontier="prop_bar")
+        # tail: everything durable at the tail is committed (it has passed
+        # every chain node); others: commit ripples up via ACKs
+        s["commit_bar"] = jnp.where(
+            is_tail,
+            s["dur_bar"],
+            jnp.maximum(s["commit_bar"], jnp.minimum(up_commit, s["prop_bar"])),
+        )
+
+        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
+
+        # ---- outbox
+        out = self.zero_outbox()
+        oflags = out["flags"]
+        succ = jnp.broadcast_to(
+            (jnp.arange(R, dtype=i32)[None, None, :] ==
+             (rid + 1)[..., None]),
+            (G, R, R),
+        ) & ~is_tail[..., None]
+
+        stale = ~is_tail & (s["next_idx"] > s["match_f"])
+        s["retry_cnt"] = jnp.where(stale, s["retry_cnt"] - 1, cfg.retry_interval)
+        rewind = stale & (s["retry_cnt"] <= 0)
+        s["next_idx"] = jnp.where(rewind, s["match_f"], s["next_idx"])
+        s["retry_cnt"] = jnp.where(rewind, cfg.retry_interval, s["retry_cnt"])
+
+        snd_lo = s["next_idx"]
+        snd_hi = jnp.minimum(s["dur_bar"], snd_lo + self._chunk)
+        do_prop = (snd_hi > snd_lo) & ~is_tail
+        oflags = oflags | jnp.where(
+            do_prop[..., None] & succ, jnp.uint32(PROP), 0
+        )
+        out["pp_lo"] = jnp.where(succ, snd_lo[..., None], 0)
+        out["pp_hi"] = jnp.where(succ, snd_hi[..., None], 0)
+        s["next_idx"] = jnp.where(do_prop, snd_hi, s["next_idx"])
+
+        # ACK to predecessor every tick: durable frontier + commit bar
+        pred = jnp.broadcast_to(
+            (jnp.arange(R, dtype=i32)[None, None, :] ==
+             (rid - 1)[..., None]),
+            (G, R, R),
+        ) & ~is_head[..., None]
+        oflags = oflags | jnp.where(pred, jnp.uint32(ACK), 0)
+        out["ak_f"] = jnp.where(pred, s["dur_bar"][..., None], 0)
+        out["ak_cbar"] = jnp.where(pred, s["commit_bar"][..., None], 0)
+
+        out["bw_abs"] = s["win_abs"]
+        out["bw_val"] = s["win_val"]
+        out["flags"] = oflags
+
+        fx = StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": n_new,
+                "is_leader": is_head,
+                "snap_bar": s["exec_bar"],
+            },
+        )
+        return s, out, fx

@@ -87,6 +87,53 @@ def kth_largest(values, k: int):
     return jnp.sort(values, axis=-1)[..., r - k]
 
 
+# --------------------------------------------------- shared lockstep blocks --
+def client_intake(s, inputs, serving, cap: int, window: int,
+                  frontier: str = "next_slot"):
+    """Clamp this tick's client proposals to window space and batch cap.
+
+    The vectorized form of the reference's ``handle_req_batch`` intake
+    (``multipaxos/request.rs:112-190``): ``serving`` marks replicas that take
+    proposals; space is bounded by the ring window above the replica's own
+    exec bar.  Returns ``(n_new, m_new, abs_new, new_vals)`` — the caller
+    writes its protocol-specific window fields and advances the frontier.
+    """
+    G, R = s["exec_bar"].shape
+    i32 = jnp.int32
+    space = jnp.maximum(s["exec_bar"] + window - s[frontier], 0)
+    n_prop = jnp.broadcast_to(
+        inputs["n_proposals"][:, None].astype(i32), (G, R)
+    )
+    n_new = jnp.where(
+        serving, jnp.minimum(jnp.minimum(n_prop, space), cap), 0
+    )
+    vbase = jnp.broadcast_to(
+        inputs["value_base"][:, None].astype(i32), (G, R)
+    )
+    m_new, abs_new = range_cover(s[frontier], s[frontier] + n_new, window)
+    new_vals = vbase[..., None] + (abs_new - s[frontier][..., None])
+    return n_new, m_new, abs_new, new_vals
+
+
+def advance_durability(s, dur_lag: int, frontier: str = "next_slot"):
+    """WAL-ack progression: instant, or `dur_lag` slots/tick (the host
+    logger-latency stand-in for device-only runs; reference StorageHub)."""
+    if dur_lag > 0:
+        return jnp.minimum(s[frontier], s["dur_bar"] + dur_lag)
+    return s[frontier]
+
+
+def advance_exec(s, inputs, exec_follows_commit: bool):
+    """Exec bar: mirrors commit in device-only mode, else follows the host
+    applier's reported floor (``exec_floor`` input)."""
+    if exec_follows_commit:
+        return s["commit_bar"]
+    return jnp.maximum(
+        s["exec_bar"],
+        jnp.minimum(s["commit_bar"], inputs["exec_floor"].astype(jnp.int32)),
+    )
+
+
 def dst_onehot(src, R: int):
     """[G, R] sender index -> [G, R, R_dst] bool one-hot (for reply routing)."""
     return jnp.arange(R, dtype=jnp.int32)[None, None, :] == src[..., None]

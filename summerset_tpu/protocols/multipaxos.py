@@ -55,7 +55,10 @@ from . import register_protocol
 from .common import (
     NO_SLOT,
     NULL_VAL,
+    advance_durability,
+    advance_exec,
     best_by_ballot,
+    client_intake,
     dst_onehot,
     initial_ballot,
     kth_largest,
@@ -431,19 +434,9 @@ class MultiPaxosKernel(ProtocolKernel):
         # reuse safety); laggards beyond it are healed via SNAPSHOT sends,
         # not by stalling the group (availability > reference's conservative
         # all-peers-executed GC rule).
-        space = jnp.maximum(s["exec_bar"] + W - s["next_slot"], 0)
-        n_prop = jnp.broadcast_to(
-            inputs["n_proposals"][:, None].astype(i32), (G, R)
+        n_new, m_new, abs_new, new_vals = client_intake(
+            s, inputs, active_leader, cfg.max_proposals_per_tick, W
         )
-        n_new = jnp.where(
-            active_leader,
-            jnp.minimum(jnp.minimum(n_prop, space), cfg.max_proposals_per_tick),
-            0,
-        )
-        vbase = jnp.broadcast_to(inputs["value_base"][:, None].astype(i32), (G, R))
-        m_new, abs_new = range_cover(s["next_slot"], s["next_slot"] + n_new, W)
-        # value id for the i-th new proposal = value_base + (abs - next_slot)
-        new_vals = vbase[..., None] + (abs_new - s["next_slot"][..., None])
         s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
         s["win_bal"] = jnp.where(m_new, s["bal_max"][..., None], s["win_bal"])
         s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
@@ -451,10 +444,7 @@ class MultiPaxosKernel(ProtocolKernel):
         s["vote_bar"] = jnp.where(active_leader, s["next_slot"], s["vote_bar"])
 
         # =========== 10. durability + leader commit tally + exec
-        if cfg.dur_lag > 0:
-            s["dur_bar"] = jnp.minimum(s["vote_bar"], s["dur_bar"] + cfg.dur_lag)
-        else:
-            s["dur_bar"] = s["vote_bar"]
+        s["dur_bar"] = advance_durability(s, cfg.dur_lag, frontier="vote_bar")
 
         # per-peer ballot-matched frontiers; own column = own durable frontier
         peer_f = jnp.where(
@@ -472,13 +462,7 @@ class MultiPaxosKernel(ProtocolKernel):
             s["commit_bar"],
         )
 
-        if cfg.exec_follows_commit:
-            s["exec_bar"] = s["commit_bar"]
-        else:
-            s["exec_bar"] = jnp.maximum(
-                s["exec_bar"],
-                jnp.minimum(s["commit_bar"], inputs["exec_floor"].astype(i32)),
-            )
+        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
 
         # =========== 11. build outbox
         out = self.zero_outbox()

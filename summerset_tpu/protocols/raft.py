@@ -51,7 +51,10 @@ from . import register_protocol
 from .common import (
     NO_SLOT,
     NULL_VAL,
+    advance_durability,
+    advance_exec,
     best_by_ballot,
+    client_intake,
     dst_onehot,
     kth_largest,
     not_self,
@@ -472,24 +475,10 @@ class RaftKernel(ProtocolKernel):
         s["win_val"] = jnp.where(m_np, NULL_VAL, s["win_val"])
         s["log_end"] = s["log_end"] + n_noop
         s["last_term"] = jnp.where(need_noop, s["term"], s["last_term"])
-        space = space - n_noop
-        n_prop = jnp.broadcast_to(
-            inputs["n_proposals"][:, None].astype(i32), (G, R)
+        n_new, m_new, abs_new, new_vals = client_intake(
+            s, inputs, lead, cfg.max_proposals_per_tick, W,
+            frontier="log_end",
         )
-        n_new = jnp.where(
-            lead,
-            jnp.minimum(
-                jnp.minimum(n_prop, space), cfg.max_proposals_per_tick
-            ),
-            0,
-        )
-        vbase = jnp.broadcast_to(
-            inputs["value_base"][:, None].astype(i32), (G, R)
-        )
-        m_new, abs_new = range_cover(
-            s["log_end"], s["log_end"] + n_new, W
-        )
-        new_vals = vbase[..., None] + (abs_new - s["log_end"][..., None])
         s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
         s["win_term"] = jnp.where(m_new, s["term"][..., None], s["win_term"])
         s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
@@ -498,12 +487,7 @@ class RaftKernel(ProtocolKernel):
         s["match_bar"] = jnp.where(lead, s["log_end"], s["match_bar"])
 
         # =========== 8. durability + leader commit tally + exec
-        if cfg.dur_lag > 0:
-            s["dur_bar"] = jnp.minimum(
-                s["log_end"], s["dur_bar"] + cfg.dur_lag
-            )
-        else:
-            s["dur_bar"] = s["log_end"]
+        s["dur_bar"] = advance_durability(s, cfg.dur_lag, frontier="log_end")
 
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
         peer_f = jnp.where(eye, s["dur_bar"][..., None], s["match_f"])
@@ -516,15 +500,7 @@ class RaftKernel(ProtocolKernel):
             s["commit_bar"],
         )
 
-        if cfg.exec_follows_commit:
-            s["exec_bar"] = s["commit_bar"]
-        else:
-            s["exec_bar"] = jnp.maximum(
-                s["exec_bar"],
-                jnp.minimum(
-                    s["commit_bar"], inputs["exec_floor"].astype(i32)
-                ),
-            )
+        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
 
         # =========== 9. build outbox
         out = self.zero_outbox()

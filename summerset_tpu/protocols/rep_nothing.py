@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from ..core.protocol import ProtocolKernel, StepEffects
 from . import register_protocol
-from .common import NO_SLOT, range_cover
+from .common import NO_SLOT, advance_durability, advance_exec, client_intake
 
 
 @dataclasses.dataclass
@@ -68,39 +68,16 @@ class RepNothingKernel(ProtocolKernel):
         rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
 
         serving = rid == 0
-        space = jnp.maximum(s["exec_bar"] + W - s["next_slot"], 0)
-        n_prop = jnp.broadcast_to(
-            inputs["n_proposals"][:, None].astype(i32), (G, R)
+        n_new, m_new, abs_new, new_vals = client_intake(
+            s, inputs, serving, cfg.max_proposals_per_tick, W
         )
-        n_new = jnp.where(
-            serving,
-            jnp.minimum(jnp.minimum(n_prop, space), cfg.max_proposals_per_tick),
-            0,
-        )
-        vbase = jnp.broadcast_to(
-            inputs["value_base"][:, None].astype(i32), (G, R)
-        )
-        m_new, abs_new = range_cover(s["next_slot"], s["next_slot"] + n_new, W)
         s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
-        s["win_val"] = jnp.where(
-            m_new, vbase[..., None] + (abs_new - s["next_slot"][..., None]),
-            s["win_val"],
-        )
+        s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
         s["next_slot"] = s["next_slot"] + n_new
 
-        if cfg.dur_lag > 0:
-            s["dur_bar"] = jnp.minimum(s["next_slot"], s["dur_bar"] + cfg.dur_lag)
-        else:
-            s["dur_bar"] = s["next_slot"]
+        s["dur_bar"] = advance_durability(s, cfg.dur_lag)
         s["commit_bar"] = s["dur_bar"]
-
-        if cfg.exec_follows_commit:
-            s["exec_bar"] = s["commit_bar"]
-        else:
-            s["exec_bar"] = jnp.maximum(
-                s["exec_bar"],
-                jnp.minimum(s["commit_bar"], inputs["exec_floor"].astype(i32)),
-            )
+        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
 
         fx = StepEffects(
             commit_bar=s["commit_bar"],

@@ -1,0 +1,215 @@
+"""Vectorized SimplePush: push-to-peers best-effort replication.
+
+Parity target: reference ``src/protocols/simple_push/`` (SURVEY.md §2.5) —
+the serving node pushes each command batch to ``rep_degree`` peers
+(``PeerMsg::{Push,PushReply}``), waits for all pushed acks, then executes
+and replies.  No leader election, no ballots — explicitly *not* fault
+tolerant ("no consistency guarantee").
+
+TPU-first shape: the per-batch Push/PushReply exchange becomes a per-peer
+go-back-N range stream with cumulative acks (same machinery as the
+MultiPaxos accept stream, minus ballots): the serving node keeps a
+``next_idx`` cursor per pushed peer and commits up to
+``min(own durable frontier, min over pushed peers' acked frontiers)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from ..core.protocol import ProtocolKernel, StepEffects
+from . import register_protocol
+from .common import (
+    NO_SLOT,
+    advance_durability,
+    advance_exec,
+    client_intake,
+    range_cover,
+    take_lane,
+    take_src,
+)
+
+PUSH = 1
+PUSH_REPLY = 2
+
+
+@dataclasses.dataclass
+class ReplicaConfigSimplePush:
+    """Parity: ``ReplicaConfigSimplePush`` (``simple_push/mod.rs``) —
+    notably ``rep_degree`` (how many peers each batch is pushed to)."""
+
+    max_proposals_per_tick: int = 16
+    chunk_size: int = 64
+    rep_degree: int = -1              # peers pushed to; -1 = all peers
+    retry_interval: int = 8
+    dur_lag: int = 0
+    exec_follows_commit: bool = True
+
+
+@register_protocol("SimplePush")
+class SimplePushKernel(ProtocolKernel):
+    broadcast_lanes = frozenset({"bw_abs", "bw_val"})
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigSimplePush | None = None,
+    ):
+        super().__init__(num_groups, population, window)
+        self.config = config or ReplicaConfigSimplePush()
+        if self.config.max_proposals_per_tick > window // 2:
+            raise ValueError("max_proposals_per_tick must be <= window/2")
+        self._chunk = min(self.config.chunk_size, window)
+        deg = self.config.rep_degree
+        self._degree = population - 1 if deg < 0 else min(deg, population - 1)
+
+    def init_state(self, seed: int = 0):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        zeros = lambda *shape: jnp.zeros(shape, i32)  # noqa: E731
+        return {
+            "next_slot": zeros(G, R),      # serving: append frontier;
+            "dur_bar": zeros(G, R),        # peers: contiguous recv frontier
+            "commit_bar": zeros(G, R),
+            "exec_bar": zeros(G, R),
+            "next_idx": zeros(G, R, R),
+            "match_f": zeros(G, R, R),
+            "retry_cnt": jnp.full((G, R, R), self.config.retry_interval, i32),
+            "win_abs": jnp.full((G, R, W), NO_SLOT, i32),
+            "win_val": zeros(G, R, W),
+        }
+
+    def zero_outbox(self):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        return {
+            "flags": jnp.zeros((G, R, R), jnp.uint32),
+            "ps_lo": pair(), "ps_hi": pair(), "ps_cbar": pair(),
+            "pr_f": pair(),
+            "bw_abs": jnp.zeros((G, R, W), i32),
+            "bw_val": jnp.zeros((G, R, W), i32),
+        }
+
+    def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
+        i32 = jnp.int32
+        s = dict(state)
+        flags = inbox["flags"]
+        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+        serving = rid == 0
+        # pushed peer set: replicas 1..degree (deterministic, like the
+        # reference's fixed peer selection)
+        pushed = (rid >= 1) & (rid <= self._degree)
+
+        # ---- PUSH ingest (peers): contiguous range accept
+        p_valid = (flags & PUSH) != 0
+        p_src = jnp.argmax(p_valid, axis=2).astype(i32)
+        p_ok = p_valid.any(axis=2) & ~serving
+        p_lo = take_src(inbox["ps_lo"], p_src)
+        p_hi = take_src(inbox["ps_hi"], p_src)
+        p_cbar = take_src(inbox["ps_cbar"], p_src)
+        acc = p_ok & (p_lo <= s["next_slot"]) & (p_hi > s["next_slot"])
+        m_acc, abs_acc = range_cover(p_lo, p_hi, W)
+        m_acc &= acc[..., None]
+        lane_val = take_lane(inbox["bw_val"], p_src)
+        s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
+        s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
+        s["next_slot"] = jnp.where(
+            acc, jnp.maximum(s["next_slot"], p_hi), s["next_slot"]
+        )
+        peer_commit = p_ok & ~serving
+        new_cbar = jnp.minimum(p_cbar, s["next_slot"])
+
+        # ---- PUSH_REPLY ingest (serving node): cumulative ack frontiers
+        r_valid = (flags & PUSH_REPLY) != 0
+        prog = r_valid & (inbox["pr_f"] > s["match_f"])
+        s["match_f"] = jnp.where(
+            r_valid, jnp.maximum(s["match_f"], inbox["pr_f"]), s["match_f"]
+        )
+        s["retry_cnt"] = jnp.where(prog, cfg.retry_interval, s["retry_cnt"])
+
+        # ---- serving node proposals
+        n_new, m_new, abs_new, new_vals = client_intake(
+            s, inputs, serving, cfg.max_proposals_per_tick, W
+        )
+        s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
+        s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
+        s["next_slot"] = s["next_slot"] + n_new
+
+        # ---- durability + commit
+        s["dur_bar"] = advance_durability(s, cfg.dur_lag)
+        # serving commit: all pushed peers acked (min over pushed frontiers)
+        pushed_row = pushed[:, None, :]  # [G, 1, R_dst] as seen by serving
+        acked_min = jnp.min(
+            jnp.where(pushed_row, s["match_f"], jnp.iinfo(jnp.int32).max),
+            axis=2,
+        )
+        srv_commit = jnp.minimum(
+            s["dur_bar"], jnp.where(self._degree > 0, acked_min, s["dur_bar"])
+        )
+        s["commit_bar"] = jnp.where(
+            serving,
+            jnp.maximum(s["commit_bar"], srv_commit),
+            jnp.where(
+                peer_commit,
+                jnp.maximum(s["commit_bar"], new_cbar),
+                s["commit_bar"],
+            ),
+        )
+
+        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
+
+        # ---- outbox
+        out = self.zero_outbox()
+        oflags = out["flags"]
+        dst_pushed = jnp.broadcast_to(pushed[:, None, :], (G, R, R))
+
+        stale = serving[..., None] & dst_pushed & (s["next_idx"] > s["match_f"])
+        s["retry_cnt"] = jnp.where(stale, s["retry_cnt"] - 1, cfg.retry_interval)
+        rewind = stale & (s["retry_cnt"] <= 0)
+        s["next_idx"] = jnp.where(rewind, s["match_f"], s["next_idx"])
+        s["retry_cnt"] = jnp.where(rewind, cfg.retry_interval, s["retry_cnt"])
+
+        snd_lo = s["next_idx"]
+        snd_hi = jnp.minimum(s["next_slot"][..., None], snd_lo + self._chunk)
+        do_push = serving[..., None] & dst_pushed & (snd_hi > snd_lo)
+        # heartbeat-style empty push keeps peer commit bars advancing
+        do_note = serving[..., None] & dst_pushed & ~do_push
+        oflags = oflags | jnp.where(do_push | do_note, jnp.uint32(PUSH), 0)
+        out["ps_lo"] = jnp.where(do_push, snd_lo, s["next_slot"][..., None])
+        out["ps_hi"] = jnp.where(do_push, snd_hi, s["next_slot"][..., None])
+        out["ps_cbar"] = jnp.where(
+            do_push | do_note, s["commit_bar"][..., None], 0
+        )
+        s["next_idx"] = jnp.where(do_push, snd_hi, s["next_idx"])
+
+        # peers ack their durable contiguous frontier to the serving node
+        do_reply = pushed[..., None] & (
+            jnp.arange(R, dtype=i32)[None, None, :] == 0
+        )
+        oflags = oflags | jnp.where(do_reply, jnp.uint32(PUSH_REPLY), 0)
+        out["pr_f"] = jnp.where(
+            do_reply, jnp.minimum(s["next_slot"], s["dur_bar"])[..., None], 0
+        )
+
+        out["bw_abs"] = s["win_abs"]
+        out["bw_val"] = s["win_val"]
+        out["flags"] = oflags
+
+        fx = StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": n_new,
+                "is_leader": serving,
+                "snap_bar": s["exec_bar"],
+            },
+        )
+        return s, out, fx

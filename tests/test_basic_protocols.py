@@ -1,0 +1,129 @@
+"""Tests for the non-consensus protocol kernels: RepNothing, SimplePush,
+ChainRep (reference ``src/protocols/{rep_nothing,simple_push,chain_rep}``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from smr_helpers import check_agreement, committed_values, run_segment
+from summerset_tpu.core import Engine, NetConfig
+from summerset_tpu.protocols import make_protocol
+from summerset_tpu.protocols.chain_rep import ReplicaConfigChainRep
+from summerset_tpu.protocols.rep_nothing import ReplicaConfigRepNothing
+from summerset_tpu.protocols.simple_push import ReplicaConfigSimplePush
+
+
+class TestRepNothing:
+    def test_local_commit_flow(self):
+        G, R, W, P = 4, 3, 32, 4
+        cfg = ReplicaConfigRepNothing(max_proposals_per_tick=P)
+        eng = Engine(make_protocol("repnothing", G, R, W, cfg))
+        state, ns = eng.init()
+        T = 30
+        state, ns, fx = run_segment(eng, state, ns, T, n_prop=P)
+        st = {k: np.asarray(v) for k, v in state.items()}
+        # serving node (0) commits everything instantly; peers stay at 0
+        assert (st["commit_bar"][:, 0] == T * P).all()
+        assert (st["commit_bar"][:, 1:] == 0).all()
+        vals = committed_values(st, 0, 0, W)
+        for slot, v in vals.items():
+            assert v == slot
+
+    def test_dur_lag_throttles(self):
+        G, R, W, P = 2, 1, 32, 4
+        cfg = ReplicaConfigRepNothing(max_proposals_per_tick=P, dur_lag=2)
+        eng = Engine(make_protocol("repnothing", G, R, W, cfg))
+        state, ns = eng.init()
+        state, ns, fx = run_segment(eng, state, ns, 20, n_prop=P)
+        st = np.asarray(state["commit_bar"])
+        # commit bounded by cumulative dur_lag
+        assert (st[:, 0] <= 2 * 20).all()
+        assert (st[:, 0] > 0).all()
+
+
+class TestSimplePush:
+    def test_all_ack_commit(self):
+        G, R, W, P = 4, 3, 32, 4
+        cfg = ReplicaConfigSimplePush(max_proposals_per_tick=P)
+        eng = Engine(make_protocol("simplepush", G, R, W, cfg))
+        state, ns = eng.init()
+        T = 40
+        state, ns, fx = run_segment(eng, state, ns, T, n_prop=P)
+        st = {k: np.asarray(v) for k, v in state.items()}
+        # push + ack round trip ~ 2-3 ticks behind the append frontier
+        assert (st["commit_bar"][:, 0] >= (T - 5) * P).all()
+        # peers received and committed close behind
+        assert (st["commit_bar"][:, 1:] >= (T - 8) * P).all()
+        check_agreement(st, G, R, W)
+        vals = committed_values(st, 0, 0, W)
+        for slot, v in vals.items():
+            assert v == slot
+
+    def test_rep_degree_subset(self):
+        G, R, W, P = 2, 5, 32, 4
+        cfg = ReplicaConfigSimplePush(max_proposals_per_tick=P, rep_degree=2)
+        eng = Engine(make_protocol("simplepush", G, R, W, cfg))
+        state, ns = eng.init()
+        state, ns, fx = run_segment(eng, state, ns, 40, n_prop=P)
+        st = {k: np.asarray(v) for k, v in state.items()}
+        # pushed peers (1, 2) advance; unpushed (3, 4) stay empty
+        assert (st["commit_bar"][:, 0] > 0).all()
+        assert (st["commit_bar"][:, 1:3] > 0).all()
+        assert (st["commit_bar"][:, 3:] == 0).all()
+        check_agreement(st, G, R, W)
+
+    def test_loss_recovery_via_retry(self):
+        G, R, W, P = 4, 3, 64, 4
+        cfg = ReplicaConfigSimplePush(max_proposals_per_tick=P)
+        net = NetConfig(drop_rate=0.2, jitter_ticks=1, max_delay_ticks=3)
+        eng = Engine(make_protocol("simplepush", G, R, W, cfg), netcfg=net,
+                     seed=9)
+        state, ns = eng.init()
+        state, ns, fx = run_segment(eng, state, ns, 200, n_prop=P)
+        st = {k: np.asarray(v) for k, v in state.items()}
+        assert (st["commit_bar"][:, 0] > 100).all()
+        check_agreement(st, G, R, W)
+
+
+class TestChainRep:
+    def test_chain_propagation_and_ack_ripple(self):
+        G, R, W, P = 4, 4, 32, 4
+        cfg = ReplicaConfigChainRep(max_proposals_per_tick=P)
+        eng = Engine(make_protocol("chainrep", G, R, W, cfg))
+        state, ns = eng.init()
+        T = 60
+        state, ns, fx = run_segment(eng, state, ns, T, n_prop=P)
+        st = {k: np.asarray(v) for k, v in state.items()}
+        # pipeline depth ~ 2 ticks per hop down + back up
+        lat = 3 * (R - 1) + 4
+        assert (st["commit_bar"][:, -1] >= (T - lat) * P).all(), (
+            st["commit_bar"]
+        )
+        # commit ripples up: head close behind tail
+        assert (st["commit_bar"][:, 0] >= st["commit_bar"][:, -1] - 4 * P).all()
+        # everyone holds identical values (chain copies)
+        check_agreement(st, G, R, W)
+        vals = committed_values(st, 0, R - 1, W)
+        for slot, v in vals.items():
+            assert v == slot
+
+    def test_single_node_chain(self):
+        G, R, W, P = 2, 1, 32, 4
+        cfg = ReplicaConfigChainRep(max_proposals_per_tick=P)
+        eng = Engine(make_protocol("chainrep", G, R, W, cfg))
+        state, ns = eng.init()
+        state, ns, fx = run_segment(eng, state, ns, 20, n_prop=P)
+        st = np.asarray(state["commit_bar"])
+        assert (st[:, 0] == 20 * P).all()
+
+    def test_loss_recovery(self):
+        G, R, W, P = 2, 3, 64, 4
+        cfg = ReplicaConfigChainRep(max_proposals_per_tick=P)
+        net = NetConfig(drop_rate=0.2, jitter_ticks=1, max_delay_ticks=3)
+        eng = Engine(make_protocol("chainrep", G, R, W, cfg), netcfg=net,
+                     seed=13)
+        state, ns = eng.init()
+        state, ns, fx = run_segment(eng, state, ns, 200, n_prop=P)
+        st = {k: np.asarray(v) for k, v in state.items()}
+        assert (st["commit_bar"][:, -1] > 100).all()
+        check_agreement(st, G, R, W)
