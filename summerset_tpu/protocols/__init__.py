@@ -44,4 +44,5 @@ from . import chain_rep  # noqa: E402,F401
 from . import multipaxos  # noqa: E402,F401
 from . import raft  # noqa: E402,F401
 from . import rep_nothing  # noqa: E402,F401
+from . import rspaxos  # noqa: E402,F401
 from . import simple_push  # noqa: E402,F401

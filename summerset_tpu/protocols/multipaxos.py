@@ -38,14 +38,20 @@ Known deviation from the reference: message loss here means silent drop (the
 netmodel's masks), so liveness machinery (candidate re-Prepare each tick,
 per-peer retry countdown with go-back-to-matched-frontier) is built into the
 kernel rather than delegated to TCP retransmission.
+
+Structure note: ``step`` is decomposed into phase methods with designated
+override hooks — the reference's protocol-variant family (RSPaxos,
+Crossword, QuorumLeases, Bodega all embed the MultiPaxos skeleton,
+SURVEY.md §2.5) maps to subclasses overriding the tally / adoption /
+commit-condition hooks rather than re-implementing the event loop.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from types import SimpleNamespace
+from typing import Any, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from ..core.protocol import ProtocolKernel, StepEffects
@@ -78,6 +84,8 @@ PREPARE = 16
 PREPARE_REPLY = 32
 AR_NACK = 64  # modifier on ACCEPT_REPLY: sender saw a gap; rewind to ar_hint
 SNAPSHOT = 128  # install-snapshot: jump a >window-behind follower forward
+# bits 256+ are reserved for subclass extensions (rspaxos reconstruction,
+# crossword gossip, lease planes)
 
 
 @dataclasses.dataclass
@@ -114,6 +122,25 @@ class MultiPaxosKernel(ProtocolKernel):
             raise ValueError("max_proposals_per_tick must be <= window/2")
         # an Accept range never exceeds the ring window
         self._chunk = min(self.config.chunk_size, window)
+
+    # ------------------------------------------------------- subclass hooks
+    @property
+    def commit_k(self) -> int:
+        """Cumulative-frontier tally order for commit (reference per-slot
+        quorum count, ``messages.rs:370-442``).  RSPaxos/CRaft raise it to
+        ``quorum + fault_tolerance``."""
+        return self.quorum
+
+    @property
+    def prepare_k(self) -> int:
+        """Promise count required for step-up."""
+        return self.quorum
+
+    def _extra_state(self, st: dict, seed: int) -> None:
+        """Subclass state fields (added in place)."""
+
+    def _extra_outbox(self, out: dict) -> None:
+        """Subclass outbox fields (added in place)."""
 
     # ------------------------------------------------------------------ init
     def init_state(self, seed: int = 0):
@@ -166,6 +193,7 @@ class MultiPaxosKernel(ProtocolKernel):
             st["bal_prep_sent"] = jnp.where(is_l, bal0, 0)
             st["leader"] = jnp.full((G, R), L, i32)
             st["vote_bal"] = jnp.full((G, R), bal0, i32)
+        self._extra_state(st, seed)
         return st
 
     # ---------------------------------------------------------------- outbox
@@ -173,7 +201,7 @@ class MultiPaxosKernel(ProtocolKernel):
         G, R, W = self.G, self.R, self.W
         i32 = jnp.int32
         pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
-        return {
+        out = {
             "flags": jnp.zeros((G, R, R), jnp.uint32),
             "acc_bal": pair(), "acc_lo": pair(), "acc_hi": pair(),
             "ar_bal": pair(), "ar_from": pair(), "ar_f": pair(),
@@ -187,25 +215,45 @@ class MultiPaxosKernel(ProtocolKernel):
             "bw_bal": jnp.zeros((G, R, W), i32),
             "bw_val": jnp.zeros((G, R, W), i32),
         }
+        self._extra_outbox(out)
+        return out
 
     # ------------------------------------------------------------------ step
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
-        G, R, W = self.G, self.R, self.W
-        cfg = self.config
-        i32 = jnp.int32
         s = dict(state)
-        flags = inbox["flags"]
-        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+        c = SimpleNamespace(inbox=inbox, inputs=inputs, flags=inbox["flags"])
+        c.rid = jnp.broadcast_to(
+            jnp.arange(self.R, dtype=jnp.int32)[None, :], (self.G, self.R)
+        )
+        self._ingest_heartbeat(s, c)
+        self._ingest_prepare(s, c)
+        self._ingest_snapshot(s, c)
+        self._ingest_accept(s, c)
+        self._ingest_accept_reply(s, c)
+        self._ingest_hb_reply(s, c)
+        self._ingest_prepare_reply(s, c)
+        self._election(s, c)
+        self._try_step_up(s, c)
+        self._leader_propose(s, c)
+        self._advance_bars(s, c)
+        out = self._build_outbox(s, c)
+        fx = self._effects(s, c)
+        return s, out, fx
 
-        # =========== 1. HEARTBEAT ingest (leader liveness + commit notice)
-        hb_ok, hb_bal, hb_src = best_by_ballot(flags, HEARTBEAT, inbox["hb_bal"])
+    # ========== 1. HEARTBEAT ingest (leader liveness + commit notice)
+    def _ingest_heartbeat(self, s, c):
+        cfg = self.config
+        inbox = c.inbox
+        hb_ok, hb_bal, hb_src = best_by_ballot(
+            c.flags, HEARTBEAT, inbox["hb_bal"]
+        )
         hb_ok &= hb_bal >= s["bal_max"]
         s["leader"] = jnp.where(hb_ok, hb_src, s["leader"])
         s["bal_max"] = jnp.where(hb_ok, hb_bal, s["bal_max"])
-        s["rng"], reload = prng.uniform_int(
+        s["rng"], c.reload = prng.uniform_int(
             s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
         )
-        s["hb_cnt"] = jnp.where(hb_ok, reload, s["hb_cnt"])
+        s["hb_cnt"] = jnp.where(hb_ok, c.reload, s["hb_cnt"])
         # follower commit advance: only when voting at the leader's ballot
         # with a run reaching back to (at or below) our commit bar
         hb_cbar = take_src(inbox["hb_cbar"], hb_src)
@@ -219,35 +267,46 @@ class MultiPaxosKernel(ProtocolKernel):
             jnp.maximum(s["commit_bar"], jnp.minimum(hb_cbar, s["vote_bar"])),
             s["commit_bar"],
         )
-        hb_reply_to = hb_ok  # reply routing computed in send phase
+        c.hb_ok, c.hb_bal, c.hb_src = hb_ok, hb_bal, hb_src
+        c.hb_reply_to = hb_ok
 
-        # =========== 2. PREPARE ingest (promise + voted-window reply)
-        p_ok, p_bal, p_src = best_by_ballot(flags, PREPARE, inbox["prp_bal"])
+    # ========== 2. PREPARE ingest (promise + voted-window reply)
+    def _ingest_prepare(self, s, c):
+        p_ok, p_bal, p_src = best_by_ballot(
+            c.flags, PREPARE, c.inbox["prp_bal"]
+        )
         p_ok &= p_bal >= s["bal_max"]
         s["bal_max"] = jnp.where(p_ok, p_bal, s["bal_max"])
         s["leader"] = jnp.where(p_ok, p_src, s["leader"])
         # also reset the election countdown: someone is actively campaigning
-        s["hb_cnt"] = jnp.where(p_ok, reload, s["hb_cnt"])
-        voted_extent = jnp.max(
+        s["hb_cnt"] = jnp.where(p_ok, c.reload, s["hb_cnt"])
+        c.voted_extent = jnp.max(
             jnp.where(s["win_bal"] > 0, s["win_abs"] + 1, 0), axis=2
         )
-        prr_hi_out = voted_extent
+        c.prr_hi_out = c.voted_extent
+        c.p_ok, c.p_bal, c.p_src = p_ok, p_bal, p_src
 
-        # =========== 2b. SNAPSHOT ingest (install: jump forward)
+    # ========== 2b. SNAPSHOT ingest (install: jump forward)
+    def _ingest_snapshot(self, s, c):
         # The reference never discards log a peer still needs (conservative
         # snap_bar, mod.rs:470-478) at the cost of unbounded memory; fixed
         # ring windows instead bound capacity by the leader's own exec bar
         # and laggards get a Raft-style install-snapshot (state itself is
         # transferred host-side; the device installs the bars).
-        sn_ok, sn_bal, sn_src = best_by_ballot(flags, SNAPSHOT, inbox["snp_bal"])
+        inbox = c.inbox
+        sn_ok, sn_bal, sn_src = best_by_ballot(
+            c.flags, SNAPSHOT, inbox["snp_bal"]
+        )
         sn_ok &= sn_bal >= s["bal_max"]
         sn_to = take_src(inbox["snp_to"], sn_src)
         sn_adv = sn_ok & (sn_to > s["commit_bar"])
         s["bal_max"] = jnp.where(sn_ok, sn_bal, s["bal_max"])
         s["leader"] = jnp.where(sn_ok, sn_src, s["leader"])
-        s["hb_cnt"] = jnp.where(sn_ok, reload, s["hb_cnt"])
+        s["hb_cnt"] = jnp.where(sn_ok, c.reload, s["hb_cnt"])
         s["commit_bar"] = jnp.where(sn_adv, sn_to, s["commit_bar"])
-        s["exec_bar"] = jnp.where(sn_adv, jnp.maximum(s["exec_bar"], sn_to), s["exec_bar"])
+        s["exec_bar"] = jnp.where(
+            sn_adv, jnp.maximum(s["exec_bar"], sn_to), s["exec_bar"]
+        )
         s["vote_bal"] = jnp.where(sn_adv, sn_bal, s["vote_bal"])
         s["vote_from"] = jnp.where(sn_adv, sn_to, s["vote_from"])
         s["vote_bar"] = jnp.where(sn_adv, sn_to, s["vote_bar"])
@@ -256,15 +315,19 @@ class MultiPaxosKernel(ProtocolKernel):
         stale_win = sn_adv[..., None] & (s["win_abs"] < sn_to[..., None])
         s["win_abs"] = jnp.where(stale_win, NO_SLOT, s["win_abs"])
         s["win_bal"] = jnp.where(stale_win, 0, s["win_bal"])
+        c.sn_ok, c.sn_adv, c.sn_to = sn_ok, sn_adv, sn_to
 
-        # =========== 3. ACCEPT ingest (acceptor voting run)
-        a_ok, a_bal, a_src = best_by_ballot(flags, ACCEPT, inbox["acc_bal"])
+    # ========== 3. ACCEPT ingest (acceptor voting run)
+    def _ingest_accept(self, s, c):
+        W = self.W
+        inbox = c.inbox
+        a_ok, a_bal, a_src = best_by_ballot(c.flags, ACCEPT, inbox["acc_bal"])
         a_ok &= a_bal >= s["bal_max"]
         a_lo = take_src(inbox["acc_lo"], a_src)
         a_hi = take_src(inbox["acc_hi"], a_src)
         s["bal_max"] = jnp.where(a_ok, a_bal, s["bal_max"])
         s["leader"] = jnp.where(a_ok, a_src, s["leader"])
-        s["hb_cnt"] = jnp.where(a_ok, reload, s["hb_cnt"])
+        s["hb_cnt"] = jnp.where(a_ok, c.reload, s["hb_cnt"])
 
         same_run = a_ok & (s["vote_bal"] == a_bal)
         # a range entirely below the current run (leader backfilling after a
@@ -294,48 +357,85 @@ class MultiPaxosKernel(ProtocolKernel):
         s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
 
         s["vote_from"] = jnp.where(
-            new_run, a_lo, jnp.where(run_merge, jnp.minimum(s["vote_from"], a_lo), s["vote_from"])
+            new_run,
+            a_lo,
+            jnp.where(
+                run_merge, jnp.minimum(s["vote_from"], a_lo), s["vote_from"]
+            ),
         )
         s["vote_bar"] = jnp.where(
-            new_run, a_hi, jnp.where(run_merge, jnp.maximum(s["vote_bar"], a_hi), s["vote_bar"])
+            new_run,
+            a_hi,
+            jnp.where(
+                run_merge, jnp.maximum(s["vote_bar"], a_hi), s["vote_bar"]
+            ),
         )
         s["vote_bal"] = jnp.where(a_ok & apply_rng, a_bal, s["vote_bal"])
         # a new run that starts above our commit bar leaves a hole -> nack
         # so the leader rewinds and backfills [commit_bar, lo)
-        nack = gap | (new_run & (a_lo > s["commit_bar"]))
-        nack_hint = jnp.where(gap, s["vote_bar"], s["commit_bar"])
+        c.nack = gap | (new_run & (a_lo > s["commit_bar"]))
+        c.nack_hint = jnp.where(gap, s["vote_bar"], s["commit_bar"])
+        c.a_ok, c.a_src, c.a_bal = a_ok, a_src, a_bal
+        c.a_new_run, c.a_applied, c.m_acc = new_run, apply_rng, m_acc
+        c.a_lo, c.a_hi = a_lo, a_hi
 
-        # =========== 4. ACCEPT_REPLY ingest (leader match bookkeeping)
-        ar_valid = (flags & ACCEPT_REPLY) != 0
-        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (s["bal_prepared"] > 0)
-        ar_mine = ar_valid & (inbox["ar_bal"] == s["bal_max"][..., None]) & i_am_leader[..., None]
+    # ========== 4. ACCEPT_REPLY ingest (leader match bookkeeping)
+    def _ingest_accept_reply(self, s, c):
+        cfg = self.config
+        inbox = c.inbox
+        ar_valid = (c.flags & ACCEPT_REPLY) != 0
+        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (
+            s["bal_prepared"] > 0
+        )
+        ar_mine = (
+            ar_valid
+            & (inbox["ar_bal"] == s["bal_max"][..., None])
+            & i_am_leader[..., None]
+        )
         prog = ar_mine & (inbox["ar_f"] > s["match_f"])
-        s["match_f"] = jnp.where(ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"])
-        s["match_from"] = jnp.where(ar_mine, inbox["ar_from"], s["match_from"])
+        s["match_f"] = jnp.where(
+            ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"]
+        )
+        s["match_from"] = jnp.where(
+            ar_mine, inbox["ar_from"], s["match_from"]
+        )
         s["match_bal"] = jnp.where(ar_mine, inbox["ar_bal"], s["match_bal"])
-        ar_nacked = ar_mine & ((flags & AR_NACK) != 0)
+        ar_nacked = ar_mine & ((c.flags & AR_NACK) != 0)
         s["next_idx"] = jnp.where(
-            ar_nacked, jnp.minimum(s["next_idx"], inbox["ar_hint"]), s["next_idx"]
+            ar_nacked,
+            jnp.minimum(s["next_idx"], inbox["ar_hint"]),
+            s["next_idx"],
         )
         s["retry_cnt"] = jnp.where(
             prog | ar_nacked, cfg.retry_interval, s["retry_cnt"]
         )
+        c.ar_mine = ar_mine
 
-        # =========== 5. HB_REPLY ingest (peer exec bars for snap_bar GC)
-        hbr_valid = (flags & HB_REPLY) != 0
+    # ========== 5. HB_REPLY ingest (peer exec bars for snap_bar GC)
+    def _ingest_hb_reply(self, s, c):
+        hbr_valid = (c.flags & HB_REPLY) != 0
         s["peer_exec"] = jnp.where(
-            hbr_valid, jnp.maximum(s["peer_exec"], inbox["hbr_ebar"]), s["peer_exec"]
+            hbr_valid,
+            jnp.maximum(s["peer_exec"], c.inbox["hbr_ebar"]),
+            s["peer_exec"],
         )
 
-        # =========== 6. PREPARE_REPLY ingest (candidate tally + adoption)
+    # -- prepare-reply shared prologue (tally + voted-lane views) ------------
+    def _prep_reply_common(self, s, c):
+        R, W = self.R, self.W
+        inbox = c.inbox
         candidate = (s["bal_prep_sent"] == s["bal_max"]) & (
             s["bal_prepared"] != s["bal_max"]
         )
-        pr_valid = (flags & PREPARE_REPLY) != 0
-        pr_mine = pr_valid & (inbox["prr_bal"] == s["bal_prep_sent"][..., None]) & candidate[..., None]
-        trig = s["prep_trigger"]
-        # ack tally + voted-extent max, reduced over the sender axis
-        src_bits = (jnp.uint32(1) << jnp.arange(R, dtype=jnp.uint32))[None, None, :]
+        pr_valid = (c.flags & PREPARE_REPLY) != 0
+        pr_mine = (
+            pr_valid
+            & (inbox["prr_bal"] == s["bal_prep_sent"][..., None])
+            & candidate[..., None]
+        )
+        src_bits = (jnp.uint32(1) << jnp.arange(R, dtype=jnp.uint32))[
+            None, None, :
+        ]
         s["prep_acks"] = s["prep_acks"] | jnp.where(
             pr_mine, src_bits, jnp.uint32(0)
         ).sum(axis=2, dtype=jnp.uint32)
@@ -343,26 +443,37 @@ class MultiPaxosKernel(ProtocolKernel):
             s["prep_hi"],
             jnp.max(jnp.where(pr_mine, inbox["prr_hi"], 0), axis=2),
         )
-        # per-slot max-ballot value adoption across all replying senders,
-        # vectorized over [G, R, R_src, W] (classic Paxos adoption rule)
-        _, abs_ad = range_cover(trig, trig + W, W)  # [G, R, W]; mask is all-True
-        lane_abs = inbox["bw_abs"][:, None, :, :]  # [G, 1, R_src, W]
-        lane_bal = inbox["bw_bal"][:, None, :, :]
-        lane_val = inbox["bw_val"][:, None, :, :]
+        c.candidate = candidate
+        c.pr_mine = pr_mine
+        # per-slot voted-lane views over [G, R, R_src, W]: abs slots from the
+        # campaign trigger, the senders' voted (ballot, value) lanes, and the
+        # valid-vote mask used by both adoption rules
+        trig = s["prep_trigger"]
+        _, abs_ad = range_cover(trig, trig + W, W)  # [G, R, W]; mask all-True
+        c.pr_abs_ad = abs_ad
+        c.pr_lane_bal = inbox["bw_bal"][:, None, :, :]  # [G, 1, R_src, W]
+        c.pr_lane_val = inbox["bw_val"][:, None, :, :]
         in_rng = abs_ad[:, :, None, :] < jnp.minimum(
             inbox["prr_hi"], trig[..., None] + W
         )[..., None]
-        ok = (
+        c.pr_ok = (
             pr_mine[..., None]
-            & (lane_abs == abs_ad[:, :, None, :])
-            & (lane_bal > 0)
+            & (inbox["bw_abs"][:, None, :, :] == abs_ad[:, :, None, :])
+            & (c.pr_lane_bal > 0)
             & in_rng
         )
-        eff_bal = jnp.where(ok, lane_bal, 0)  # [G, R, R_src, W]
+
+    # ========== 6. PREPARE_REPLY ingest (candidate tally + adoption) [HOOK]
+    def _ingest_prepare_reply(self, s, c):
+        self._prep_reply_common(s, c)
+        # per-slot max-ballot value adoption across all replying senders,
+        # vectorized over [G, R, R_src, W] (classic Paxos adoption rule)
+        abs_ad, ok = c.pr_abs_ad, c.pr_ok
+        eff_bal = jnp.where(ok, c.pr_lane_bal, 0)  # [G, R, R_src, W]
         best_bal = eff_bal.max(axis=2)  # [G, R, W]
         best_src = eff_bal.argmax(axis=2)[:, :, None, :]
         best_val = jnp.take_along_axis(
-            jnp.broadcast_to(lane_val, eff_bal.shape), best_src, axis=2
+            jnp.broadcast_to(c.pr_lane_val, eff_bal.shape), best_src, axis=2
         )[:, :, 0, :]
         adopt = (best_bal > 0) & (
             (s["win_abs"] != abs_ad) | (best_bal > s["win_bal"])
@@ -371,81 +482,127 @@ class MultiPaxosKernel(ProtocolKernel):
         s["win_bal"] = jnp.where(adopt, best_bal, s["win_bal"])
         s["win_val"] = jnp.where(adopt, best_val, s["win_val"])
 
-        # =========== 7. election timeout -> campaign
+    def _on_explode(self, s, c, explode):
+        """Hook: candidate-side bookkeeping at campaign start."""
+
+    # ========== 7. election timeout -> campaign
+    def _election(self, s, c):
+        cfg = self.config
+        W = self.W
+        rid = c.rid
+        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (
+            s["bal_prepared"] > 0
+        )
         active_leader = i_am_leader & (s["leader"] == rid)
         s["hb_cnt"] = jnp.where(active_leader, s["hb_cnt"], s["hb_cnt"] - 1)
         # a replica whose voted tail spans more than the window past its
         # commit bar cannot safely lead (it would have to re-propose slots
         # it cannot hold) — it skips candidacy without inflating its ballot,
         # staying receptive to the current leader's backfill/snapshot heal
-        viable = voted_extent - s["commit_bar"] <= W
+        viable = c.voted_extent - s["commit_bar"] <= W
         explode = (~active_leader) & (s["hb_cnt"] <= 0) & viable
         timer_out = (~active_leader) & (s["hb_cnt"] <= 0)
         new_bal = make_greater_ballot(s["bal_max"], rid)
         s["bal_max"] = jnp.where(explode, new_bal, s["bal_max"])
         s["bal_prep_sent"] = jnp.where(explode, new_bal, s["bal_prep_sent"])
-        s["prep_trigger"] = jnp.where(explode, s["commit_bar"], s["prep_trigger"])
+        s["prep_trigger"] = jnp.where(
+            explode, s["commit_bar"], s["prep_trigger"]
+        )
         s["prep_acks"] = jnp.where(
             explode, jnp.uint32(1) << rid.astype(jnp.uint32), s["prep_acks"]
         )
         s["prep_hi"] = jnp.where(
-            explode, jnp.maximum(voted_extent, s["commit_bar"]), s["prep_hi"]
+            explode, jnp.maximum(c.voted_extent, s["commit_bar"]), s["prep_hi"]
         )
         s["leader"] = jnp.where(explode, rid, s["leader"])
         s["rng"], reload2 = prng.uniform_int(
             s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
         )
         s["hb_cnt"] = jnp.where(timer_out, reload2, s["hb_cnt"])
-        candidate = (candidate | explode) & (
+        self._on_explode(s, c, explode)
+        c.candidate = (c.candidate | explode) & (
             s["bal_prep_sent"] == s["bal_max"]
         )
 
-        # =========== 8. candidate -> leader on prepare quorum
-        # A candidate whose window cannot hold the voted tail it would have
-        # to re-propose (> W behind the frontier) must yield: proposing
-        # unseen slots would overwrite committed values.  It stops
-        # campaigning; a more current replica wins and snapshots it forward.
-        behind = candidate & (s["prep_hi"] - s["prep_trigger"] > W)
-        s["bal_prep_sent"] = jnp.where(behind, 0, s["bal_prep_sent"])
-        candidate &= ~behind
-        win = candidate & (popcount(s["prep_acks"]) >= self.quorum)
-        trig = s["prep_trigger"]
-        nslot = jnp.maximum(s["prep_hi"], s["commit_bar"])
-        m_re, abs_re = range_cover(trig, nslot, W)
-        m_re &= win[..., None]
+    def _win_condition(self, s, c):
+        """Hook: promise tally -> step-up decision (`[G, R]` bool)."""
+        return c.candidate & (popcount(s["prep_acks"]) >= self.prepare_k)
+
+    def _adopt_on_win(self, s, c, win, m_re, abs_re):
+        """Hook: write the re-proposal window content for winners.
+
+        Default: keep adopted values merged during PREPARE_REPLY ingest,
+        fill holes with no-ops, stamp everything at the new ballot."""
         hole = m_re & (s["win_abs"] != abs_re)
         s["win_val"] = jnp.where(hole, NULL_VAL, s["win_val"])
         s["win_abs"] = jnp.where(m_re, abs_re, s["win_abs"])
         s["win_bal"] = jnp.where(m_re, s["bal_max"][..., None], s["win_bal"])
+
+    # ========== 8. candidate -> leader on prepare quorum
+    def _try_step_up(self, s, c):
+        cfg = self.config
+        W = self.W
+        # A candidate whose window cannot hold the voted tail it would have
+        # to re-propose (> W behind the frontier) must yield: proposing
+        # unseen slots would overwrite committed values.  It stops
+        # campaigning; a more current replica wins and snapshots it forward.
+        behind = c.candidate & (s["prep_hi"] - s["prep_trigger"] > W)
+        s["bal_prep_sent"] = jnp.where(behind, 0, s["bal_prep_sent"])
+        c.candidate &= ~behind
+        win = self._win_condition(s, c)
+        trig = s["prep_trigger"]
+        nslot = jnp.maximum(s["prep_hi"], s["commit_bar"])
+        m_re, abs_re = range_cover(trig, nslot, W)
+        m_re &= win[..., None]
+        self._adopt_on_win(s, c, win, m_re, abs_re)
         s["bal_prepared"] = jnp.where(win, s["bal_max"], s["bal_prepared"])
         s["next_slot"] = jnp.where(win, nslot, s["next_slot"])
-        s["next_idx"] = jnp.where(win[..., None], trig[..., None], s["next_idx"])
+        s["next_idx"] = jnp.where(
+            win[..., None], trig[..., None], s["next_idx"]
+        )
         s["match_bal"] = jnp.where(win[..., None], 0, s["match_bal"])
         s["match_f"] = jnp.where(win[..., None], 0, s["match_f"])
         s["vote_bal"] = jnp.where(win, s["bal_max"], s["vote_bal"])
         s["vote_from"] = jnp.where(win, trig, s["vote_from"])
         s["vote_bar"] = jnp.where(win, nslot, s["vote_bar"])
         s["hb_send_cnt"] = jnp.where(win, 0, s["hb_send_cnt"])
+        c.win = win
 
-        # =========== 9. leader proposals (client batch intake)
-        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (s["bal_prepared"] > 0)
-        active_leader = i_am_leader & (s["leader"] == rid)
+    # ========== 9. leader proposals (client batch intake)
+    def _leader_propose(self, s, c):
+        cfg = self.config
+        W = self.W
+        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (
+            s["bal_prepared"] > 0
+        )
+        active_leader = i_am_leader & (s["leader"] == c.rid)
         # ring capacity is bounded by the leader's own exec bar (own window
         # reuse safety); laggards beyond it are healed via SNAPSHOT sends,
         # not by stalling the group (availability > reference's conservative
         # all-peers-executed GC rule).
         n_new, m_new, abs_new, new_vals = client_intake(
-            s, inputs, active_leader, cfg.max_proposals_per_tick, W
+            s, c.inputs, active_leader, cfg.max_proposals_per_tick, W
         )
         s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
         s["win_bal"] = jnp.where(m_new, s["bal_max"][..., None], s["win_bal"])
         s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
         s["next_slot"] = s["next_slot"] + n_new
         s["vote_bar"] = jnp.where(active_leader, s["next_slot"], s["vote_bar"])
+        c.active_leader = active_leader
+        c.n_new, c.m_new = n_new, m_new
 
-        # =========== 10. durability + leader commit tally + exec
-        s["dur_bar"] = advance_durability(s, cfg.dur_lag, frontier="vote_bar")
+    def _exec_gate(self, s, c):
+        """Hook: exec-bar advance (RSPaxos gates it on shard availability)."""
+        s["exec_bar"] = advance_exec(
+            s, c.inputs, self.config.exec_follows_commit
+        )
 
+    # ========== 10. durability + leader commit tally + exec
+    def _advance_bars(self, s, c):
+        R = self.R
+        s["dur_bar"] = advance_durability(
+            s, self.config.dur_lag, frontier="vote_bar"
+        )
         # per-peer ballot-matched frontiers; own column = own durable frontier
         peer_f = jnp.where(
             (s["match_bal"] == s["bal_max"][..., None])
@@ -455,27 +612,39 @@ class MultiPaxosKernel(ProtocolKernel):
         )
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
         peer_f = jnp.where(eye, s["dur_bar"][..., None], peer_f)
-        q_f = kth_largest(peer_f, self.quorum)
+        q_f = kth_largest(peer_f, self.commit_k)
         s["commit_bar"] = jnp.where(
-            active_leader,
+            c.active_leader,
             jnp.clip(q_f, s["commit_bar"], s["next_slot"]),
             s["commit_bar"],
         )
+        self._exec_gate(s, c)
 
-        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
+    def _extra_sends(self, s, c, out, oflags):
+        """Hook: subclass message sends; returns updated oflags."""
+        return oflags
 
-        # =========== 11. build outbox
+    # ========== 11. build outbox
+    def _build_outbox(self, s, c):
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
         out = self.zero_outbox()
         oflags = out["flags"]
         ns_mask = not_self(G, R)
+        active_leader = c.active_leader
 
         # ACCEPT streams: per-peer go-back-N with retry rewind
         stale = (
             active_leader[..., None]
             & ns_mask
-            & (s["next_idx"] > jnp.maximum(s["match_f"], s["prep_trigger"][..., None]))
+            & (
+                s["next_idx"]
+                > jnp.maximum(s["match_f"], s["prep_trigger"][..., None])
+            )
         )
-        s["retry_cnt"] = jnp.where(stale, s["retry_cnt"] - 1, cfg.retry_interval)
+        s["retry_cnt"] = jnp.where(
+            stale, s["retry_cnt"] - 1, cfg.retry_interval
+        )
         rewind = stale & (s["retry_cnt"] <= 0)
         matched_ok = s["match_bal"] == s["bal_max"][..., None]
         s["next_idx"] = jnp.where(
@@ -501,9 +670,7 @@ class MultiPaxosKernel(ProtocolKernel):
         )
 
         snd_lo = s["next_idx"]
-        snd_hi = jnp.minimum(
-            s["next_slot"][..., None], snd_lo + self._chunk
-        )
+        snd_hi = jnp.minimum(s["next_slot"][..., None], snd_lo + self._chunk)
         do_acc = active_leader[..., None] & ns_mask & (snd_hi > snd_lo)
         oflags = oflags | jnp.where(do_acc, jnp.uint32(ACCEPT), 0)
         out["acc_bal"] = jnp.where(do_acc, s["bal_max"][..., None], 0)
@@ -527,14 +694,14 @@ class MultiPaxosKernel(ProtocolKernel):
         out["hb_ebar"] = jnp.where(do_hb, s["exec_bar"][..., None], 0)
 
         # HB_REPLY: to the heartbeat sender
-        do_hbr = hb_reply_to[..., None] & dst_onehot(hb_src, R) & ns_mask
+        do_hbr = c.hb_reply_to[..., None] & dst_onehot(c.hb_src, R) & ns_mask
         oflags = oflags | jnp.where(do_hbr, jnp.uint32(HB_REPLY), 0)
         out["hbr_ebar"] = jnp.where(do_hbr, s["exec_bar"][..., None], 0)
 
         # ACCEPT_REPLY: follower acks its durable frontier to its leader
         is_follower = (
             (s["leader"] >= 0)
-            & (s["leader"] != rid)
+            & (s["leader"] != c.rid)
             & (s["vote_bal"] == s["bal_max"])
             & (s["vote_bal"] > 0)
         )
@@ -543,29 +710,38 @@ class MultiPaxosKernel(ProtocolKernel):
         out["ar_bal"] = jnp.where(do_ar, s["vote_bal"][..., None], 0)
         out["ar_from"] = jnp.where(do_ar, s["vote_from"][..., None], 0)
         out["ar_f"] = jnp.where(do_ar, s["dur_bar"][..., None], 0)
-        do_nack = do_ar & nack[..., None]
+        do_nack = do_ar & c.nack[..., None]
         oflags = oflags | jnp.where(do_nack, jnp.uint32(AR_NACK), 0)
-        out["ar_hint"] = jnp.where(do_nack, nack_hint[..., None], 0)
+        out["ar_hint"] = jnp.where(do_nack, c.nack_hint[..., None], 0)
 
         # PREPARE: candidates campaign every tick (loss-tolerant)
-        do_prp = candidate[..., None] & ns_mask
+        do_prp = c.candidate[..., None] & ns_mask
         oflags = oflags | jnp.where(do_prp, jnp.uint32(PREPARE), 0)
         out["prp_bal"] = jnp.where(do_prp, s["bal_prep_sent"][..., None], 0)
-        out["prp_trigger"] = jnp.where(do_prp, s["prep_trigger"][..., None], 0)
+        out["prp_trigger"] = jnp.where(
+            do_prp, s["prep_trigger"][..., None], 0
+        )
 
         # PREPARE_REPLY: to the campaigner we just promised
-        do_prr = p_ok[..., None] & dst_onehot(p_src, R) & ns_mask
+        do_prr = c.p_ok[..., None] & dst_onehot(c.p_src, R) & ns_mask
         oflags = oflags | jnp.where(do_prr, jnp.uint32(PREPARE_REPLY), 0)
-        out["prr_bal"] = jnp.where(do_prr, p_bal[..., None], 0)
-        out["prr_hi"] = jnp.where(do_prr, prr_hi_out[..., None], 0)
+        out["prr_bal"] = jnp.where(do_prr, c.p_bal[..., None], 0)
+        out["prr_hi"] = jnp.where(do_prr, c.prr_hi_out[..., None], 0)
 
         # broadcast window lanes: voted log content (consumed by both
         # ACCEPT receivers and PREPARE_REPLY adopters)
         out["bw_abs"] = s["win_abs"]
         out["bw_bal"] = s["win_bal"]
         out["bw_val"] = s["win_val"]
-        out["flags"] = oflags
+        out["flags"] = self._extra_sends(s, c, out, oflags)
+        return out
 
+    def _effects_extra(self, s, c) -> dict:
+        """Hook: protocol-specific effects fields."""
+        return {}
+
+    def _effects(self, s, c):
+        R = self.R
         # conservative min-exec over the group (the reference's snap_bar,
         # mod.rs:470-478): the host WAL/payload store may GC below it —
         # every replica has executed those slots
@@ -575,14 +751,12 @@ class MultiPaxosKernel(ProtocolKernel):
             s["peer_exec"],
         )
         snap_bar = jnp.minimum(jnp.min(eye_max, axis=2), s["exec_bar"])
-
-        fx = StepEffects(
-            commit_bar=s["commit_bar"],
-            exec_bar=s["exec_bar"],
-            extra={
-                "n_accepted": n_new,  # per [G, R]; engine masks paused rows
-                "is_leader": active_leader,
-                "snap_bar": snap_bar,
-            },
+        extra = {
+            "n_accepted": c.n_new,  # per [G, R]; engine masks paused rows
+            "is_leader": c.active_leader,
+            "snap_bar": snap_bar,
+        }
+        extra.update(self._effects_extra(s, c))
+        return StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"], extra=extra
         )
-        return s, out, fx

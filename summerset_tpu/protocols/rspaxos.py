@@ -1,0 +1,333 @@
+"""Vectorized RSPaxos: MultiPaxos + Reed-Solomon erasure-coded payloads.
+
+Parity target: reference ``src/protocols/rspaxos/`` (SURVEY.md §2.5) — the
+leader encodes each instance's request batch with RS scheme
+``(d = majority, p = population - majority)`` and sends replica ``r`` only
+shard ``r`` (``rspaxos/mod.rs:597-608``); an instance commits only after
+``majority + fault_tolerance`` Accept acks (``rspaxos/messages.rs:435``);
+a new leader reconstructs voted values from >= ``majority`` distinct shard
+holders in its Prepare quorum, treating shard-starved slots as provably
+uncommitted no-ops once >= ``population - fault_tolerance`` replicas have
+replied (``rspaxos/messages.rs:227-256``); committed-but-shard-starved
+replicas issue Reconstruct reads (``rspaxos/leadership.rs:142-165``,
+``messages.rs:468-560``).
+
+TPU-first redesign on the MultiPaxos lockstep skeleton:
+
+- The device runs the consensus **control plane** only: ``win_val`` stays an
+  int32 reference to the host payload store, which holds the actual
+  RS-coded shards (encode/decode via :class:`summerset_tpu.ops.rscoding
+  .RSCode`'s bit-sliced GF(2^8) Pallas kernel).  What the kernel tracks is
+  *shard availability*: replica ``r`` holding its vote for slot ``s`` means
+  "shard ``r`` of value ``win_val[s]`` is available at ``r``".
+- **Commit tally**: the cumulative-frontier quorum count is simply raised
+  from ``quorum`` to ``quorum + fault_tolerance`` (``commit_k``).
+- **Prepare adoption** cannot take one best sender's lane: a voted value is
+  recoverable only if >= ``d`` distinct senders voted it at the max ballot.
+  The candidate accumulates a per-slot voter bitmap ``prep_voters`` (reset
+  when a higher per-slot ballot appears) across campaign ticks; at step-up
+  a slot is adopted if its voter count reaches ``d``, no-op-filled
+  otherwise.  Step-up therefore requires either every tallied slot to be
+  recoverable, or promises from >= ``population - fault_tolerance``
+  replicas (the reference's two-tier rule).
+- **Execution gating**: a replica executes slot ``s`` only when it can
+  materialize the full value — it tracks a contiguous *full-data frontier*
+  ``full_bar`` (always at the leader, whose proposals carry full batches:
+  the ``[f2_lo, f2_hi)`` leader interval) and fills it at followers with
+  RECON_REQ/RECON_REPLY rounds: a needy replica broadcasts its wanted range
+  and peers reply with the prefix their current (ballot-safe) voting run
+  covers; ``d``-th largest cover across peers advances ``full_bar``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..utils.bitmap import popcount
+from . import register_protocol
+from .common import (
+    NULL_VAL,
+    kth_largest,
+    not_self,
+    range_cover,
+)
+from .multipaxos import (
+    MultiPaxosKernel,
+    ReplicaConfigMultiPaxos,
+)
+
+RECON_REQ = 256    # needy replica -> all: want full data for [rq_lo, rq_hi)
+RECON_REPLY = 512  # peer -> needy: my shards cover [rq_lo, rr_hi)
+
+
+@dataclasses.dataclass
+class ReplicaConfigRSPaxos(ReplicaConfigMultiPaxos):
+    """Extends the MultiPaxos knobs (parity: ``ReplicaConfigRSPaxos``,
+    ``rspaxos/mod.rs:49-110``)."""
+
+    fault_tolerance: int = 0     # extra acks required beyond majority
+    recon_interval: int = 4      # ticks between Reconstruct read rounds
+
+
+@register_protocol("RSPaxos")
+class RSPaxosKernel(MultiPaxosKernel):
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigRSPaxos | None = None,
+    ):
+        config = config or ReplicaConfigRSPaxos()
+        super().__init__(num_groups, population, window, config)
+        # RS scheme (d, p) = (majority, population - majority),
+        # rspaxos/mod.rs:597-608
+        self.num_data = self.quorum
+        self.num_parity = population - self.quorum
+        if config.fault_tolerance > self.num_parity:
+            raise ValueError(
+                f"invalid fault_tolerance {config.fault_tolerance} "
+                f"(max {self.num_parity})"
+            )
+
+    # commit needs majority + fault_tolerance cumulative acks
+    @property
+    def commit_k(self) -> int:
+        return self.quorum + self.config.fault_tolerance
+
+    # ------------------------------------------------------------------ state
+    def _extra_state(self, st, seed):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        st.update(
+            # candidate-side per-slot adoption tally (ring-indexed like win)
+            prep_voters=jnp.zeros((G, R, W), jnp.uint32),
+            prep_pbal=jnp.zeros((G, R, W), i32),
+            prep_pval=jnp.full((G, R, W), NULL_VAL, i32),
+            # full-data frontier + the leader's full interval [f2_lo, f2_hi)
+            full_bar=jnp.zeros((G, R), i32),
+            f2_lo=jnp.zeros((G, R), i32),
+            f2_hi=jnp.zeros((G, R), i32),
+            # reconstruction read bookkeeping
+            recon_cover=jnp.zeros((G, R, R), i32),
+            recon_cnt=jnp.zeros((G, R), i32),
+        )
+        # (a warm-start leader needs no f2 seeding: [0, 0) grows with its
+        # proposals, which carry full batches)
+
+    def _extra_outbox(self, out):
+        G, R = self.G, self.R
+        i32 = jnp.int32
+        out.update(
+            rq_bal=jnp.zeros((G, R, R), i32),
+            rq_lo=jnp.zeros((G, R, R), i32),
+            rq_hi=jnp.zeros((G, R, R), i32),
+            rr_hi=jnp.zeros((G, R, R), i32),
+        )
+
+    # ------------------------------------------------- accept-side additions
+    def _ingest_accept(self, s, c):
+        super()._ingest_accept(s, c)
+        # a run re-based by another proposer invalidates the leader-era
+        # full interval (those slots' values may be superseded)
+        foreign = c.a_new_run & (c.a_src != c.rid)
+        s["f2_lo"] = jnp.where(foreign, s["full_bar"], s["f2_lo"])
+        s["f2_hi"] = jnp.where(foreign, s["full_bar"], s["f2_hi"])
+
+    def _ingest_snapshot(self, s, c):
+        super()._ingest_snapshot(s, c)
+        # install jumps the full-data frontier too (host transfers KV state)
+        s["full_bar"] = jnp.where(
+            c.sn_adv, jnp.maximum(s["full_bar"], c.sn_to), s["full_bar"]
+        )
+        s["f2_lo"] = jnp.where(c.sn_adv, c.sn_to, s["f2_lo"])
+        s["f2_hi"] = jnp.where(c.sn_adv, c.sn_to, s["f2_hi"])
+
+    # --------------------------------------------- prepare-reply accumulation
+    def _ingest_prepare_reply(self, s, c):
+        self._prep_reply_common(s, c)
+        ok = c.pr_ok
+        eff_bal = jnp.where(ok, c.pr_lane_bal, 0)     # [G, R, R_src, W]
+        tick_best = eff_bal.max(axis=2)               # [G, R, W]
+        best_src = eff_bal.argmax(axis=2)[:, :, None, :]
+        tick_val = jnp.take_along_axis(
+            jnp.broadcast_to(c.pr_lane_val, eff_bal.shape), best_src, axis=2
+        )[:, :, 0, :]
+
+        # the tally tracks the max-ballot value per slot, but counts shard
+        # holders BY VALUE, at any ballot: shards of the same value id are
+        # byte-identical regardless of the ballot they were voted at (a
+        # higher-ballot proposal of a committed slot must carry the committed
+        # value), so discarding lower-ballot same-value voters — as the
+        # reference's per-ballot absorb does, rspaxos/messages.rs:185-195 —
+        # would let a partially-propagated re-proposal mask a committed
+        # slot's recoverability and no-op it in the full-quorum tier below
+        higher = tick_best > s["prep_pbal"]
+        new_pbal = jnp.maximum(s["prep_pbal"], tick_best)
+        new_pval = jnp.where(higher, tick_val, s["prep_pval"])
+        src_bits = (jnp.uint32(1) << jnp.arange(
+            self.R, dtype=jnp.uint32
+        ))[None, None, :, None]
+        tick_voters = jnp.where(
+            ok & (c.pr_lane_val == new_pval[:, :, None, :]),
+            src_bits,
+            jnp.uint32(0),
+        ).sum(axis=2, dtype=jnp.uint32)
+        value_kept = ~higher | (tick_val == s["prep_pval"])
+        s["prep_voters"] = (
+            jnp.where(value_kept, s["prep_voters"], jnp.uint32(0))
+            | tick_voters
+        )
+        s["prep_pbal"] = new_pbal
+        s["prep_pval"] = new_pval
+
+    def _on_explode(self, s, c, explode):
+        # seed the tally with the candidate's own voted window
+        W = self.W
+        trig = jnp.where(explode, s["commit_bar"], s["prep_trigger"])
+        _, abs_ad = range_cover(trig, trig + W, W)
+        own_vote = (
+            explode[..., None]
+            & (s["win_abs"] == abs_ad)
+            & (s["win_bal"] > 0)
+        )
+        own_bit = (jnp.uint32(1) << c.rid.astype(jnp.uint32))[..., None]
+        s["prep_voters"] = jnp.where(
+            explode[..., None],
+            jnp.where(own_vote, own_bit, jnp.uint32(0)),
+            s["prep_voters"],
+        )
+        s["prep_pbal"] = jnp.where(
+            explode[..., None], jnp.where(own_vote, s["win_bal"], 0),
+            s["prep_pbal"],
+        )
+        s["prep_pval"] = jnp.where(
+            explode[..., None],
+            jnp.where(own_vote, s["win_val"], NULL_VAL),
+            s["prep_pval"],
+        )
+
+    # -------------------------------------------------- step-up + adoption
+    def _win_condition(self, s, c):
+        W = self.W
+        cfg = self.config
+        trig = s["prep_trigger"]
+        _, abs_ad = range_cover(trig, trig + W, W)
+        tallied = abs_ad < s["prep_hi"][..., None]
+        cnt = popcount(s["prep_voters"])
+        # slot resolvable: untouched, or enough distinct shards to rebuild
+        slot_ok = ~tallied | (s["prep_pbal"] == 0) | (cnt >= self.num_data)
+        acks = popcount(s["prep_acks"])
+        full_quorum = acks >= (self.R - cfg.fault_tolerance)
+        return c.candidate & (
+            (acks >= self.quorum) & slot_ok.all(axis=2) | full_quorum
+        )
+
+    def _adopt_on_win(self, s, c, win, m_re, abs_re):
+        # recoverable slots adopt the tallied value; the rest (including
+        # shard-starved ones, provably uncommitted by the win condition)
+        # become no-ops — all stamped at the new ballot
+        cnt = popcount(s["prep_voters"])
+        recover = m_re & (s["prep_pbal"] > 0) & (cnt >= self.num_data)
+        s["win_val"] = jnp.where(
+            m_re, jnp.where(recover, s["prep_pval"], NULL_VAL), s["win_val"]
+        )
+        s["win_abs"] = jnp.where(m_re, abs_re, s["win_abs"])
+        s["win_bal"] = jnp.where(m_re, s["bal_max"][..., None], s["win_bal"])
+        # the winner reconstructs every adopted value from its quorum's
+        # shards (host-side decode), so its full interval covers the
+        # re-proposed tail; [full_bar, trigger) still heals via recon reads
+        s["f2_lo"] = jnp.where(win, s["prep_trigger"], s["f2_lo"])
+        s["f2_hi"] = jnp.where(win, s["next_slot"], s["f2_hi"])
+
+    def _leader_propose(self, s, c):
+        super()._leader_propose(s, c)
+        # fresh proposals carry full batches at the leader
+        s["f2_hi"] = jnp.where(
+            c.active_leader, jnp.maximum(s["f2_hi"], s["next_slot"]), s["f2_hi"]
+        )
+
+    # ------------------------------------------------- execution gating
+    def _exec_gate(self, s, c):
+        # merge the leader-era full interval into the contiguous frontier
+        s["full_bar"] = jnp.where(
+            s["full_bar"] >= s["f2_lo"],
+            jnp.maximum(s["full_bar"], s["f2_hi"]),
+            s["full_bar"],
+        )
+        if self.config.exec_follows_commit:
+            s["exec_bar"] = jnp.minimum(s["commit_bar"], s["full_bar"])
+        else:
+            s["exec_bar"] = jnp.maximum(
+                s["exec_bar"],
+                jnp.minimum(
+                    jnp.minimum(s["commit_bar"], s["full_bar"]),
+                    c.inputs["exec_floor"].astype(jnp.int32),
+                ),
+            )
+
+    # ------------------------------------------------- reconstruction reads
+    def _extra_sends(self, s, c, out, oflags):
+        R = self.R
+        cfg = self.config
+        ns_mask = not_self(self.G, R)
+        inbox = c.inbox
+
+        # ingest RECON_REPLY: per-peer cover frontiers (monotone; covered
+        # slots are committed so their values never change)
+        rr_valid = (c.flags & RECON_REPLY) != 0
+        s["recon_cover"] = jnp.where(
+            rr_valid,
+            jnp.maximum(s["recon_cover"], inbox["rr_hi"]),
+            s["recon_cover"],
+        )
+        # own shards count within the current ballot-safe run
+        own_cover = jnp.where(
+            s["vote_from"] <= s["full_bar"], s["vote_bar"], s["full_bar"]
+        )
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        cover = jnp.where(eye, own_cover[..., None], s["recon_cover"])
+        d_cover = kth_largest(cover, self.num_data)
+        s["full_bar"] = jnp.clip(
+            jnp.maximum(s["full_bar"], d_cover),
+            s["full_bar"],
+            s["commit_bar"],
+        )
+
+        # send RECON_REQ every recon_interval ticks while starved
+        needy = s["full_bar"] < s["commit_bar"]
+        s["recon_cnt"] = jnp.where(needy, s["recon_cnt"] - 1, cfg.recon_interval)
+        fire = needy & (s["recon_cnt"] <= 0)
+        s["recon_cnt"] = jnp.where(fire, cfg.recon_interval, s["recon_cnt"])
+        do_rq = fire[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_rq, jnp.uint32(RECON_REQ), 0)
+        out["rq_bal"] = jnp.where(do_rq, s["bal_max"][..., None], 0)
+        out["rq_lo"] = jnp.where(do_rq, s["full_bar"][..., None], 0)
+        out["rq_hi"] = jnp.where(do_rq, s["commit_bar"][..., None], 0)
+
+        # serve RECON_REQ: my current run covers [rq_lo, min(rq_hi,
+        # vote_bar)) iff it reaches back to rq_lo and is at a ballot >= the
+        # requester's bal_max (such votes are the committed values below the
+        # requester's commit bar)
+        rq_valid = (c.flags & RECON_REQ) != 0
+        can_serve = (
+            rq_valid
+            & (s["vote_bal"][..., None] >= inbox["rq_bal"])
+            & (s["vote_from"][..., None] <= inbox["rq_lo"])
+        )
+        cover_hi = jnp.where(
+            can_serve,
+            jnp.minimum(inbox["rq_hi"], s["vote_bar"][..., None]),
+            0,
+        )
+        # the inbox is receiver-oriented [G, self, src], so replying to each
+        # requester writes the same [G, self, dst=src] layout the outbox uses
+        do_rr = can_serve & (cover_hi > inbox["rq_lo"]) & ns_mask
+        oflags = oflags | jnp.where(do_rr, jnp.uint32(RECON_REPLY), 0)
+        out["rr_hi"] = jnp.where(do_rr, cover_hi, 0)
+        return oflags
+
+    def _effects_extra(self, s, c):
+        return {"full_bar": s["full_bar"]}
