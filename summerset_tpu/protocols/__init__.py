@@ -41,6 +41,7 @@ def make_protocol(name: str, *args, **kwargs) -> ProtocolKernel:
 
 # import protocol modules for registration side effects
 from . import chain_rep  # noqa: E402,F401
+from . import craft  # noqa: E402,F401
 from . import multipaxos  # noqa: E402,F401
 from . import raft  # noqa: E402,F401
 from . import rep_nothing  # noqa: E402,F401

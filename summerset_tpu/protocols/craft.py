@@ -1,0 +1,301 @@
+"""Vectorized CRaft: Raft + Reed-Solomon erasure coding with full-copy
+fallback.
+
+Parity target: reference ``src/protocols/craft/`` (SURVEY.md §2.5; FAST'20
+CRaft) — the leader erasure-codes each entry with RS scheme
+``(d = majority, p = population - majority)`` and ships replica ``r`` shard
+``r``; a coded entry commits only at ``majority + fault_tolerance`` match
+acks, while a full-copy entry commits at plain ``majority``
+(``craft/messages.rs:307-312``); when more than ``fault_tolerance`` peers
+look dead the leader falls back to full-copy replication
+(``craft/leadership.rs:75-137, 280-287``; the reference latches the switch
+one-way and notes follow-up works propose more gradual mechanisms —
+``craft/mod.rs:280-283``).
+
+TPU-first redesign on the Raft lockstep skeleton:
+
+- **Per-slot mode bits** instead of the reference's global latch: each
+  appended entry is stamped coded/full (``win_full`` + ``bw_full`` lane)
+  from the leader's live peer count at append time, which *is* the "more
+  gradual fallback" the reference's NOTE points at — mode switches
+  per-entry, both directions, and each slot's commit threshold is pinned at
+  propose time so the mixed-mode commit frontier stays well-defined.
+- **Commit frontier with per-slot thresholds**: two cumulative tallies
+  ``f_coded = kth(match, majority + ft)`` and ``f_full = kth(match,
+  majority)``; the commit bar walks forward over window slots while each
+  slot's own threshold is satisfied (a vectorized prefix-scan, not a
+  per-slot loop).
+- **Peer liveness** is a per-peer reply-countdown at the leader (the
+  conservative reply-counter scheme of the reference Heartbeater,
+  ``src/server/heartbeat.rs:244-276``).
+- **Execution gating + reconstruction**: like the RSPaxos kernel, replicas
+  execute only below their full-data frontier ``full_bar``.  Full-copy
+  slots received via AppendEntries are immediately full; coded slots at
+  followers heal via RECON_REQ/RECON_REPLY rounds where peers report both a
+  shard-cover (own shard, k-th largest over ``d`` peers) and a full-cover
+  (their own ``full_bar``, any single peer suffices).  Serving is gated on
+  the *server's own commit bar* — committed prefixes are unique, so shards
+  from different peers are always of the same value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..utils.bitmap import popcount
+from . import register_protocol
+from .common import kth_largest, not_self, range_cover, take_lane
+from .raft import RaftKernel, ReplicaConfigRaft
+
+RECON_REQ = 128    # needy replica -> all: want full data for [rq_lo, rq_hi)
+RECON_REPLY = 256  # peer -> needy: shard cover rr_hi, full cover rr_fhi
+
+
+@dataclasses.dataclass
+class ReplicaConfigCRaft(ReplicaConfigRaft):
+    """Extends the Raft knobs (parity: ``ReplicaConfigCRaft``,
+    ``craft/mod.rs:46-100``)."""
+
+    fault_tolerance: int = 0     # extra acks required for coded commits
+    recon_interval: int = 4      # ticks between Reconstruct read rounds
+    alive_timeout: int = 20      # ticks without any reply -> peer dead
+
+
+@register_protocol("CRaft")
+class CRaftKernel(RaftKernel):
+    broadcast_lanes = frozenset({"bw_abs", "bw_term", "bw_val", "bw_full"})
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigCRaft | None = None,
+    ):
+        config = config or ReplicaConfigCRaft()
+        super().__init__(num_groups, population, window, config)
+        self.num_data = self.quorum
+        self.num_parity = population - self.quorum
+        if config.fault_tolerance > self.num_parity:
+            raise ValueError(
+                f"invalid fault_tolerance {config.fault_tolerance} "
+                f"(max {self.num_parity})"
+            )
+
+    # ------------------------------------------------------------------ state
+    def _extra_state(self, st, seed):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        st.update(
+            win_full=jnp.zeros((G, R, W), jnp.bool_),
+            # leader-side conservative peer liveness countdowns
+            alive_cnt=jnp.full(
+                (G, R, R), self.config.alive_timeout, i32
+            ),
+            # full-data frontier + reconstruction bookkeeping (cf. rspaxos)
+            full_bar=jnp.zeros((G, R), i32),
+            recon_cover=jnp.zeros((G, R, R), i32),
+            recon_fcover=jnp.zeros((G, R, R), i32),
+            recon_cnt=jnp.zeros((G, R), i32),
+        )
+
+    def _extra_outbox(self, out):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        out.update(
+            rq_lo=jnp.zeros((G, R, R), i32),
+            rq_hi=jnp.zeros((G, R, R), i32),
+            rr_hi=jnp.zeros((G, R, R), i32),
+            rr_fhi=jnp.zeros((G, R, R), i32),
+            bw_full=jnp.zeros((G, R, W), jnp.bool_),
+        )
+
+    # ---------------------------------------------------- mode-bit plumbing
+    def _on_ae_write(self, s, c, m_acc, a_src):
+        lane_full = take_lane(c.inbox["bw_full"], a_src)
+        s["win_full"] = jnp.where(m_acc, lane_full, s["win_full"])
+
+    def _append_mode(self, s, c):
+        # full-copy when more than `fault_tolerance` peers look dead
+        # (craft/leadership.rs:280-287); evaluated per append tick, so the
+        # mode heals back to coded once peers return (the own column never
+        # decays — it is refreshed unconditionally in the liveness update)
+        n_dead = jnp.sum((s["alive_cnt"] <= 0).astype(jnp.int32), axis=2)
+        return n_dead > self.config.fault_tolerance
+
+    def _on_append(self, s, c, m_new, mode):
+        s["win_full"] = jnp.where(m_new, mode[..., None], s["win_full"])
+
+    def _leader_append(self, s, c):
+        super()._leader_append(s, c)
+        # while in fallback the leader also re-stamps its *pending* coded
+        # tail [commit_bar, log_end) as full-copy so the whole frontier can
+        # commit at the majority threshold — the per-slot form of the
+        # reference's global-latch switch, which reinterprets every entry at
+        # the full-copy threshold and accepts the documented weakening
+        # against extra failures during the period (craft/leadership.rs:82,
+        # messages.rs:307-312); the leader itself holds full data for the
+        # re-stamped range (it is above own_from or within its full_bar), so
+        # followers heal through its full-cover recon replies
+        mode = self._append_mode(s, c)
+        m, abs_s = range_cover(s["commit_bar"], s["log_end"], self.W)
+        restamp = (
+            (s["is_leader"] & mode)[..., None]
+            & m
+            & (s["win_abs"] == abs_s)
+            # only own-term appends: the leader provably holds their full
+            # batches, so a majority-committed re-stamped slot always has a
+            # full-cover source (stricter than the reference, which accepts
+            # unrecoverable commits in this corner)
+            & (abs_s >= s["own_from"][..., None])
+        )
+        s["win_full"] = s["win_full"] | restamp
+
+    def _try_win(self, s, c):
+        super()._try_win(s, c)
+        # a fresh leader starts from optimistic liveness (coded mode) and
+        # lets the countdown discover dead peers
+        s["alive_cnt"] = jnp.where(
+            c.win[..., None], self.config.alive_timeout, s["alive_cnt"]
+        )
+
+    # --------------------------------------------------- liveness countdown
+    def _ingest_ae_reply(self, s, c):
+        super()._ingest_ae_reply(s, c)
+        # any reply (vote or append) refreshes the sender's liveness
+        heard = c.ar_valid | c.vr_valid | ((c.flags & RECON_REPLY) != 0)
+        eye = jnp.eye(self.R, dtype=jnp.bool_)[None]
+        s["alive_cnt"] = jnp.where(
+            heard | eye,
+            self.config.alive_timeout,
+            s["alive_cnt"] - s["is_leader"][..., None].astype(jnp.int32),
+        )
+
+    # ------------------------------------------- per-slot-threshold commit
+    def _commit_frontier(self, s, c, peer_f):
+        W = self.W
+        f_full = kth_largest(peer_f, self.quorum)
+        f_coded = kth_largest(
+            peer_f, self.quorum + self.config.fault_tolerance
+        )
+        # walk the window from commit_bar: slot a commits iff its own
+        # stamped threshold frontier exceeds a, and all before it did
+        m, abs_s = range_cover(s["commit_bar"], s["log_end"], W)
+        thresh = jnp.where(s["win_full"], f_full[..., None], f_coded[..., None])
+        in_win = s["win_abs"] == abs_s
+        ok = (~m) | (in_win & (thresh > abs_s))
+        # first failing absolute slot = the new commit frontier
+        fail_at = jnp.where(m & ~ok, abs_s, jnp.iinfo(jnp.int32).max)
+        return jnp.minimum(jnp.min(fail_at, axis=2), s["log_end"])
+
+    # ------------------------------------------------- execution gating
+    def _exec_gate(self, s, c):
+        W = self.W
+        # full-copy slots received intact extend the full frontier without
+        # reconstruction; the leader's own appends are always full (it holds
+        # the un-coded batch), coded slots at followers need recon covers
+        eye = jnp.eye(self.R, dtype=jnp.bool_)[None]
+        own_shard_cover = jnp.where(
+            eye, jnp.iinfo(jnp.int32).max, s["recon_cover"]
+        )
+        d_cover = (
+            kth_largest(own_shard_cover, self.num_data)
+            if self.num_data > 1
+            else s["commit_bar"]
+        )
+        f_cover = jnp.max(s["recon_fcover"], axis=2)
+        healed = jnp.maximum(d_cover, f_cover)
+
+        m, abs_s = range_cover(s["full_bar"], s["commit_bar"], W)
+        in_win = s["win_abs"] == abs_s
+        slot_full = (in_win & s["win_full"]) | (abs_s < healed[..., None])
+        ok = (~m) | slot_full
+        fail_at = jnp.where(m & ~ok, abs_s, jnp.iinfo(jnp.int32).max)
+        s["full_bar"] = jnp.clip(
+            jnp.min(fail_at, axis=2),
+            s["full_bar"],
+            s["commit_bar"],
+        )
+        # leaders hold full batches for their own appends [own_from, end)
+        s["full_bar"] = jnp.where(
+            s["is_leader"] & (s["full_bar"] >= s["own_from"]),
+            jnp.maximum(s["full_bar"], s["commit_bar"]),
+            s["full_bar"],
+        )
+        if self.config.exec_follows_commit:
+            s["exec_bar"] = jnp.minimum(s["commit_bar"], s["full_bar"])
+        else:
+            s["exec_bar"] = jnp.maximum(
+                s["exec_bar"],
+                jnp.minimum(
+                    jnp.minimum(s["commit_bar"], s["full_bar"]),
+                    c.inputs["exec_floor"].astype(jnp.int32),
+                ),
+            )
+
+    def _ingest_snapshot(self, s, c):
+        super()._ingest_snapshot(s, c)
+        s["full_bar"] = jnp.where(
+            c.sn_adv, jnp.maximum(s["full_bar"], c.sn_to), s["full_bar"]
+        )
+
+    # ------------------------------------------------- reconstruction reads
+    def _extra_sends(self, s, c, out, oflags):
+        R = self.R
+        cfg = self.config
+        ns_mask = not_self(self.G, R)
+        inbox = c.inbox
+
+        # ingest RECON_REPLY covers (monotone: committed values never change)
+        rr_valid = (c.flags & RECON_REPLY) != 0
+        s["recon_cover"] = jnp.where(
+            rr_valid,
+            jnp.maximum(s["recon_cover"], inbox["rr_hi"]),
+            s["recon_cover"],
+        )
+        s["recon_fcover"] = jnp.where(
+            rr_valid,
+            jnp.maximum(s["recon_fcover"], inbox["rr_fhi"]),
+            s["recon_fcover"],
+        )
+
+        # send RECON_REQ while starved
+        needy = s["full_bar"] < s["commit_bar"]
+        s["recon_cnt"] = jnp.where(
+            needy, s["recon_cnt"] - 1, cfg.recon_interval
+        )
+        fire = needy & (s["recon_cnt"] <= 0)
+        s["recon_cnt"] = jnp.where(fire, cfg.recon_interval, s["recon_cnt"])
+        do_rq = fire[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_rq, jnp.uint32(RECON_REQ), 0)
+        out["rq_lo"] = jnp.where(do_rq, s["full_bar"][..., None], 0)
+        out["rq_hi"] = jnp.where(do_rq, s["commit_bar"][..., None], 0)
+
+        # serve RECON_REQ: below my own commit bar the log is the committed
+        # prefix (unique values), so my shards/full-data there are always
+        # compatible with any other server's
+        rq_valid = (c.flags & RECON_REQ) != 0
+        shard_hi = jnp.minimum(
+            inbox["rq_hi"],
+            jnp.minimum(s["commit_bar"], s["match_bar"])[..., None],
+        )
+        full_hi = jnp.minimum(inbox["rq_hi"], s["full_bar"][..., None])
+        do_rr = (
+            rq_valid
+            & (
+                (shard_hi > inbox["rq_lo"]) | (full_hi > inbox["rq_lo"])
+            )
+            & ns_mask
+        )
+        oflags = oflags | jnp.where(do_rr, jnp.uint32(RECON_REPLY), 0)
+        out["rr_hi"] = jnp.where(do_rr, shard_hi, 0)
+        out["rr_fhi"] = jnp.where(do_rr, full_hi, 0)
+
+        # broadcast mode-bit lane rides with the log content lanes
+        out["bw_full"] = s["win_full"]
+        return oflags
+
+    def _effects_extra(self, s, c):
+        return {"full_bar": s["full_bar"]}

@@ -35,12 +35,17 @@ TPU-first redesign (NOT a port of the tokio event loop):
 - Followers too far behind the leader's ring window receive an
   install-snapshot jump (``SNAPSHOT``), the analog of the reference's
   snapshot transfer; the snapshot body itself lives host-side.
+
+Structure note: like the MultiPaxos kernel, ``step`` is decomposed into
+phase methods with override hooks — CRaft subclasses the append / commit /
+exec phases to add erasure-coded replication with full-copy fallback.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from types import SimpleNamespace
+from typing import Any, Tuple
 
 import jax.numpy as jnp
 
@@ -71,6 +76,7 @@ REQVOTE = 8
 VOTE_REPLY = 16
 VOTE_GRANT = 32   # modifier on VOTE_REPLY
 SNAPSHOT = 64     # install-snapshot: jump a >window-behind follower forward
+# bits 128+ reserved for subclass extensions (craft reconstruction reads)
 
 
 @dataclasses.dataclass
@@ -121,6 +127,13 @@ class RaftKernel(ProtocolKernel):
             raise ValueError("max_proposals_per_tick must be <= window/2")
         self._chunk = min(self.config.chunk_size, window)
 
+    # ------------------------------------------------------- subclass hooks
+    def _extra_state(self, st: dict, seed: int) -> None:
+        """Subclass state fields (added in place)."""
+
+    def _extra_outbox(self, out: dict) -> None:
+        """Subclass outbox fields (added in place)."""
+
     # ------------------------------------------------------------------ init
     def init_state(self, seed: int = 0):
         G, R = self.G, self.R
@@ -168,6 +181,7 @@ class RaftKernel(ProtocolKernel):
             st["voted_for"] = jnp.full((G, R), L, i32)
             st["is_leader"] = is_l
             st["leader"] = jnp.full((G, R), L, i32)
+        self._extra_state(st, seed)
         return st
 
     # ---------------------------------------------------------------- outbox
@@ -175,7 +189,7 @@ class RaftKernel(ProtocolKernel):
         G, R, W = self.G, self.R, self.W
         i32 = jnp.int32
         pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
-        return {
+        out = {
             "flags": jnp.zeros((G, R, R), jnp.uint32),
             "ae_term": pair(), "ae_lo": pair(), "ae_hi": pair(),
             "ae_prev": pair(), "ae_cbar": pair(),
@@ -188,28 +202,41 @@ class RaftKernel(ProtocolKernel):
             "bw_term": jnp.zeros((G, R, W), i32),
             "bw_val": jnp.zeros((G, R, W), i32),
         }
+        self._extra_outbox(out)
+        return out
 
     # ------------------------------------------------------------------ step
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
-        G, R, W = self.G, self.R, self.W
-        cfg = self.config
-        i32 = jnp.int32
         s = dict(state)
-        flags = inbox["flags"]
-        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
-        src_bits = (jnp.uint32(1) << jnp.arange(R, dtype=jnp.uint32))[
+        c = SimpleNamespace(inbox=inbox, inputs=inputs, flags=inbox["flags"])
+        c.rid = jnp.broadcast_to(
+            jnp.arange(self.R, dtype=jnp.int32)[None, :], (self.G, self.R)
+        )
+        c.src_bits = (jnp.uint32(1) << jnp.arange(self.R, dtype=jnp.uint32))[
             None, None, :
         ]
-
-        def best_by(bit, field):
-            return best_by_ballot(flags, bit, field)
-
-        s["rng"], reload = prng.uniform_int(
-            s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
+        s["rng"], c.reload = prng.uniform_int(
+            s["rng"], self.config.hear_timeout_lo, self.config.hear_timeout_hi
         )
+        self._ingest_reqvote(s, c)
+        self._ingest_vote_reply(s, c)
+        self._ingest_ae(s, c)
+        self._ingest_snapshot(s, c)
+        self._ingest_ae_reply(s, c)
+        self._election(s, c)
+        self._try_win(s, c)
+        self._leader_append(s, c)
+        self._advance_bars(s, c)
+        out = self._build_outbox(s, c)
+        fx = self._effects(s, c)
+        return s, out, fx
 
-        # =========== 1. REQVOTE ingest (vote granting; may bump term)
-        rv_ok, rv_term, rv_src = best_by(REQVOTE, inbox["rv_term"])
+    # ========== 1. REQVOTE ingest (vote granting; may bump term)
+    def _ingest_reqvote(self, s, c):
+        inbox = c.inbox
+        rv_ok, rv_term, rv_src = best_by_ballot(
+            c.flags, REQVOTE, inbox["rv_term"]
+        )
         higher = rv_ok & (rv_term > s["term"])
         s["voted_for"] = jnp.where(higher, -1, s["voted_for"])
         s["is_leader"] &= ~higher
@@ -231,27 +258,35 @@ class RaftKernel(ProtocolKernel):
             & ~s["is_leader"]
         )
         s["voted_for"] = jnp.where(can_vote, rv_src, s["voted_for"])
-        s["hb_cnt"] = jnp.where(can_vote, reload, s["hb_cnt"])
+        s["hb_cnt"] = jnp.where(can_vote, c.reload, s["hb_cnt"])
+        c.rv_ok, c.rv_src, c.can_vote = rv_ok, rv_src, can_vote
 
-        # =========== 2. VOTE_REPLY ingest (candidate tally)
-        vr_valid = (flags & VOTE_REPLY) != 0
+    # ========== 2. VOTE_REPLY ingest (candidate tally)
+    def _ingest_vote_reply(self, s, c):
+        vr_valid = (c.flags & VOTE_REPLY) != 0
         vr_grant = (
             vr_valid
-            & ((flags & VOTE_GRANT) != 0)
-            & (inbox["vr_term"] == s["term"][..., None])
+            & ((c.flags & VOTE_GRANT) != 0)
+            & (c.inbox["vr_term"] == s["term"][..., None])
         )
         s["grants"] = s["grants"] | jnp.where(
-            vr_grant, src_bits, jnp.uint32(0)
+            vr_grant, c.src_bits, jnp.uint32(0)
         ).sum(axis=2, dtype=jnp.uint32)
+        c.vr_valid = vr_valid
 
-        # =========== 3. AE ingest (prev-check, entry write, commit notice)
-        a_ok, a_term, a_src = best_by(AE, inbox["ae_term"])
+    def _on_ae_write(self, s, c, m_acc, a_src):
+        """Hook: extra per-slot lanes copied on an applied AE range."""
+
+    # ========== 3. AE ingest (prev-check, entry write, commit notice)
+    def _ingest_ae(self, s, c):
+        W = self.W
+        inbox = c.inbox
+        a_ok, a_term, a_src = best_by_ballot(c.flags, AE, inbox["ae_term"])
         a_ok &= a_term >= s["term"]
         # a leader never yields to an equal-term AE (impossible by election
         # safety); a candidate at the same term steps down to the winner
         a_ok &= (a_term > s["term"]) | ~s["is_leader"]
-        old_term = s["term"]
-        newterm = a_ok & (a_term > old_term)
+        newterm = a_ok & (a_term > s["term"])
         s["voted_for"] = jnp.where(newterm, -1, s["voted_for"])
         s["term"] = jnp.where(a_ok, a_term, s["term"])
         # certified-match frontier resets to the committed prefix whenever
@@ -265,7 +300,7 @@ class RaftKernel(ProtocolKernel):
         s["is_leader"] &= ~a_ok
         s["cand_term"] = jnp.where(a_ok, -1, s["cand_term"])
         s["leader"] = jnp.where(a_ok, a_src, s["leader"])
-        s["hb_cnt"] = jnp.where(a_ok, reload, s["hb_cnt"])
+        s["hb_cnt"] = jnp.where(a_ok, c.reload, s["hb_cnt"])
 
         a_lo = take_src(inbox["ae_lo"], a_src)
         a_hi = take_src(inbox["ae_hi"], a_src)
@@ -282,10 +317,10 @@ class RaftKernel(ProtocolKernel):
         gap = a_ok & (a_lo > s["log_end"])
         acc = a_ok & ~gap & prev_ok
         rej = a_ok & ~gap & ~prev_ok
-        nack = gap | rej
+        c.nack = gap | rej
         # conflict backtrack hint: log_end for past-the-end, commit_bar for
         # term mismatch (one-shot rewind; the committed prefix always matches)
-        nack_hint = jnp.where(gap, s["log_end"], s["commit_bar"])
+        c.nack_hint = jnp.where(gap, s["log_end"], s["commit_bar"])
 
         m_acc, abs_acc = range_cover(a_lo, a_hi, W)
         m_acc &= acc[..., None]
@@ -301,14 +336,13 @@ class RaftKernel(ProtocolKernel):
         s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
         s["win_term"] = jnp.where(m_acc, lane_term, s["win_term"])
         s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
+        self._on_ae_write(s, c, m_acc, a_src)
         # Raft truncation rule: a conflicting entry and all that follow are
         # deleted; the written range replaces them, so log_end = hi on
         # conflict, else extend-only
         s["log_end"] = jnp.where(
             acc,
-            jnp.where(
-                any_conflict, a_hi, jnp.maximum(s["log_end"], a_hi)
-            ),
+            jnp.where(any_conflict, a_hi, jnp.maximum(s["log_end"], a_hi)),
             s["log_end"],
         )
         s["dur_bar"] = jnp.minimum(s["dur_bar"], s["log_end"])
@@ -334,9 +368,14 @@ class RaftKernel(ProtocolKernel):
             ),
             s["last_term"],
         )
+        c.a_ok, c.a_src, c.a_acc = a_ok, a_src, acc
 
-        # =========== 3b. SNAPSHOT ingest (install: jump forward)
-        sn_ok, sn_term, sn_src = best_by(SNAPSHOT, inbox["snp_term"])
+    # ========== 3b. SNAPSHOT ingest (install: jump forward)
+    def _ingest_snapshot(self, s, c):
+        inbox = c.inbox
+        sn_ok, sn_term, sn_src = best_by_ballot(
+            c.flags, SNAPSHOT, inbox["snp_term"]
+        )
         sn_ok &= sn_term >= s["term"]
         sn_ok &= (sn_term > s["term"]) | ~s["is_leader"]
         sn_to = take_src(inbox["snp_to"], sn_src)
@@ -354,7 +393,7 @@ class RaftKernel(ProtocolKernel):
         s["is_leader"] &= ~sn_ok
         s["cand_term"] = jnp.where(sn_ok, -1, s["cand_term"])
         s["leader"] = jnp.where(sn_ok, sn_src, s["leader"])
-        s["hb_cnt"] = jnp.where(sn_ok, reload, s["hb_cnt"])
+        s["hb_cnt"] = jnp.where(sn_ok, c.reload, s["hb_cnt"])
         sn_adv = sn_ok & (sn_to > s["commit_bar"])
         s["commit_bar"] = jnp.where(sn_adv, sn_to, s["commit_bar"])
         s["exec_bar"] = jnp.where(
@@ -373,9 +412,13 @@ class RaftKernel(ProtocolKernel):
         stale_win = sn_adv[..., None] & (s["win_abs"] < sn_to[..., None])
         s["win_abs"] = jnp.where(stale_win, NO_SLOT, s["win_abs"])
         s["win_term"] = jnp.where(stale_win, 0, s["win_term"])
+        c.sn_ok, c.sn_adv, c.sn_to = sn_ok, sn_adv, sn_to
 
-        # =========== 4. AE_REPLY ingest (leader match bookkeeping)
-        ar_valid = (flags & AE_REPLY) != 0
+    # ========== 4. AE_REPLY ingest (leader match bookkeeping + step-down)
+    def _ingest_ae_reply(self, s, c):
+        cfg = self.config
+        inbox = c.inbox
+        ar_valid = (c.flags & AE_REPLY) != 0
         ar_mine = (
             ar_valid
             & (inbox["ar_term"] == s["term"][..., None])
@@ -385,7 +428,7 @@ class RaftKernel(ProtocolKernel):
         s["match_f"] = jnp.where(
             ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"]
         )
-        ar_nacked = ar_mine & ((flags & AR_NACK) != 0)
+        ar_nacked = ar_mine & ((c.flags & AR_NACK) != 0)
         s["next_idx"] = jnp.where(
             ar_nacked,
             jnp.minimum(s["next_idx"], inbox["ar_hint"]),
@@ -399,10 +442,11 @@ class RaftKernel(ProtocolKernel):
             jnp.maximum(s["peer_exec"], inbox["ar_ebar"]),
             s["peer_exec"],
         )
+        c.ar_valid, c.ar_mine = ar_valid, ar_mine
 
         # higher terms piggybacked on replies force step-down
         reply_tmax = jnp.maximum(
-            jnp.max(jnp.where(vr_valid, inbox["vr_term"], 0), axis=2),
+            jnp.max(jnp.where(c.vr_valid, inbox["vr_term"], 0), axis=2),
             jnp.max(jnp.where(ar_valid, inbox["ar_term"], 0), axis=2),
         )
         stepdown = reply_tmax > s["term"]
@@ -412,10 +456,11 @@ class RaftKernel(ProtocolKernel):
         s["cand_term"] = jnp.where(stepdown, -1, s["cand_term"])
         s["match_bar"] = jnp.where(stepdown, s["commit_bar"], s["match_bar"])
 
-        # =========== 5. election timeout -> campaign
-        s["hb_cnt"] = jnp.where(
-            s["is_leader"], s["hb_cnt"], s["hb_cnt"] - 1
-        )
+    # ========== 5. election timeout -> campaign
+    def _election(self, s, c):
+        W = self.W
+        rid = c.rid
+        s["hb_cnt"] = jnp.where(s["is_leader"], s["hb_cnt"], s["hb_cnt"] - 1)
         # viability guard (cf. multipaxos `viable`): a replica whose log tail
         # already fills its ring window could never append the current-term
         # entry the commit rule needs (space stays 0) — it skips candidacy
@@ -428,21 +473,21 @@ class RaftKernel(ProtocolKernel):
         s["voted_for"] = jnp.where(explode, rid, s["voted_for"])
         s["cand_term"] = jnp.where(explode, s["term"], s["cand_term"])
         s["grants"] = jnp.where(
-            explode,
-            jnp.uint32(1) << rid.astype(jnp.uint32),
-            s["grants"],
+            explode, jnp.uint32(1) << rid.astype(jnp.uint32), s["grants"]
         )
         s["leader"] = jnp.where(explode, -1, s["leader"])
         s["rng"], reload2 = prng.uniform_int(
-            s["rng"], cfg.hear_timeout_lo, cfg.hear_timeout_hi
+            s["rng"], self.config.hear_timeout_lo, self.config.hear_timeout_hi
         )
         s["hb_cnt"] = jnp.where(timer_out, reload2, s["hb_cnt"])
-        candidate = ~s["is_leader"] & (s["cand_term"] == s["term"])
+        c.candidate = ~s["is_leader"] & (s["cand_term"] == s["term"])
 
-        # =========== 6. candidate -> leader on vote quorum
-        win = candidate & (popcount(s["grants"]) >= self.quorum)
+    # ========== 6. candidate -> leader on vote quorum
+    def _try_win(self, s, c):
+        cfg = self.config
+        win = c.candidate & (popcount(s["grants"]) >= self.quorum)
         s["is_leader"] |= win
-        s["leader"] = jnp.where(win, rid, s["leader"])
+        s["leader"] = jnp.where(win, c.rid, s["leader"])
         s["own_from"] = jnp.where(win, s["log_end"], s["own_from"])
         s["match_bar"] = jnp.where(win, s["log_end"], s["match_bar"])
         s["next_idx"] = jnp.where(
@@ -453,11 +498,24 @@ class RaftKernel(ProtocolKernel):
             win[..., None], cfg.retry_interval, s["retry_cnt"]
         )
         s["hb_send_cnt"] = jnp.where(win, 0, s["hb_send_cnt"])
-        candidate &= ~win
+        c.candidate &= ~win
+        c.win = win
 
-        # =========== 7. leader appends: term no-op, then client proposals
+    def _append_mode(self, s, c):
+        """Hook: per-slot replication mode stamp for new appends (CRaft)."""
+        return None
+
+    def _on_append(self, s, c, m_new, mode):
+        """Hook: extra per-slot lanes written on leader appends."""
+
+    # ========== 7. leader appends: term no-op, then client proposals
+    def _leader_append(self, s, c):
+        W = self.W
+        cfg = self.config
+        i32 = jnp.int32
         lead = s["is_leader"]
         space = jnp.maximum(s["exec_bar"] + W - s["log_end"], 0)
+        mode = self._append_mode(s, c)
         # current-term no-op: a fresh leader with an uncommitted predecessor
         # tail appends one no-op so the commit rule (q_f > own_from) can fire
         # even with zero client load (standard Raft practice; the reference
@@ -473,52 +531,72 @@ class RaftKernel(ProtocolKernel):
         s["win_abs"] = jnp.where(m_np, abs_np, s["win_abs"])
         s["win_term"] = jnp.where(m_np, s["term"][..., None], s["win_term"])
         s["win_val"] = jnp.where(m_np, NULL_VAL, s["win_val"])
+        self._on_append(s, c, m_np, mode)
         s["log_end"] = s["log_end"] + n_noop
         s["last_term"] = jnp.where(need_noop, s["term"], s["last_term"])
         n_new, m_new, abs_new, new_vals = client_intake(
-            s, inputs, lead, cfg.max_proposals_per_tick, W,
+            s, c.inputs, lead, cfg.max_proposals_per_tick, W,
             frontier="log_end",
         )
         s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
         s["win_term"] = jnp.where(m_new, s["term"][..., None], s["win_term"])
         s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
+        self._on_append(s, c, m_new, mode)
         s["log_end"] = s["log_end"] + n_new
         s["last_term"] = jnp.where(n_new > 0, s["term"], s["last_term"])
         s["match_bar"] = jnp.where(lead, s["log_end"], s["match_bar"])
+        c.n_new = n_new
 
-        # =========== 8. durability + leader commit tally + exec
-        s["dur_bar"] = advance_durability(s, cfg.dur_lag, frontier="log_end")
+    def _commit_frontier(self, s, c, peer_f):
+        """Hook: quorum-tally frontier from durably-acked match frontiers."""
+        return kth_largest(peer_f, self.quorum)
 
+    def _exec_gate(self, s, c):
+        """Hook: exec-bar advance (CRaft gates on shard availability)."""
+        s["exec_bar"] = advance_exec(
+            s, c.inputs, self.config.exec_follows_commit
+        )
+
+    # ========== 8. durability + leader commit tally + exec
+    def _advance_bars(self, s, c):
+        R = self.R
+        s["dur_bar"] = advance_durability(
+            s, self.config.dur_lag, frontier="log_end"
+        )
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        c.eye = eye
         peer_f = jnp.where(eye, s["dur_bar"][..., None], s["match_f"])
-        q_f = kth_largest(peer_f, self.quorum)
+        q_f = self._commit_frontier(s, c, peer_f)
         # commit-only-current-term: at least one own-term entry replicated
-        can_commit = lead & (q_f > s["own_from"])
+        can_commit = s["is_leader"] & (q_f > s["own_from"])
         s["commit_bar"] = jnp.where(
             can_commit,
             jnp.clip(q_f, s["commit_bar"], s["log_end"]),
             s["commit_bar"],
         )
+        self._exec_gate(s, c)
 
-        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
+    def _extra_sends(self, s, c, out, oflags):
+        """Hook: subclass message sends; returns updated oflags."""
+        return oflags
 
-        # =========== 9. build outbox
+    # ========== 9. build outbox
+    def _build_outbox(self, s, c):
+        G, R, W = self.G, self.R, self.W
+        cfg = self.config
         out = self.zero_outbox()
         oflags = out["flags"]
         ns_mask = not_self(G, R)
+        lead = s["is_leader"]
 
         # AE streams: go-back-N with retry rewind
-        stale = (
-            lead[..., None] & ns_mask & (s["next_idx"] > s["match_f"])
-        )
+        stale = lead[..., None] & ns_mask & (s["next_idx"] > s["match_f"])
         s["retry_cnt"] = jnp.where(
             stale, s["retry_cnt"] - 1, cfg.retry_interval
         )
         rewind = stale & (s["retry_cnt"] <= 0)
         s["next_idx"] = jnp.where(rewind, s["match_f"], s["next_idx"])
-        s["retry_cnt"] = jnp.where(
-            rewind, cfg.retry_interval, s["retry_cnt"]
-        )
+        s["retry_cnt"] = jnp.where(rewind, cfg.retry_interval, s["retry_cnt"])
 
         # peers fallen below the ring window get an install-snapshot jump
         too_behind = (
@@ -577,7 +655,7 @@ class RaftKernel(ProtocolKernel):
 
         # AE_REPLY: follower acks its durable certified frontier
         is_follower = (
-            (s["leader"] >= 0) & (s["leader"] != rid) & ~s["is_leader"]
+            (s["leader"] >= 0) & (s["leader"] != c.rid) & ~s["is_leader"]
         )
         do_ar = is_follower[..., None] & dst_onehot(s["leader"], R) & ns_mask
         oflags = oflags | jnp.where(do_ar, jnp.uint32(AE_REPLY), 0)
@@ -588,22 +666,22 @@ class RaftKernel(ProtocolKernel):
             0,
         )
         out["ar_ebar"] = jnp.where(do_ar, s["exec_bar"][..., None], 0)
-        do_nack = do_ar & nack[..., None]
+        do_nack = do_ar & c.nack[..., None]
         oflags = oflags | jnp.where(do_nack, jnp.uint32(AR_NACK), 0)
-        out["ar_hint"] = jnp.where(do_nack, nack_hint[..., None], 0)
+        out["ar_hint"] = jnp.where(do_nack, c.nack_hint[..., None], 0)
 
         # REQVOTE: candidates campaign every tick (loss-tolerant)
-        do_rv = candidate[..., None] & ns_mask
+        do_rv = c.candidate[..., None] & ns_mask
         oflags = oflags | jnp.where(do_rv, jnp.uint32(REQVOTE), 0)
         out["rv_term"] = jnp.where(do_rv, s["term"][..., None], 0)
         out["rv_lidx"] = jnp.where(do_rv, s["log_end"][..., None], 0)
         out["rv_lterm"] = jnp.where(do_rv, s["last_term"][..., None], 0)
 
         # VOTE_REPLY: to the candidate we just heard (grant bit if granted)
-        do_vr = rv_ok[..., None] & dst_onehot(rv_src, R) & ns_mask
+        do_vr = c.rv_ok[..., None] & dst_onehot(c.rv_src, R) & ns_mask
         oflags = oflags | jnp.where(do_vr, jnp.uint32(VOTE_REPLY), 0)
         oflags = oflags | jnp.where(
-            do_vr & can_vote[..., None], jnp.uint32(VOTE_GRANT), 0
+            do_vr & c.can_vote[..., None], jnp.uint32(VOTE_GRANT), 0
         )
         out["vr_term"] = jnp.where(do_vr, s["term"][..., None], 0)
 
@@ -611,21 +689,25 @@ class RaftKernel(ProtocolKernel):
         out["bw_abs"] = s["win_abs"]
         out["bw_term"] = s["win_term"]
         out["bw_val"] = s["win_val"]
-        out["flags"] = oflags
+        out["flags"] = self._extra_sends(s, c, out, oflags)
+        return out
 
+    def _effects_extra(self, s, c) -> dict:
+        return {}
+
+    def _effects(self, s, c):
+        R = self.R
         # conservative min-exec over the group (snap_bar GC rule)
         eye_max = jnp.where(
-            eye, jnp.iinfo(jnp.int32).max, s["peer_exec"]
+            c.eye, jnp.iinfo(jnp.int32).max, s["peer_exec"]
         )
         snap_bar = jnp.minimum(jnp.min(eye_max, axis=2), s["exec_bar"])
-
-        fx = StepEffects(
-            commit_bar=s["commit_bar"],
-            exec_bar=s["exec_bar"],
-            extra={
-                "n_accepted": n_new,
-                "is_leader": s["is_leader"] & (s["leader"] == rid),
-                "snap_bar": snap_bar,
-            },
+        extra = {
+            "n_accepted": c.n_new,
+            "is_leader": s["is_leader"] & (s["leader"] == c.rid),
+            "snap_bar": snap_bar,
+        }
+        extra.update(self._effects_extra(s, c))
+        return StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"], extra=extra
         )
-        return s, out, fx
