@@ -43,6 +43,7 @@ def make_protocol(name: str, *args, **kwargs) -> ProtocolKernel:
 from . import chain_rep  # noqa: E402,F401
 from . import craft  # noqa: E402,F401
 from . import multipaxos  # noqa: E402,F401
+from . import quorum_leases  # noqa: E402,F401
 from . import raft  # noqa: E402,F401
 from . import rep_nothing  # noqa: E402,F401
 from . import rspaxos  # noqa: E402,F401

@@ -270,12 +270,18 @@ class MultiPaxosKernel(ProtocolKernel):
         c.hb_ok, c.hb_bal, c.hb_src = hb_ok, hb_bal, hb_src
         c.hb_reply_to = hb_ok
 
+    def _vote_gate(self, s, c, p_bal, p_src):
+        """Hook: extra veto on granting a Prepare promise (leader leases
+        refuse votes for challengers while the promise countdown runs)."""
+        return jnp.ones((self.G, self.R), jnp.bool_)
+
     # ========== 2. PREPARE ingest (promise + voted-window reply)
     def _ingest_prepare(self, s, c):
         p_ok, p_bal, p_src = best_by_ballot(
             c.flags, PREPARE, c.inbox["prp_bal"]
         )
         p_ok &= p_bal >= s["bal_max"]
+        p_ok &= self._vote_gate(s, c, p_bal, p_src)
         s["bal_max"] = jnp.where(p_ok, p_bal, s["bal_max"])
         s["leader"] = jnp.where(p_ok, p_src, s["leader"])
         # also reset the election countdown: someone is actively campaigning
@@ -355,6 +361,7 @@ class MultiPaxosKernel(ProtocolKernel):
         s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
         s["win_bal"] = jnp.where(m_acc, a_bal[..., None], s["win_bal"])
         s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
+        self._on_accept_write(s, c, m_acc, a_src)
 
         s["vote_from"] = jnp.where(
             new_run,
@@ -481,9 +488,22 @@ class MultiPaxosKernel(ProtocolKernel):
         s["win_abs"] = jnp.where(adopt, abs_ad, s["win_abs"])
         s["win_bal"] = jnp.where(adopt, best_bal, s["win_bal"])
         s["win_val"] = jnp.where(adopt, best_val, s["win_val"])
+        self._on_adopt(s, c, adopt, best_src)
+
+    def _on_accept_write(self, s, c, m_acc, a_src):
+        """Hook: extra per-slot lanes copied on an applied Accept range."""
+
+    def _on_adopt(self, s, c, adopt, best_src):
+        """Hook: extra per-slot lanes adopted from the best prepare-reply
+        sender (``best_src`` is ``[G, R, 1, W]`` for take_along_axis)."""
 
     def _on_explode(self, s, c, explode):
         """Hook: candidate-side bookkeeping at campaign start."""
+
+    def _campaign_gate(self, s, c):
+        """Hook: extra veto on starting a campaign (own outstanding
+        promises must lapse before campaigning at a higher ballot)."""
+        return jnp.ones((self.G, self.R), jnp.bool_)
 
     # ========== 7. election timeout -> campaign
     def _election(self, s, c):
@@ -500,7 +520,12 @@ class MultiPaxosKernel(ProtocolKernel):
         # it cannot hold) — it skips candidacy without inflating its ballot,
         # staying receptive to the current leader's backfill/snapshot heal
         viable = c.voted_extent - s["commit_bar"] <= W
-        explode = (~active_leader) & (s["hb_cnt"] <= 0) & viable
+        explode = (
+            (~active_leader)
+            & (s["hb_cnt"] <= 0)
+            & viable
+            & self._campaign_gate(s, c)
+        )
         timer_out = (~active_leader) & (s["hb_cnt"] <= 0)
         new_bal = make_greater_ballot(s["bal_max"], rid)
         s["bal_max"] = jnp.where(explode, new_bal, s["bal_max"])
@@ -612,13 +637,21 @@ class MultiPaxosKernel(ProtocolKernel):
         )
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
         peer_f = jnp.where(eye, s["dur_bar"][..., None], peer_f)
-        q_f = kth_largest(peer_f, self.commit_k)
+        q_f = jnp.minimum(
+            kth_largest(peer_f, self.commit_k),
+            self._commit_cap(s, c, peer_f),
+        )
         s["commit_bar"] = jnp.where(
             c.active_leader,
             jnp.clip(q_f, s["commit_bar"], s["next_slot"]),
             s["commit_bar"],
         )
         self._exec_gate(s, c)
+
+    def _commit_cap(self, s, c, peer_f):
+        """Hook: extra cap on the commit frontier (quorum-lease write
+        barriers cap it at unacked leased responders' frontiers)."""
+        return jnp.full((self.G, self.R), jnp.iinfo(jnp.int32).max)
 
     def _extra_sends(self, s, c, out, oflags):
         """Hook: subclass message sends; returns updated oflags."""

@@ -1,0 +1,331 @@
+"""Vectorized QuorumLeases: MultiPaxos + quorum read leases on a
+configurable responder set.
+
+Parity target: reference ``src/protocols/quorum_leases/`` (SURVEY.md §2.5;
+the CMU Quorum-Read-Leases design) — clients install a responders config
+through consensus (``quorumconf.rs``, driven by ``ConfChange`` requests);
+lease-holding responders serve reads locally when quiescent
+(``quorumlease.rs:10-17`` ``is_local_reader``); writes must be acked by
+*all* lease-holding responders before committing (``commit_condition``,
+``quorumlease.rs:22-42``); and a second lease plane keeps the leader stable
+(dual ``LeaseManager``s, lease gids 0/1).  The reference's guard/promise/
+revoke clock-free lease machinery (``src/server/leaseman.rs:122-131``)
+becomes counter arithmetic in lockstep ticks:
+
+- a grantor's countdown starts ``lease_margin`` ticks longer than the
+  length it granted, so every holder-side expiry strictly precedes its
+  grantor-side expiry as long as ``lease_margin > max network delay`` —
+  the same role ``T_guard`` plays against unbounded in-flight time;
+- revocation is passive (stop refreshing, wait out the countdown), which
+  is the reference's expire path; explicit revoke round-trips are not
+  needed because the barrier math (not the wire) enforces safety.
+
+Kernel semantics on the MultiPaxos lockstep skeleton:
+
+- **Responder conf changes ride the log**: a conf entry (``win_cfg`` lane,
+  value = responders bitmap) is proposed by the leader from the
+  ``conf_target`` host input and applied when executed — the analog of the
+  reference's ``ConfChange -> quorumconf`` flow.
+- **Quorum leases are leader-granted, epoch-bounded**: the leader refreshes
+  grants to conf responders whose matched frontier reaches its commit bar;
+  a new leader conservatively assumes every peer may hold an outstanding
+  lease (full ``ql_out`` reset at step-up) until countdowns lapse.
+- **Write barrier**: the commit frontier is capped at the matched frontier
+  of every possibly-leased responder (``_commit_cap``), the frontier form
+  of "writes ack all grantees".
+- **Local reads**: a leased responder serves key buckets with no pending
+  write in its own log tail ``[exec_bar, vote frontier)`` — key buckets are
+  ``value_id % num_key_buckets`` (the host hashes real keys to buckets).
+- **Leader leases**: followers promise the heartbeat sender vote-refusal
+  for ``leader_lease_len`` ticks; the leader counts confirmed promises from
+  heartbeat replies (shortened by ``lease_margin``) and may serve local
+  reads while a quorum holds — reference ``leaderlease.rs:10-21``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..utils.bitmap import popcount
+from . import register_protocol
+from .common import range_cover
+from .multipaxos import HB_REPLY, MultiPaxosKernel, ReplicaConfigMultiPaxos
+
+GRANT = 1024  # quorum-lease grant/refresh: leader -> responder
+
+
+@dataclasses.dataclass
+class ReplicaConfigQuorumLeases(ReplicaConfigMultiPaxos):
+    """Extends the MultiPaxos knobs (parity: ``ReplicaConfigQuorumLeases``,
+    ``quorum_leases/mod.rs``)."""
+
+    lease_len: int = 12          # quorum-lease length granted (ticks)
+    alive_timeout: int = 10      # ticks without a reply -> stop refreshing
+    leader_lease_len: int = 12   # follower vote-refusal promise (ticks)
+    lease_margin: int = 4        # grantor-side slack; must exceed the
+                                 # network's max one-way delay in ticks
+    grant_interval: int = 4      # lease refresh period (ticks)
+    num_key_buckets: int = 8     # key-hash buckets for quiescence checks
+    init_responders: int = 0     # initial responders bitmap (0 = none)
+    enable_leader_leases: bool = True
+
+
+@register_protocol("QuorumLeases")
+class QuorumLeasesKernel(MultiPaxosKernel):
+    broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val", "bw_cfg"})
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigQuorumLeases | None = None,
+    ):
+        config = config or ReplicaConfigQuorumLeases()
+        super().__init__(num_groups, population, window, config)
+        if config.hear_timeout_lo <= config.leader_lease_len:
+            raise ValueError(
+                "hear_timeout_lo must exceed leader_lease_len (a follower "
+                "must outwait its own promise before campaigning)"
+            )
+
+    # ------------------------------------------------------------------ state
+    def _extra_state(self, st, seed):
+        G, R = self.G, self.R
+        i32 = jnp.int32
+        cfg = self.config
+        st.update(
+            win_cfg=jnp.zeros((G, R, self.W), jnp.bool_),
+            conf_cur=jnp.full((G, R), cfg.init_responders, i32),
+            conf_slot=jnp.full((G, R), -1, i32),
+            conf_prop=jnp.full((G, R), -1, i32),
+            # quorum-lease countdowns: grantor (leader) and holder sides
+            ql_out=jnp.zeros((G, R, R), i32),
+            ql_left=jnp.zeros((G, R), i32),
+            grant_cnt=jnp.zeros((G, R), i32),
+            # leader-lease countdowns: holder (follower promise) and the
+            # leader's confirmed view per peer
+            ll_left=jnp.zeros((G, R), i32),
+            ll_in=jnp.zeros((G, R, R), i32),
+            # reply-based peer liveness: a dead responder must stop
+            # receiving grant refreshes or the leader's own barrier
+            # countdown never lapses
+            alive_cnt=jnp.full((G, R, R), cfg.alive_timeout, i32),
+        )
+
+    def _extra_outbox(self, out):
+        G, R, W = self.G, self.R, self.W
+        out.update(
+            gr_len=jnp.zeros((G, R, R), jnp.int32),
+            bw_cfg=jnp.zeros((G, R, W), jnp.bool_),
+        )
+
+    # ------------------------------------------------------ lane plumbing
+    def _on_accept_write(self, s, c, m_acc, a_src):
+        G = self.G
+        lane_cfg = c.inbox["bw_cfg"][jnp.arange(G)[:, None], a_src]
+        s["win_cfg"] = jnp.where(m_acc, lane_cfg, s["win_cfg"])
+
+    def _on_adopt(self, s, c, adopt, best_src):
+        lane_cfg = c.inbox["bw_cfg"][:, None, :, :]  # [G, 1, R_src, W]
+        shape = adopt.shape[:2] + (self.R,) + adopt.shape[2:]
+        best_cfg = jnp.take_along_axis(
+            jnp.broadcast_to(lane_cfg, shape), best_src, axis=2
+        )[:, :, 0, :]
+        s["win_cfg"] = jnp.where(adopt, best_cfg, s["win_cfg"])
+
+    def _adopt_on_win(self, s, c, win, m_re, abs_re):
+        hole = m_re & (s["win_abs"] != abs_re)
+        super()._adopt_on_win(s, c, win, m_re, abs_re)
+        # no-op filled holes are not conf entries
+        s["win_cfg"] = jnp.where(hole, False, s["win_cfg"])
+
+    # ------------------------------------------------------ leader leases
+    def _ingest_heartbeat(self, s, c):
+        super()._ingest_heartbeat(s, c)
+        # countdowns tick once per lockstep tick (done here: the first
+        # phase to run); holder promises refresh on an accepted heartbeat
+        for k in ("ql_out", "ql_left", "grant_cnt", "ll_left", "ll_in",
+                  "alive_cnt"):
+            s[k] = jnp.maximum(s[k] - 1, 0)
+        if self.config.enable_leader_leases:
+            s["ll_left"] = jnp.where(
+                c.hb_ok, self.config.leader_lease_len, s["ll_left"]
+            )
+
+    def _vote_gate(self, s, c, p_bal, p_src):
+        if not self.config.enable_leader_leases:
+            return jnp.ones((self.G, self.R), jnp.bool_)
+        # refuse challengers while our promise to the current leader runs
+        return (
+            (s["ll_left"] <= 0)
+            | (p_src == s["leader"])
+            | (s["leader"] < 0)
+        )
+
+    def _campaign_gate(self, s, c):
+        if not self.config.enable_leader_leases:
+            return jnp.ones((self.G, self.R), jnp.bool_)
+        return s["ll_left"] <= 0
+
+    def _ingest_hb_reply(self, s, c):
+        super()._ingest_hb_reply(s, c)
+        hbr_valid = (c.flags & HB_REPLY) != 0
+        if self.config.enable_leader_leases:
+            # a heartbeat reply confirms the sender's promise; the leader's
+            # belief is shortened by the margin so it expires first
+            s["ll_in"] = jnp.where(
+                hbr_valid,
+                self.config.leader_lease_len - self.config.lease_margin,
+                s["ll_in"],
+            )
+        s["alive_cnt"] = jnp.where(
+            hbr_valid | c.ar_mine, self.config.alive_timeout, s["alive_cnt"]
+        )
+
+    # ------------------------------------------------------- conf changes
+    def _leader_propose(self, s, c):
+        W = self.W
+        i32 = jnp.int32
+        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (
+            s["bal_prepared"] > 0
+        )
+        active_leader = i_am_leader & (s["leader"] == c.rid)
+        tgt = c.inputs.get("conf_target")
+        if tgt is None:
+            tgt = jnp.full((self.G,), -1, i32)
+        tgt = jnp.broadcast_to(tgt[:, None].astype(i32), (self.G, self.R))
+        space = jnp.maximum(s["exec_bar"] + W - s["next_slot"], 0)
+        want = (
+            active_leader
+            & (tgt >= 0)
+            & (tgt != s["conf_cur"])
+            & (tgt != s["conf_prop"])
+            & (space > 0)
+        )
+        n_cfg = want.astype(i32)
+        m_cfg, abs_cfg = range_cover(s["next_slot"], s["next_slot"] + n_cfg, W)
+        s["win_abs"] = jnp.where(m_cfg, abs_cfg, s["win_abs"])
+        s["win_bal"] = jnp.where(m_cfg, s["bal_max"][..., None], s["win_bal"])
+        s["win_val"] = jnp.where(m_cfg, tgt[..., None], s["win_val"])
+        s["win_cfg"] = jnp.where(m_cfg, True, s["win_cfg"])
+        s["next_slot"] = s["next_slot"] + n_cfg
+        s["conf_prop"] = jnp.where(want, tgt, s["conf_prop"])
+        super()._leader_propose(s, c)
+        # fresh client proposals are data entries
+        s["win_cfg"] = jnp.where(c.m_new, False, s["win_cfg"])
+
+    def _exec_gate(self, s, c):
+        super()._exec_gate(s, c)
+        # apply the latest executed conf entry (the reference applies conf
+        # changes at execution order, quorumconf.rs)
+        applied = (
+            s["win_cfg"]
+            & (s["win_abs"] >= 0)
+            & (s["win_abs"] < s["exec_bar"][..., None])
+            & (s["win_abs"] > s["conf_slot"][..., None])
+        )
+        eff = jnp.where(applied, s["win_abs"], -1)
+        best = eff.max(axis=2)
+        pos = eff.argmax(axis=2)
+        newer = best > s["conf_slot"]
+        val = jnp.take_along_axis(s["win_val"], pos[..., None], axis=2)[..., 0]
+        s["conf_cur"] = jnp.where(newer, val, s["conf_cur"])
+        s["conf_slot"] = jnp.where(newer, best, s["conf_slot"])
+
+    # ---------------------------------------------------- takeover safety
+    def _try_step_up(self, s, c):
+        super()._try_step_up(s, c)
+        # a fresh leader cannot know the predecessor's outstanding grants:
+        # assume every peer holds a maximal lease until countdowns lapse
+        s["ql_out"] = jnp.where(
+            c.win[..., None],
+            self.config.lease_len + self.config.lease_margin,
+            s["ql_out"],
+        )
+
+    # ------------------------------------------------------ write barrier
+    def _commit_cap(self, s, c, peer_f):
+        eye = jnp.eye(self.R, dtype=jnp.bool_)[None]
+        barrier = (s["ql_out"] > 0) & ~eye
+        cap = jnp.where(barrier, peer_f, jnp.iinfo(jnp.int32).max)
+        return jnp.min(cap, axis=2)
+
+    # ------------------------------------------------------ grants + reads
+    def _extra_sends(self, s, c, out, oflags):
+        R = self.R
+        cfg = self.config
+        inbox = c.inbox
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+
+        # ingest grants (any grantor; countdown math keeps overlap safe)
+        g_valid = (c.flags & GRANT) != 0
+        got = jnp.max(jnp.where(g_valid, inbox["gr_len"], 0), axis=2)
+        s["ql_left"] = jnp.maximum(s["ql_left"], got)
+
+        # leader refreshes grants to matched conf responders
+        fire = c.active_leader & (s["grant_cnt"] <= 0)
+        s["grant_cnt"] = jnp.where(fire, cfg.grant_interval, s["grant_cnt"])
+        member = (
+            (s["conf_cur"][..., None] >> jnp.arange(R, dtype=jnp.int32))
+            & 1
+        ) != 0  # [G, R, R_grantee]
+        matched = (s["match_bal"] == s["bal_max"][..., None]) & (
+            s["match_f"] >= s["commit_bar"][..., None]
+        )
+        do_grant = (
+            fire[..., None] & member & matched & (s["alive_cnt"] > 0) & ~eye
+        )
+        oflags = oflags | jnp.where(do_grant, jnp.uint32(GRANT), 0)
+        out["gr_len"] = jnp.where(do_grant, cfg.lease_len, 0)
+        s["ql_out"] = jnp.where(
+            do_grant, cfg.lease_len + cfg.lease_margin, s["ql_out"]
+        )
+        # the leader is its own responder when in conf (no wire needed)
+        self_member = ((s["conf_cur"] >> c.rid) & 1) != 0
+        s["ql_left"] = jnp.where(
+            c.active_leader & self_member & fire,
+            cfg.lease_len,
+            s["ql_left"],
+        )
+
+        out["bw_cfg"] = s["win_cfg"]
+        return oflags
+
+    def _effects_extra(self, s, c):
+        cfg = self.config
+        K = cfg.num_key_buckets
+        # pending-write buckets: un-executed tail of the own voted log
+        tail = (
+            (s["win_bal"] > 0)
+            & (s["win_abs"] >= s["exec_bar"][..., None])
+            & (
+                s["win_abs"]
+                < jnp.maximum(s["vote_bar"], s["next_slot"])[..., None]
+            )
+        )
+        bucket = s["win_val"] % K
+        pend = jnp.zeros(tail.shape[:2], jnp.uint32)
+        for b in range(K):  # K is small and static; unrolled bucket ORs
+            has = jnp.any(tail & (bucket == b), axis=2)
+            pend = pend | (has.astype(jnp.uint32) << b)
+        self_member = ((s["conf_cur"] >> c.rid) & 1) != 0
+        lease_held = self_member & (s["ql_left"] > 0)
+        n_local = jnp.where(
+            lease_held, K - popcount(pend & jnp.uint32((1 << K) - 1)), 0
+        )
+        # leader local reads under a confirmed quorum of vote promises
+        ll_cnt = jnp.sum((s["ll_in"] > 0).astype(jnp.int32), axis=2) + 1
+        leader_read_ok = c.active_leader & (
+            (ll_cnt >= self.quorum)
+            if cfg.enable_leader_leases
+            else jnp.zeros_like(c.active_leader)
+        )
+        return {
+            "lease_held": lease_held,
+            "n_local_buckets": n_local.astype(jnp.int32),
+            "leader_read_ok": leader_read_ok,
+            "conf_cur": s["conf_cur"],
+        }
