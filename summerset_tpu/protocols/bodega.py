@@ -1,0 +1,503 @@
+"""Vectorized Bodega: roster-leased always-local linearizable reads.
+
+Parity target: reference ``src/protocols/bodega/`` (SURVEY.md §2.5; the
+Bodega thesis chapter) — a ballot-numbered ``RespondersConf`` roster
+(leader + per-key-range responder sets) is installed through all-to-all
+**config leases**; roster responders serve linearizable reads locally, and
+writes wait for acks from *all* responders of their touched keys:
+
+- ``conflease.rs:10-47`` ``heard_new_conf``: a higher-ballot conf triggers
+  revoke -> adopt -> regrant, and step-up if the new conf names me leader;
+- ``localread.rs:8-26``: a stable leader / responder serves local reads
+  when majority-leased and ``commit_bar >= peer_accept_max``;
+- ``localread.rs:32-56`` ``commit_condition``: quorum AND all responders of
+  every written key acked;
+- ``durability.rs:137-175`` + ``messages.rs:419-525``: follower-to-follower
+  ``AcceptNotice`` gossip releases held reads once a majority accepted;
+- ``heartbeat.rs:85-108``: peer hear-timeout composes a filtered conf
+  (dead peer dropped, self volunteering as leader) at a higher ballot.
+
+TPU-first redesign on the MultiPaxos lockstep skeleton:
+
+- **The conf is state, not log**: ``(conf_bal, conf_leader, conf_resp[K])``
+  per replica, with responder bitmaps per key bucket (the host's
+  ``KeyRangeMap`` folds real key ranges onto buckets, ``utils/keyrange.py``).
+  CONF broadcasts carry it every tick; a receiver holding a higher-ballot
+  pending conf *defers installing* until all of its own outgoing leases at
+  the old conf have lapsed — the lockstep form of the reference's blocking
+  revoke-then-adopt (``conflease.rs:22-38``), which is exactly what makes
+  the lease chain safe: nobody acks new-epoch writes while a lease it
+  granted under the old roster may still be serving reads.
+- **Epoch-tagged consensus traffic**: every replica's per-tick CONF lane
+  doubles as the epoch tag; receivers defer ACCEPT/PREPARE/HEARTBEAT from
+  senders whose conf ballot differs from their own installed conf (the
+  ballot-coupling the reference gets from confs riding heartbeats,
+  ``mod.rs:306-318``).
+- **Config leases are all-to-all countdowns**: grantor-side expiry runs
+  ``lease_margin`` ticks longer than the granted length (clock-free safety,
+  same role as ``T_guard``); active revoke (REVOKE/REVOKE_REPLY) shortcuts
+  the wait on conf changes.  Grants carry the grantor's accept frontier;
+  the holder's ``peer_accept_max`` is the min-over-time of the quorum-th
+  smallest grant-time accept bar (``conflease.rs:267-282``).
+- **Write barrier is a per-slot tally**: slot ``s`` commits once a quorum
+  of cumulative ack frontiers pass it AND every responder of
+  ``bucket(value)`` has acked past it (no-ops skip the responder clause).
+- **AcceptNotice** is a per-tick accept-frontier + liveness beacon lane;
+  the reference's majority-notice read release is subsumed by the
+  exec-gated pending check (see the NOTE at the AN ingest), and commit
+  learning rides the leader heartbeat path, which respects the barrier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from . import register_protocol
+from .common import (
+    initial_ballot,
+    kth_largest,
+    make_greater_ballot,
+    not_self,
+    range_cover,
+    take_lane,
+    take_src,
+)
+from .multipaxos import (
+    ACCEPT,
+    HEARTBEAT,
+    PREPARE,
+    SNAPSHOT,
+    MultiPaxosKernel,
+    ReplicaConfigMultiPaxos,
+)
+
+CONF = 1024          # conf broadcast (doubles as the sender's epoch tag)
+GRANT = 2048         # config-lease grant/refresh
+REVOKE = 4096        # active revoke request
+REVOKE_REPLY = 8192  # holder confirms the lease is dropped
+AN = 16384           # accept-frontier notice (AcceptNotice analog)
+
+_INF = jnp.int32(1 << 30)
+_EPOCH_BITS = jnp.uint32(ACCEPT | PREPARE | HEARTBEAT | SNAPSHOT)
+
+
+@dataclasses.dataclass
+class ReplicaConfigBodega(ReplicaConfigMultiPaxos):
+    """Extends the MultiPaxos knobs (parity: ``ReplicaConfigBodega``,
+    ``bodega/mod.rs``)."""
+
+    lease_len: int = 12          # config lease length granted (ticks)
+    lease_margin: int = 4        # grantor-side slack > max one-way delay
+    grant_interval: int = 4      # lease refresh period (ticks)
+    num_key_buckets: int = 8     # key-hash buckets (host KeyRangeMap folds)
+    init_responders: int = 0     # initial all-bucket responders bitmap
+    conf_timeout: int = 40       # ticks without hearing a peer -> failover
+
+
+@register_protocol("Bodega")
+class BodegaKernel(MultiPaxosKernel):
+    broadcast_lanes = frozenset(
+        {"bw_abs", "bw_bal", "bw_val", "bw_noop", "cf_resp"}
+    )
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigBodega | None = None,
+    ):
+        config = config or ReplicaConfigBodega()
+        super().__init__(num_groups, population, window, config)
+        if config.num_key_buckets > 30:
+            raise ValueError("num_key_buckets must be <= 30 (int32 bitmaps)")
+
+    # ------------------------------------------------------------------ state
+    def _extra_state(self, st, seed):
+        G, R, K = self.G, self.R, self.config.num_key_buckets
+        cfg = self.config
+        i32 = jnp.int32
+        # warm-start roster mirrors the warm-start leader
+        if cfg.init_leader >= 0:
+            bal0 = int(initial_ballot(cfg.init_leader))
+            lead0 = cfg.init_leader
+        else:
+            bal0, lead0 = 0, -1
+        st.update(
+            conf_bal=jnp.full((G, R), bal0, i32),
+            conf_leader=jnp.full((G, R), lead0, i32),
+            conf_resp=jnp.full((G, R, K), cfg.init_responders, i32),
+            pend_bal=jnp.zeros((G, R), i32),
+            pend_leader=jnp.full((G, R), -1, i32),
+            pend_resp=jnp.zeros((G, R, K), i32),
+            # all-to-all lease countdowns + the conf ballot they bind to
+            lease_out=jnp.zeros((G, R, R), i32),
+            lease_in=jnp.zeros((G, R, R), i32),
+            in_bal=jnp.zeros((G, R, R), i32),
+            grant_cnt=jnp.zeros((G, R), i32),
+            # grant-time peer accept bars -> peer_accept_max
+            pab=jnp.full((G, R, R), _INF, i32),
+            pam=jnp.full((G, R), _INF, i32),
+            # AN-fed peer liveness for conf failover
+            conf_alive=jnp.full((G, R, R), cfg.conf_timeout, i32),
+            # explicit no-op marks: value ids are opaque host references
+            # (0 is a legal id), so bucket classification must not key off
+            # the NULL_VAL sentinel
+            win_noop=jnp.zeros((G, R, self.W), jnp.bool_),
+        )
+
+    def _extra_outbox(self, out):
+        G, R, K = self.G, self.R, self.config.num_key_buckets
+        i32 = jnp.int32
+        pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        out.update(
+            cf_bal=pair(), cf_leader=pair(),
+            cf_resp=jnp.zeros((G, R, K), i32),
+            gr_len=pair(), gr_bal=pair(), gr_abar=pair(),
+            rv_bal=pair(), rvr_bal=pair(),
+            bw_noop=jnp.zeros((G, R, self.W), jnp.bool_),
+        )
+
+    # ------------------------------------------------- conf + lease ingest
+    def _ingest_heartbeat(self, s, c):
+        cfg = self.config
+        R, K = self.R, self.config.num_key_buckets
+        inbox = c.inbox
+        flags = c.flags
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+
+        # epoch gate: defer consensus traffic from senders whose installed
+        # conf differs from ours (their per-tick CONF lane is the tag; an
+        # unset CONF bit zeroes cf_bal, which matches only the no-conf
+        # cold-start epoch)
+        cf_valid = (flags & CONF) != 0
+        epoch_ok = inbox["cf_bal"] == s["conf_bal"][..., None]
+        c.flags = jnp.where(
+            epoch_ok, flags, flags & ~_EPOCH_BITS
+        )
+
+        super()._ingest_heartbeat(s, c)
+
+        # countdowns tick once per lockstep tick
+        for k in ("lease_out", "lease_in", "grant_cnt", "conf_alive"):
+            s[k] = jnp.maximum(s[k] - 1, 0)
+
+        # --- CONF ingest: stage the highest conf ballot heard as pending
+        eff = jnp.where(cf_valid, inbox["cf_bal"], -1)
+        best = eff.max(axis=2)
+        src = eff.argmax(axis=2).astype(jnp.int32)
+        newer = (best > s["conf_bal"]) & (best > s["pend_bal"])
+        s["pend_leader"] = jnp.where(
+            newer, take_src(inbox["cf_leader"], src), s["pend_leader"]
+        )
+        new_resp = take_lane(inbox["cf_resp"], src)  # [G, R, K]
+        s["pend_resp"] = jnp.where(
+            newer[..., None], new_resp, s["pend_resp"]
+        )
+        s["pend_bal"] = jnp.where(newer, best, s["pend_bal"])
+
+        # --- REVOKE ingest: drop held leases, confirm to grantor (echoing
+        # the revoke's conf ballot so stale replies can't release leases
+        # granted under a later conf)
+        rv_valid = (c.flags & REVOKE) != 0
+        s["lease_in"] = jnp.where(rv_valid, 0, s["lease_in"])
+        c.rv_reply = rv_valid
+        c.rv_echo = inbox["rv_bal"]
+        # REVOKE_REPLY ingest: grantor releases its countdown only when the
+        # echoed ballot matches its still-installed conf (pre-install epoch)
+        rr_valid = ((c.flags & REVOKE_REPLY) != 0) & (
+            inbox["rvr_bal"] == s["conf_bal"][..., None]
+        )
+        s["lease_out"] = jnp.where(rr_valid, 0, s["lease_out"])
+
+        # --- GRANT ingest: hold the lease, learn grant-time accept bars
+        g_valid = (c.flags & GRANT) != 0
+        s["lease_in"] = jnp.where(g_valid, inbox["gr_len"], s["lease_in"])
+        s["in_bal"] = jnp.where(g_valid, inbox["gr_bal"], s["in_bal"])
+        g_cur = g_valid & (inbox["gr_bal"] == s["conf_bal"][..., None])
+        s["pab"] = jnp.where(g_cur, inbox["gr_abar"], s["pab"])
+
+        # --- AN ingest: per-tick liveness beacon + peer accept frontiers.
+        # NOTE deliberately NOT a commit fast path: a quorum of same-ballot
+        # accept frontiers proves a slot *chosen* in the Paxos sense, but
+        # Bodega's commit additionally requires acks from all responders of
+        # the written keys — advancing commit_bar on chosen-ness alone would
+        # let a responder skip a write it never saw.  The reference uses
+        # majority AcceptNotices only to release reads held behind accepts
+        # the responder itself holds (``localread.rs:81,225,265``); here
+        # that release is subsumed by the (conservative) exec-gated pending
+        # check in the effects.
+        an_valid = (c.flags & AN) != 0
+        s["conf_alive"] = jnp.where(
+            an_valid | eye, cfg.conf_timeout, s["conf_alive"]
+        )
+
+        # --- conf failover: a conf member went silent -> stage a filtered
+        # conf at a higher ballot (heartbeat.rs:85-108)
+        dead = (s["conf_alive"] <= 0) & ~eye  # [G, R, R_peer]
+        dead_bits = jnp.sum(
+            jnp.where(dead, jnp.int32(1) << jnp.arange(R, dtype=jnp.int32), 0),
+            axis=2,
+        )
+        lead_dead = jnp.where(
+            s["conf_leader"] >= 0,
+            ((dead_bits >> jnp.clip(s["conf_leader"], 0, R - 1)) & 1) != 0,
+            False,
+        )
+        in_roster = (
+            jnp.any((s["conf_resp"] & dead_bits[..., None]) != 0, axis=2)
+            | lead_dead
+        )
+        fire = (
+            in_roster
+            & (s["pend_bal"] <= s["conf_bal"])
+            & (s["conf_bal"] > 0)
+        )
+        new_bal = make_greater_ballot(
+            jnp.maximum(s["bal_max"], s["pend_bal"]), c.rid
+        )
+        s["pend_leader"] = jnp.where(
+            fire,
+            jnp.where(lead_dead, c.rid, s["conf_leader"]),
+            s["pend_leader"],
+        )
+        s["pend_resp"] = jnp.where(
+            fire[..., None],
+            s["conf_resp"] & ~dead_bits[..., None],
+            s["pend_resp"],
+        )
+        s["pend_bal"] = jnp.where(fire, new_bal, s["pend_bal"])
+
+        # --- host-initiated conf change (client ConfChange analog,
+        # request.rs:12-90): inputs name the announcing replica + targets
+        tgt_init = c.inputs.get("conf_init")
+        if tgt_init is not None:
+            i32 = jnp.int32
+            init = jnp.broadcast_to(
+                tgt_init[:, None].astype(i32), (self.G, R)
+            )
+            lead_t = jnp.broadcast_to(
+                c.inputs["conf_leader_target"][:, None].astype(i32),
+                (self.G, R),
+            )
+            resp_t = jnp.broadcast_to(
+                c.inputs["conf_resp_target"][:, None].astype(i32),
+                (self.G, R),
+            )
+            bucket_t = c.inputs.get("conf_bucket")
+            if bucket_t is None:
+                bucket_t = jnp.full((self.G,), -1, i32)
+            bucket_t = jnp.broadcast_to(
+                bucket_t[:, None].astype(i32), (self.G, R)
+            )
+            want = (init == c.rid) & (s["pend_bal"] <= s["conf_bal"])
+            new_bal2 = make_greater_ballot(
+                jnp.maximum(s["bal_max"], s["pend_bal"]), c.rid
+            )
+            karange = jnp.arange(K, dtype=i32)[None, None, :]
+            sel = (bucket_t[..., None] < 0) | (
+                karange == bucket_t[..., None]
+            )
+            s["pend_resp"] = jnp.where(
+                want[..., None] & sel,
+                resp_t[..., None],
+                jnp.where(want[..., None], s["conf_resp"], s["pend_resp"]),
+            )
+            s["pend_leader"] = jnp.where(want, lead_t, s["pend_leader"])
+            s["pend_bal"] = jnp.where(want, new_bal2, s["pend_bal"])
+
+        # --- install the pending conf once every outgoing lease at the
+        # old conf has lapsed (the revoke-then-adopt barrier)
+        pending = s["pend_bal"] > s["conf_bal"]
+        clear = jnp.max(s["lease_out"], axis=2) <= 0
+        install = pending & clear
+        s["conf_bal"] = jnp.where(install, s["pend_bal"], s["conf_bal"])
+        s["conf_leader"] = jnp.where(
+            install, s["pend_leader"], s["conf_leader"]
+        )
+        s["conf_resp"] = jnp.where(
+            install[..., None], s["pend_resp"], s["conf_resp"]
+        )
+        s["bal_max"] = jnp.maximum(s["bal_max"], s["conf_bal"])
+        s["pab"] = jnp.where(install[..., None], _INF, s["pab"])
+        s["pam"] = jnp.where(install, _INF, s["pam"])
+        c.conf_pending = pending & ~install
+        c.conf_installed = install
+
+        # new-conf leader steps up through the normal campaign path
+        stepup = (
+            install
+            & (s["pend_leader"] == c.rid)
+            & (s["bal_prepared"] < s["conf_bal"])
+        )
+        s["hb_cnt"] = jnp.where(stepup, 0, s["hb_cnt"])
+
+        # pam: min-over-time of the quorum-th smallest grant-time bar
+        pab_eff = jnp.where(
+            jnp.eye(R, dtype=jnp.bool_)[None],
+            s["vote_bar"][..., None],
+            s["pab"],
+        )
+        q_small = kth_largest(pab_eff, R - self.quorum + 1)
+        s["pam"] = jnp.minimum(s["pam"], q_small)
+
+    # --------------------------------------------------- no-op lane plumbing
+    def _on_accept_write(self, s, c, m_acc, a_src):
+        lane = take_lane(c.inbox["bw_noop"], a_src)
+        s["win_noop"] = jnp.where(m_acc, lane, s["win_noop"])
+
+    def _on_adopt(self, s, c, adopt, best_src):
+        lane = c.inbox["bw_noop"][:, None, :, :]  # [G, 1, R_src, W]
+        shape = adopt.shape[:2] + (self.R,) + adopt.shape[2:]
+        best = jnp.take_along_axis(
+            jnp.broadcast_to(lane, shape), best_src, axis=2
+        )[:, :, 0, :]
+        s["win_noop"] = jnp.where(adopt, best, s["win_noop"])
+
+    def _adopt_on_win(self, s, c, win, m_re, abs_re):
+        hole = m_re & (s["win_abs"] != abs_re)
+        super()._adopt_on_win(s, c, win, m_re, abs_re)
+        s["win_noop"] = s["win_noop"] | hole
+
+    def _leader_propose(self, s, c):
+        super()._leader_propose(s, c)
+        s["win_noop"] = jnp.where(c.m_new, False, s["win_noop"])
+
+    # ------------------------------------------------------- write barrier
+    def _commit_cap(self, s, c, peer_f):
+        # per-slot responder clause: every responder of bucket(value) must
+        # have acked past the slot (localread.rs:32-56); the first slot
+        # failing it caps the commit frontier
+        R, W, K = self.R, self.W, self.config.num_key_buckets
+        _, abs_w = range_cover(s["commit_bar"], s["commit_bar"] + W, W)
+        bucket = jnp.where(
+            ~s["win_noop"], s["win_val"] % K, -1
+        )  # no-ops skip
+        resp_bits = jnp.take_along_axis(
+            s["conf_resp"], jnp.clip(bucket, 0, K - 1), axis=2
+        )
+        resp_bits = jnp.where(bucket >= 0, resp_bits, 0)  # [G, R, W]
+        member = (
+            (resp_bits[..., None] >> jnp.arange(R, dtype=jnp.int32)) & 1
+        ) != 0  # [G, R, W, R_peer]
+        acked = peer_f[..., None, :] > abs_w[..., None]  # [G, R, W, R_peer]
+        resp_ok = ~jnp.any(member & ~acked, axis=3)  # [G, R, W]
+        slot_known = s["win_abs"] == abs_w
+        in_rng = abs_w < s["next_slot"][..., None]
+        fail = in_rng & ~(resp_ok & slot_known)
+        fail_abs = jnp.min(jnp.where(fail, abs_w, _INF), axis=2)
+        return fail_abs
+
+    # ----------------------------------------------------- sends + leases
+    def _extra_sends(self, s, c, out, oflags):
+        R = self.R
+        cfg = self.config
+        ns_mask = not_self(self.G, R)
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+
+        # CONF: every tick (epoch tag + propagation)
+        has_conf = (s["conf_bal"] > 0)[..., None] & ns_mask
+        oflags = oflags | jnp.where(has_conf, jnp.uint32(CONF), 0)
+        out["cf_bal"] = jnp.where(has_conf, s["conf_bal"][..., None], 0)
+        out["cf_leader"] = jnp.where(
+            has_conf, s["conf_leader"][..., None], 0
+        )
+        out["cf_resp"] = s["conf_resp"]
+
+        # AN: per-tick liveness beacon (see ingest NOTE)
+        do_an = jnp.broadcast_to(ns_mask, (self.G, R, R))
+        oflags = oflags | jnp.where(do_an, jnp.uint32(AN), 0)
+        out["bw_noop"] = s["win_noop"]
+
+        # GRANT: refresh config leases at the installed conf; while a conf
+        # change is pending, stop refreshing (passive revoke) and actively
+        # REVOKE instead
+        s["grant_cnt"] = jnp.where(
+            c.conf_pending | (s["grant_cnt"] > 0), s["grant_cnt"],
+            cfg.grant_interval,
+        )
+        fire = (
+            ~c.conf_pending
+            & (s["conf_bal"] > 0)
+            & (s["grant_cnt"] == cfg.grant_interval)
+        )
+        do_grant = fire[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_grant, jnp.uint32(GRANT), 0)
+        out["gr_len"] = jnp.where(do_grant, cfg.lease_len, 0)
+        out["gr_bal"] = jnp.where(do_grant, s["conf_bal"][..., None], 0)
+        out["gr_abar"] = jnp.where(do_grant, s["vote_bar"][..., None], 0)
+        s["lease_out"] = jnp.where(
+            do_grant, cfg.lease_len + cfg.lease_margin, s["lease_out"]
+        )
+
+        do_rv = (
+            c.conf_pending[..., None] & (s["lease_out"] > 0) & ns_mask
+        )
+        oflags = oflags | jnp.where(do_rv, jnp.uint32(REVOKE), 0)
+        out["rv_bal"] = jnp.where(do_rv, s["conf_bal"][..., None], 0)
+        do_rvr = c.rv_reply & ns_mask
+        oflags = oflags | jnp.where(do_rvr, jnp.uint32(REVOKE_REPLY), 0)
+        out["rvr_bal"] = jnp.where(do_rvr, c.rv_echo, 0)
+
+        return oflags
+
+    # ------------------------------------------------------------- effects
+    def _effects_extra(self, s, c):
+        cfg = self.config
+        R, K = self.R, cfg.num_key_buckets
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        lease_ok = (
+            (s["lease_in"] > 0)
+            & (s["in_bal"] == s["conf_bal"][..., None])
+            & ~eye
+        )
+        lease_cnt = jnp.sum(lease_ok.astype(jnp.int32), axis=2)
+        majority_leased = (lease_cnt + 1) >= self.quorum
+        quiet = s["commit_bar"] >= s["pam"]
+
+        # per-bucket local-read service: responder membership + no pending
+        # write on the bucket in the un-executed window tail
+        member = (
+            (s["conf_resp"] >> c.rid[..., None]) & 1
+        ) != 0  # [G, R, K]
+        tail = (
+            (s["win_bal"] > 0)
+            & (s["win_abs"] >= s["exec_bar"][..., None])
+            & (
+                s["win_abs"]
+                < jnp.maximum(s["vote_bar"], s["next_slot"])[..., None]
+            )
+            & ~s["win_noop"]
+        )
+        bucket = s["win_val"] % K
+        karange = jnp.arange(K, dtype=jnp.int32)[None, None, :]
+        pend = jnp.any(
+            tail[..., None, :] & (bucket[..., None, :] == karange[..., None]),
+            axis=3,
+        )  # [G, R, K]
+        can_serve = (
+            member
+            & ~pend
+            & (majority_leased & quiet)[..., None]
+        )
+        local_buckets = jnp.sum(
+            jnp.where(can_serve, jnp.int32(1) << karange, 0), axis=2
+        )
+        stable_leader = (
+            c.active_leader
+            & (s["conf_leader"] == c.rid)
+            & majority_leased
+            & quiet
+        )
+        return {
+            "conf_bal": s["conf_bal"],
+            "conf_leader": s["conf_leader"],
+            "lease_cnt": lease_cnt,
+            "stable_leader": stable_leader,
+            "local_read_buckets": local_buckets,
+            "n_local_buckets": jnp.sum(
+                can_serve.astype(jnp.int32), axis=2
+            ),
+        }

@@ -1,0 +1,265 @@
+"""Vectorized Crossword: MultiPaxos + tunable per-instance shard assignment.
+
+Parity target: reference ``src/protocols/crossword/`` (SURVEY.md §2.5) —
+MultiPaxos with flexible Reed-Solomon sharding where each instance carries
+its own shard-to-replica assignment (``crossword/mod.rs:259-292,360-361``),
+the commit condition generalizes the quorum-size vs. shards-per-replica
+tradeoff (``messages.rs:15-62`` ``coverage_under_faults``: with a balanced
+round-robin assignment, acks ``a`` cover at least
+``(a - f - 1) * dj_spr + spr`` distinct shards), follower gossiping fills
+missing shards off the critical path (``gossiping.rs:14-193``), and an
+adaptive policy re-picks the assignment from live per-peer responsiveness
+(``adaptive.rs:274+`` linreg perf models + qdisc introspection).
+
+TPU-first redesign on the RSPaxos lockstep skeleton:
+
+- **Assignment is a per-slot lane** ``win_spr``: balanced round-robin of
+  width ``spr`` over ``T = rs_total_shards`` shards (replica ``r`` holds
+  shards ``[r*dj, r*dj + spr) mod T`` where ``dj = T // R`` — the
+  reference's default diagonal policy family, ``adaptive.rs:44-67``).  The
+  leader stamps each proposal with its current choice; the lane travels in
+  the ``bw_spr`` broadcast window and is adopted like values.  Arbitrary
+  unbalanced ``Vec<Bitmap>`` assignments (reference static-config niche)
+  reduce to their worst-case balanced bound and are not materialized.
+- **Commit tally is per-slot**: slot ``s`` with width ``spr`` commits once
+  ``max(majority, f + 1 + ceil((d - spr) / dj))`` cumulative ack frontiers
+  pass it — the closed form of ``coverage_under_faults >= d`` for balanced
+  assignments.  ``spr = d`` degrades to MultiPaxos (majority), ``spr = dj``
+  to RSPaxos (majority + f): the Crossword tradeoff knob, exactly.
+- **Gossip**: RSPaxos's RECON_REQ/RECON_REPLY rounds serve as the gossip
+  plane; the full-data frontier advances when enough distinct cover
+  frontiers pass a slot (``1 + ceil((d - spr) / dj)`` for its width), and a
+  configurable tail margin keeps gossip off the freshest slots
+  (``gossip_tail_ignores``, ``mod.rs:88-90``).
+- **Adaptive assignment**: per-peer responsiveness counters (ticks since
+  ack progress / heartbeat reply) replace the reference's RTT linreg; each
+  tick the leader picks the smallest viable width
+  ``spr >= d - (resp - f - 1) * dj`` — bandwidth-optimal when all peers are
+  fast, sliding toward full-copy as peers stall, which is the same
+  liveness-constrained envelope the reference optimizes within
+  (``adaptive.rs:274+``).  Host-side linreg/qdisc models
+  (``utils/linreg.py``, ``utils/qdisc.py``) can override the choice via the
+  ``spr_override`` input.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from . import register_protocol
+from .common import advance_durability, not_self, range_cover, take_lane
+from .rspaxos import ReplicaConfigRSPaxos, RSPaxosKernel
+
+_INF = jnp.int32(1 << 30)
+
+
+@dataclasses.dataclass
+class ReplicaConfigCrossword(ReplicaConfigRSPaxos):
+    """Extends the RSPaxos knobs (parity: ``ReplicaConfigCrossword``,
+    ``crossword/mod.rs:46-150``)."""
+
+    rs_total_shards: int = 0    # codeword width T; 0 = population
+    rs_data_shards: int = 0     # data shards d; 0 = majority * dj
+    init_spr: int = 0           # initial shards per replica; 0 = dj (diagonal)
+    assignment_adaptive: bool = True   # re-pick spr from live responsiveness
+    lag_threshold: int = 8      # ticks without ack/hb-reply -> unresponsive
+    gossip_tail_ignores: int = 0  # freshest slots exempt from gossip rounds
+
+
+@register_protocol("Crossword")
+class CrosswordKernel(RSPaxosKernel):
+    broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val", "bw_spr"})
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 64,
+        config: ReplicaConfigCrossword | None = None,
+    ):
+        config = config or ReplicaConfigCrossword()
+        # RSPaxosKernel.__init__ validates fault_tolerance <= R - majority
+        super().__init__(num_groups, population, window, config)
+        T = config.rs_total_shards or population
+        if T % population != 0:
+            raise ValueError("rs_total_shards must be a multiple of population")
+        self.total_shards = T
+        self.dj = T // population
+        d = config.rs_data_shards or self.quorum * self.dj
+        if not self.dj <= d <= T:
+            raise ValueError(f"invalid rs_data_shards {d} (T={T}, dj={self.dj})")
+        self.data_shards = d
+        spr0 = config.init_spr or self.dj
+        if not self.dj <= spr0 <= d:
+            raise ValueError(f"invalid init_spr {spr0} (dj={self.dj}, d={d})")
+        self.init_spr = spr0
+
+    # ------------------------------------------------------------- need math
+    def _cdiv_pos(self, x):
+        """max(0, ceil(x / dj)) elementwise — shard deficit in replicas."""
+        return jnp.maximum(0, -((-x) // self.dj))
+
+    def _commit_need(self, spr):
+        """Acks required to commit a slot of width `spr`: quorum AND
+        worst-case (f+1-survivor) coverage >= d (``messages.rs:15-62``)."""
+        f = self.config.fault_tolerance
+        cov = f + 1 + self._cdiv_pos(self.data_shards - spr)
+        return jnp.maximum(self.quorum, cov)
+
+    def _recover_need(self, spr):
+        """Distinct cover frontiers needed to rebuild a slot of width `spr`
+        (the f=0 coverage bound: adjacent-replica worst case)."""
+        return 1 + self._cdiv_pos(self.data_shards - spr)
+
+    # ------------------------------------------------------------------ state
+    def _extra_state(self, st, seed):
+        super()._extra_state(st, seed)
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        d = self.data_shards
+        st.update(
+            # per-slot assignment width lane (full-copy-safe default)
+            win_spr=jnp.full((G, R, W), d, i32),
+            # candidate-side min voter width of the tallied value
+            prep_pspr=jnp.full((G, R, W), d, i32),
+            # adaptive policy: per-peer staleness + current choice
+            lag_cnt=jnp.zeros((G, R, R), i32),
+            cur_spr=jnp.full((G, R), self.init_spr, i32),
+        )
+
+    def _extra_outbox(self, out):
+        super()._extra_outbox(out)
+        out["bw_spr"] = jnp.zeros((self.G, self.R, self.W), jnp.int32)
+
+    # --------------------------------------------------- accept-side additions
+    def _on_accept_write(self, s, c, m_acc, a_src):
+        super()._on_accept_write(s, c, m_acc, a_src)
+        lane_spr = take_lane(c.inbox["bw_spr"], a_src)
+        s["win_spr"] = jnp.where(m_acc, lane_spr, s["win_spr"])
+
+    # ---------------------------------------------- prepare tally extensions
+    def _on_prep_tally(self, s, c, ok, value_kept, new_pval):
+        # worst-case recoverability must assume the narrowest assignment any
+        # era voted this value under: track the min width among contributors
+        d = jnp.int32(self.data_shards)
+        lane_spr = jnp.minimum(c.inbox["bw_spr"][:, None, :, :], d)
+        contrib = ok & (c.pr_lane_val == new_pval[:, :, None, :])
+        tick_min = jnp.min(jnp.where(contrib, lane_spr, d), axis=2)
+        base = jnp.where(value_kept, s["prep_pspr"], d)
+        s["prep_pspr"] = jnp.minimum(base, tick_min)
+
+    def _on_explode(self, s, c, explode):
+        super()._on_explode(s, c, explode)
+        d = jnp.int32(self.data_shards)
+        s["prep_pspr"] = jnp.where(
+            explode[..., None],
+            jnp.where(c.own_vote, jnp.minimum(s["win_spr"], d), d),
+            s["prep_pspr"],
+        )
+
+    # -------------------------------------------------- step-up + adoption
+    def _prep_recover_need(self, s):
+        return self._recover_need(s["prep_pspr"])
+
+    def _adopt_on_win(self, s, c, win, m_re, abs_re):
+        super()._adopt_on_win(s, c, win, m_re, abs_re)
+        # re-proposals are re-encoded under the winner's current assignment
+        s["win_spr"] = jnp.where(m_re, s["cur_spr"][..., None], s["win_spr"])
+
+    # ------------------------------------------------ adaptive policy + intake
+    def _leader_propose(self, s, c):
+        cfg = self.config
+        d, dj, f = self.data_shards, self.dj, cfg.fault_tolerance
+        prog = c.ar_prog | c.hbr_valid
+        s["lag_cnt"] = jnp.where(prog, 0, s["lag_cnt"] + 1)
+        if cfg.assignment_adaptive:
+            ns_mask = not_self(self.G, self.R)
+            resp = 1 + jnp.sum(
+                ns_mask & (s["lag_cnt"] < cfg.lag_threshold),
+                axis=2,
+                dtype=jnp.int32,
+            )
+            choice = jnp.clip(d - (resp - 1 - f) * dj, self.init_spr, d)
+        else:
+            choice = jnp.full((self.G, self.R), self.init_spr, jnp.int32)
+        # host perf models (linreg over ack latencies + qdisc state) may
+        # override per group: the adaptive.rs analog computed off-device
+        if "spr_override" in c.inputs:
+            ov = c.inputs["spr_override"].astype(jnp.int32)  # [G]
+            choice = jnp.where(
+                ov[:, None] > 0, jnp.clip(ov[:, None], self.dj, d), choice
+            )
+        s["cur_spr"] = choice
+        super()._leader_propose(s, c)
+        s["win_spr"] = jnp.where(
+            c.m_new, s["cur_spr"][..., None], s["win_spr"]
+        )
+        # NOTE an instance's assignment is fixed at propose time (reference:
+        # Accept carries the per-instance assignment, mod.rs:360-361).
+        # Re-stamping the pending tail wider would lower its ack requirement
+        # against followers who only hold the narrow shards — a committed
+        # slot could then be unrecoverable after one leader crash.  So, as
+        # in the reference, pending narrow slots under excess failures stall
+        # the (execution-ordered) commit frontier until peers heal; the
+        # widened choice applies to slots proposed from now on.
+
+    # ----------------------------------------------- per-slot commit tally
+    def _advance_bars(self, s, c):
+        W = self.W
+        s["dur_bar"] = advance_durability(
+            s, self.config.dur_lag, frontier="vote_bar"
+        )
+        peer_f = self._peer_frontiers(s)
+        _, abs_w = range_cover(s["commit_bar"], s["commit_bar"] + W, W)
+        # cnt[g,r,w] = how many peers acked past slot w
+        cnt = (peer_f[..., :, None] > abs_w[..., None, :]).sum(
+            axis=2, dtype=jnp.int32
+        )
+        need = self._commit_need(s["win_spr"])
+        slot_known = s["win_abs"] == abs_w
+        in_rng = abs_w < s["next_slot"][..., None]
+        fail = in_rng & ~((cnt >= need) & slot_known)
+        fail_abs = jnp.min(jnp.where(fail, abs_w, _INF), axis=2)
+        cap = self._commit_cap(s, c, peer_f)
+        q_f = jnp.minimum(jnp.minimum(fail_abs, s["next_slot"]), cap)
+        s["commit_bar"] = jnp.where(
+            c.active_leader,
+            jnp.clip(q_f, s["commit_bar"], s["next_slot"]),
+            s["commit_bar"],
+        )
+        self._exec_gate(s, c)
+
+    # ------------------------------------------- per-slot gossip cover tally
+    def _advance_full_bar(self, s, cover):
+        W = self.W
+        _, abs_w = range_cover(s["full_bar"], s["full_bar"] + W, W)
+        cnt = (cover[..., :, None] > abs_w[..., None, :]).sum(
+            axis=2, dtype=jnp.int32
+        )
+        need = self._recover_need(s["win_spr"])
+        slot_known = s["win_abs"] == abs_w
+        in_rng = abs_w < s["commit_bar"][..., None]
+        fail = in_rng & ~((cnt >= need) & slot_known)
+        fail_abs = jnp.min(jnp.where(fail, abs_w, _INF), axis=2)
+        s["full_bar"] = jnp.clip(
+            jnp.minimum(fail_abs, s["commit_bar"]),
+            s["full_bar"],
+            s["commit_bar"],
+        )
+
+    def _recon_goal(self, s):
+        tail = self.config.gossip_tail_ignores
+        if tail <= 0:
+            return s["commit_bar"]
+        return jnp.maximum(s["full_bar"], s["commit_bar"] - tail)
+
+    def _extra_sends(self, s, c, out, oflags):
+        out["bw_spr"] = s["win_spr"]
+        return super()._extra_sends(s, c, out, oflags)
+
+    def _effects_extra(self, s, c):
+        fx = super()._effects_extra(s, c)
+        fx["cur_spr"] = s["cur_spr"]
+        return fx

@@ -400,6 +400,7 @@ class MultiPaxosKernel(ProtocolKernel):
             & i_am_leader[..., None]
         )
         prog = ar_mine & (inbox["ar_f"] > s["match_f"])
+        c.ar_prog = prog
         s["match_f"] = jnp.where(
             ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"]
         )
@@ -421,6 +422,7 @@ class MultiPaxosKernel(ProtocolKernel):
     # ========== 5. HB_REPLY ingest (peer exec bars for snap_bar GC)
     def _ingest_hb_reply(self, s, c):
         hbr_valid = (c.flags & HB_REPLY) != 0
+        c.hbr_valid = hbr_valid
         s["peer_exec"] = jnp.where(
             hbr_valid,
             jnp.maximum(s["peer_exec"], c.inbox["hbr_ebar"]),
@@ -622,21 +624,24 @@ class MultiPaxosKernel(ProtocolKernel):
             s, c.inputs, self.config.exec_follows_commit
         )
 
-    # ========== 10. durability + leader commit tally + exec
-    def _advance_bars(self, s, c):
-        R = self.R
-        s["dur_bar"] = advance_durability(
-            s, self.config.dur_lag, frontier="vote_bar"
-        )
-        # per-peer ballot-matched frontiers; own column = own durable frontier
+    def _peer_frontiers(self, s):
+        """Per-peer ballot-matched acked frontiers [G, R, R_peer]; own
+        column = own durable frontier (the leader's tally input)."""
         peer_f = jnp.where(
             (s["match_bal"] == s["bal_max"][..., None])
             & (s["match_from"] <= s["commit_bar"][..., None]),
             s["match_f"],
             0,
         )
-        eye = jnp.eye(R, dtype=jnp.bool_)[None]
-        peer_f = jnp.where(eye, s["dur_bar"][..., None], peer_f)
+        eye = jnp.eye(self.R, dtype=jnp.bool_)[None]
+        return jnp.where(eye, s["dur_bar"][..., None], peer_f)
+
+    # ========== 10. durability + leader commit tally + exec
+    def _advance_bars(self, s, c):
+        s["dur_bar"] = advance_durability(
+            s, self.config.dur_lag, frontier="vote_bar"
+        )
+        peer_f = self._peer_frontiers(s)
         q_f = jnp.minimum(
             kth_largest(peer_f, self.commit_k),
             self._commit_cap(s, c, peer_f),

@@ -1,45 +1,56 @@
 """Vectorized QuorumLeases: MultiPaxos + quorum read leases on a
-configurable responder set.
+configurable grantee set.
 
 Parity target: reference ``src/protocols/quorum_leases/`` (SURVEY.md §2.5;
-the CMU Quorum-Read-Leases design) — clients install a responders config
+the CMU Quorum-Read-Leases design) — clients install a grantee config
 through consensus (``quorumconf.rs``, driven by ``ConfChange`` requests);
-lease-holding responders serve reads locally when quiescent
-(``quorumlease.rs:10-17`` ``is_local_reader``); writes must be acked by
-*all* lease-holding responders before committing (``commit_condition``,
-``quorumlease.rs:22-42``); and a second lease plane keeps the leader stable
-(dual ``LeaseManager``s, lease gids 0/1).  The reference's guard/promise/
-revoke clock-free lease machinery (``src/server/leaseman.rs:122-131``)
-becomes counter arithmetic in lockstep ticks:
+**every replica is a grantor** of quorum leases to the configured grantees;
+a grantee serves reads locally only while it holds leases from a majority
+of grantors (``quorumlease.rs:10-17`` ``is_local_reader``:
+``lease_cnt() >= quorum_cnt``); and the leader's commit condition requires
+Accept acks from every grantee appearing in any acceptor's reported grant
+set (``quorumlease.rs:22-42`` ``commit_condition`` over
+``accept_grant_sets``; ``AcceptReply`` carries the sender's ``grant_set``,
+``messages.rs:367-403``).  Majority intersection is what makes this safe
+without epochs: a serving grantee holds leases from a majority of
+grantors, every write quorum intersects that majority, and the
+intersecting grantor's reported grant set forces the leader to wait for
+the grantee's ack.  A second lease plane keeps the leader stable (dual
+``LeaseManager``s, lease gids 0/1, ``leaderlease.rs:10-21``).
 
-- a grantor's countdown starts ``lease_margin`` ticks longer than the
-  length it granted, so every holder-side expiry strictly precedes its
+The reference's guard/promise/revoke clock-free lease machinery
+(``src/server/leaseman.rs:122-131``) becomes counter arithmetic in
+lockstep ticks:
+
+- a grantor's countdown runs ``lease_margin`` ticks longer than the length
+  it granted, so every holder-side expiry strictly precedes its
   grantor-side expiry as long as ``lease_margin > max network delay`` —
   the same role ``T_guard`` plays against unbounded in-flight time;
 - revocation is passive (stop refreshing, wait out the countdown), which
-  is the reference's expire path; explicit revoke round-trips are not
-  needed because the barrier math (not the wire) enforces safety.
+  is the reference's expire path; grant sets reported to the leader decay
+  on the same clock, so the write barrier never frees before the last
+  possibly-live lease.
 
 Kernel semantics on the MultiPaxos lockstep skeleton:
 
-- **Responder conf changes ride the log**: a conf entry (``win_cfg`` lane,
-  value = responders bitmap) is proposed by the leader from the
+- **Grantee conf changes ride the log**: a conf entry (``win_cfg`` lane,
+  value = grantee bitmap) is proposed by the leader from the
   ``conf_target`` host input and applied when executed — the analog of the
-  reference's ``ConfChange -> quorumconf`` flow.
-- **Quorum leases are leader-granted, epoch-bounded**: the leader refreshes
-  grants to conf responders whose matched frontier reaches its commit bar;
-  a new leader conservatively assumes every peer may hold an outstanding
-  lease (full ``ql_out`` reset at step-up) until countdowns lapse.
-- **Write barrier**: the commit frontier is capped at the matched frontier
-  of every possibly-leased responder (``_commit_cap``), the frontier form
-  of "writes ack all grantees".
-- **Local reads**: a leased responder serves key buckets with no pending
-  write in its own log tail ``[exec_bar, vote frontier)`` — key buckets are
+  reference's ``ConfChange -> quorumconf`` flow.  Grants are tagged with
+  the grantor's applied conf slot; holders count only same-conf leases.
+- **All-to-all grants**: every replica refreshes leases to the configured
+  grantees it believes alive (GRANT / GRANT_ACK), and beacons its current
+  outstanding-grant bitmap to the leader every tick (GSET).  The leader
+  caps the commit frontier at the ack frontier of every grantee in any
+  live-reported grant set (``_commit_cap``) — the frontier form of
+  ``commit_condition``.
+- **Local reads**: a majority-leased grantee serves key buckets with no
+  pending write in its own un-executed log tail; key buckets are
   ``value_id % num_key_buckets`` (the host hashes real keys to buckets).
 - **Leader leases**: followers promise the heartbeat sender vote-refusal
-  for ``leader_lease_len`` ticks; the leader counts confirmed promises from
-  heartbeat replies (shortened by ``lease_margin``) and may serve local
-  reads while a quorum holds — reference ``leaderlease.rs:10-21``.
+  for ``leader_lease_len`` ticks; the leader counts confirmed promises
+  from heartbeat replies (shortened by ``lease_margin``) and may serve
+  local reads while a quorum holds — reference ``leaderlease.rs:10-21``.
 """
 
 from __future__ import annotations
@@ -53,7 +64,11 @@ from . import register_protocol
 from .common import range_cover
 from .multipaxos import HB_REPLY, MultiPaxosKernel, ReplicaConfigMultiPaxos
 
-GRANT = 1024  # quorum-lease grant/refresh: leader -> responder
+GRANT = 1024      # quorum-lease grant/refresh: grantor -> grantee
+GRANT_ACK = 2048  # grantee -> grantor (liveness for refresh gating)
+GSET = 4096       # per-tick outstanding-grant bitmap beacon (to the leader)
+
+_INF = jnp.int32(1 << 30)
 
 
 @dataclasses.dataclass
@@ -68,7 +83,7 @@ class ReplicaConfigQuorumLeases(ReplicaConfigMultiPaxos):
                                  # network's max one-way delay in ticks
     grant_interval: int = 4      # lease refresh period (ticks)
     num_key_buckets: int = 8     # key-hash buckets for quiescence checks
-    init_responders: int = 0     # initial responders bitmap (0 = none)
+    init_responders: int = 0     # initial grantee bitmap (0 = none)
     enable_leader_leases: bool = True
 
 
@@ -96,29 +111,40 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         G, R = self.G, self.R
         i32 = jnp.int32
         cfg = self.config
+        hold = cfg.lease_len + cfg.lease_margin
         st.update(
             win_cfg=jnp.zeros((G, R, self.W), jnp.bool_),
             conf_cur=jnp.full((G, R), cfg.init_responders, i32),
             conf_slot=jnp.full((G, R), -1, i32),
             conf_prop=jnp.full((G, R), -1, i32),
-            # quorum-lease countdowns: grantor (leader) and holder sides
+            # grantor side: per-grantee outstanding countdown
             ql_out=jnp.zeros((G, R, R), i32),
-            ql_left=jnp.zeros((G, R), i32),
+            # holder side: per-grantor countdown + conf slot it bound to
+            ql_in=jnp.zeros((G, R, R), i32),
+            ql_slot=jnp.full((G, R, R), -1, i32),
             grant_cnt=jnp.zeros((G, R), i32),
+            # leader side: peers' reported outstanding-grant bitmaps, with
+            # a decay ttl so a silent peer's claim expires on the lease
+            # clock; conservative full-grant init (a fresh leader cannot
+            # know what anyone granted)
+            rep_gset=jnp.full((G, R, R), cfg.init_responders, i32),
+            gset_ttl=jnp.full((G, R, R), hold, i32),
             # leader-lease countdowns: holder (follower promise) and the
             # leader's confirmed view per peer
             ll_left=jnp.zeros((G, R), i32),
             ll_in=jnp.zeros((G, R, R), i32),
-            # reply-based peer liveness: a dead responder must stop
-            # receiving grant refreshes or the leader's own barrier
-            # countdown never lapses
+            # reply-based peer liveness: grants to a dead grantee must stop
+            # or the write barrier never frees
             alive_cnt=jnp.full((G, R, R), cfg.alive_timeout, i32),
         )
 
     def _extra_outbox(self, out):
         G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
         out.update(
-            gr_len=jnp.zeros((G, R, R), jnp.int32),
+            gr_len=jnp.zeros((G, R, R), i32),
+            gr_slot=jnp.zeros((G, R, R), i32),
+            gs_bits=jnp.zeros((G, R, R), i32),
             bw_cfg=jnp.zeros((G, R, W), jnp.bool_),
         )
 
@@ -147,8 +173,8 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         super()._ingest_heartbeat(s, c)
         # countdowns tick once per lockstep tick (done here: the first
         # phase to run); holder promises refresh on an accepted heartbeat
-        for k in ("ql_out", "ql_left", "grant_cnt", "ll_left", "ll_in",
-                  "alive_cnt"):
+        for k in ("ql_out", "ql_in", "grant_cnt", "gset_ttl", "ll_left",
+                  "ll_in", "alive_cnt"):
             s[k] = jnp.maximum(s[k] - 1, 0)
         if self.config.enable_leader_leases:
             s["ll_left"] = jnp.where(
@@ -172,17 +198,18 @@ class QuorumLeasesKernel(MultiPaxosKernel):
 
     def _ingest_hb_reply(self, s, c):
         super()._ingest_hb_reply(s, c)
-        hbr_valid = (c.flags & HB_REPLY) != 0
         if self.config.enable_leader_leases:
             # a heartbeat reply confirms the sender's promise; the leader's
             # belief is shortened by the margin so it expires first
             s["ll_in"] = jnp.where(
-                hbr_valid,
+                c.hbr_valid,
                 self.config.leader_lease_len - self.config.lease_margin,
                 s["ll_in"],
             )
         s["alive_cnt"] = jnp.where(
-            hbr_valid | c.ar_mine, self.config.alive_timeout, s["alive_cnt"]
+            c.hbr_valid | c.ar_mine,
+            self.config.alive_timeout,
+            s["alive_cnt"],
         )
 
     # ------------------------------------------------------- conf changes
@@ -193,6 +220,9 @@ class QuorumLeasesKernel(MultiPaxosKernel):
             s["bal_prepared"] > 0
         )
         active_leader = i_am_leader & (s["leader"] == c.rid)
+        # a deposed replica forgets its in-flight conf proposal: if the
+        # entry was lost to a no-op fill it must be re-proposable later
+        s["conf_prop"] = jnp.where(active_leader, s["conf_prop"], -1)
         tgt = c.inputs.get("conf_target")
         if tgt is None:
             tgt = jnp.full((self.G,), -1, i32)
@@ -238,19 +268,41 @@ class QuorumLeasesKernel(MultiPaxosKernel):
     # ---------------------------------------------------- takeover safety
     def _try_step_up(self, s, c):
         super()._try_step_up(s, c)
-        # a fresh leader cannot know the predecessor's outstanding grants:
-        # assume every peer holds a maximal lease until countdowns lapse
-        s["ql_out"] = jnp.where(
-            c.win[..., None],
-            self.config.lease_len + self.config.lease_margin,
-            s["ql_out"],
+        # a fresh leader cannot know the cluster's outstanding grants: it
+        # assumes every peer may be granting to every configured grantee
+        # until real GSET beacons replace the claim or the lease clock
+        # lapses (reference: revoke-and-wait at step-up, leadership.rs)
+        hold = self.config.lease_len + self.config.lease_margin
+        s["rep_gset"] = jnp.where(
+            c.win[..., None], s["conf_cur"][..., None], s["rep_gset"]
+        )
+        s["gset_ttl"] = jnp.where(c.win[..., None], hold, s["gset_ttl"])
+
+    def _own_gset(self, s):
+        """Bitmap of grantees this replica may still have leases out to;
+        both the local barrier and the GSET beacon must use this exact set."""
+        R = self.R
+        return jnp.sum(
+            jnp.where(
+                s["ql_out"] > 0,
+                jnp.int32(1) << jnp.arange(R, dtype=jnp.int32),
+                0,
+            ),
+            axis=2,
         )
 
     # ------------------------------------------------------ write barrier
     def _commit_cap(self, s, c, peer_f):
-        eye = jnp.eye(self.R, dtype=jnp.bool_)[None]
-        barrier = (s["ql_out"] > 0) & ~eye
-        cap = jnp.where(barrier, peer_f, jnp.iinfo(jnp.int32).max)
+        R = self.R
+        # union of live-reported outstanding grant sets (own included)
+        own_gset = self._own_gset(s)
+        live_rep = jnp.where(s["gset_ttl"] > 0, s["rep_gset"], 0)
+        ar = jnp.arange(R, dtype=jnp.int32)
+        rep_member = (
+            ((live_rep[..., :, None] >> ar[None, None, None, :]) & 1) != 0
+        ).any(axis=2)  # [G, R, R_grantee]
+        member = rep_member | (((own_gset[..., None] >> ar) & 1) != 0)
+        cap = jnp.where(member, peer_f, _INF)
         return jnp.min(cap, axis=2)
 
     # ------------------------------------------------------ grants + reads
@@ -259,44 +311,67 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         cfg = self.config
         inbox = c.inbox
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        ns_mask = ~eye
 
-        # ingest grants (any grantor; countdown math keeps overlap safe)
+        # ingest GRANT: hold the lease, bound to the grantor's conf slot
         g_valid = (c.flags & GRANT) != 0
-        got = jnp.max(jnp.where(g_valid, inbox["gr_len"], 0), axis=2)
-        s["ql_left"] = jnp.maximum(s["ql_left"], got)
-
-        # leader refreshes grants to matched conf responders
-        fire = c.active_leader & (s["grant_cnt"] <= 0)
-        s["grant_cnt"] = jnp.where(fire, cfg.grant_interval, s["grant_cnt"])
-        member = (
-            (s["conf_cur"][..., None] >> jnp.arange(R, dtype=jnp.int32))
-            & 1
-        ) != 0  # [G, R, R_grantee]
-        matched = (s["match_bal"] == s["bal_max"][..., None]) & (
-            s["match_f"] >= s["commit_bar"][..., None]
+        s["ql_in"] = jnp.where(g_valid, inbox["gr_len"], s["ql_in"])
+        s["ql_slot"] = jnp.where(g_valid, inbox["gr_slot"], s["ql_slot"])
+        # ack back to the grantor (directed: inbox axis 2 is the source)
+        do_ga = g_valid & ns_mask
+        oflags = oflags | jnp.where(do_ga, jnp.uint32(GRANT_ACK), 0)
+        ga_valid = (c.flags & GRANT_ACK) != 0
+        s["alive_cnt"] = jnp.where(
+            ga_valid, cfg.alive_timeout, s["alive_cnt"]
         )
+
+        # ingest GSET beacons: peers' outstanding-grant claims
+        gs_valid = (c.flags & GSET) != 0
+        s["rep_gset"] = jnp.where(gs_valid, inbox["gs_bits"], s["rep_gset"])
+        s["gset_ttl"] = jnp.where(
+            gs_valid, cfg.lease_len + cfg.lease_margin, s["gset_ttl"]
+        )
+
+        # every replica refreshes grants to alive configured grantees
+        fire = s["grant_cnt"] <= 0
+        s["grant_cnt"] = jnp.where(fire, cfg.grant_interval, s["grant_cnt"])
+        grantee = (
+            (s["conf_cur"][..., None] >> jnp.arange(R, dtype=jnp.int32)) & 1
+        ) != 0  # [G, R, R_grantee]
         do_grant = (
-            fire[..., None] & member & matched & (s["alive_cnt"] > 0) & ~eye
+            fire[..., None] & grantee & (s["alive_cnt"] > 0) & ns_mask
         )
         oflags = oflags | jnp.where(do_grant, jnp.uint32(GRANT), 0)
         out["gr_len"] = jnp.where(do_grant, cfg.lease_len, 0)
+        out["gr_slot"] = jnp.where(do_grant, s["conf_slot"][..., None], 0)
         s["ql_out"] = jnp.where(
             do_grant, cfg.lease_len + cfg.lease_margin, s["ql_out"]
         )
-        # the leader is its own responder when in conf (no wire needed)
-        self_member = ((s["conf_cur"] >> c.rid) & 1) != 0
-        s["ql_left"] = jnp.where(
-            c.active_leader & self_member & fire,
-            cfg.lease_len,
-            s["ql_left"],
-        )
+
+        # GSET beacon every tick (leaders may change any tick; cheap lane)
+        own_gset = self._own_gset(s)
+        do_gs = jnp.broadcast_to(ns_mask, (self.G, R, R))
+        oflags = oflags | jnp.where(do_gs, jnp.uint32(GSET), 0)
+        out["gs_bits"] = jnp.where(do_gs, own_gset[..., None], 0)
 
         out["bw_cfg"] = s["win_cfg"]
         return oflags
 
     def _effects_extra(self, s, c):
         cfg = self.config
+        R = self.R
         K = cfg.num_key_buckets
+        eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        # majority-leased check: same-conf leases from a majority of
+        # grantors (self counts as one), quorumlease.rs:10-17
+        lease_ok = (
+            (s["ql_in"] > 0)
+            & (s["ql_slot"] == s["conf_slot"][..., None])
+            & ~eye
+        )
+        lease_cnt = jnp.sum(lease_ok.astype(jnp.int32), axis=2) + 1
+        self_member = ((s["conf_cur"] >> c.rid) & 1) != 0
+        lease_held = self_member & (lease_cnt >= self.quorum)
         # pending-write buckets: un-executed tail of the own voted log
         tail = (
             (s["win_bal"] > 0)
@@ -311,8 +386,6 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         for b in range(K):  # K is small and static; unrolled bucket ORs
             has = jnp.any(tail & (bucket == b), axis=2)
             pend = pend | (has.astype(jnp.uint32) << b)
-        self_member = ((s["conf_cur"] >> c.rid) & 1) != 0
-        lease_held = self_member & (s["ql_left"] > 0)
         n_local = jnp.where(
             lease_held, K - popcount(pend & jnp.uint32((1 << K) - 1)), 0
         )
@@ -325,6 +398,7 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         )
         return {
             "lease_held": lease_held,
+            "lease_cnt": lease_cnt,
             "n_local_buckets": n_local.astype(jnp.int32),
             "leader_read_ok": leader_read_ok,
             "conf_cur": s["conf_cur"],

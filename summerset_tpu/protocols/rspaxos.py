@@ -182,6 +182,11 @@ class RSPaxosKernel(MultiPaxosKernel):
         )
         s["prep_pbal"] = new_pbal
         s["prep_pval"] = new_pval
+        self._on_prep_tally(s, c, ok, value_kept, new_pval)
+
+    def _on_prep_tally(self, s, c, ok, value_kept, new_pval):
+        """Hook: extra per-slot lanes tracked alongside the shard-holder
+        tally (Crossword records the min assignment width among voters)."""
 
     def _on_explode(self, s, c, explode):
         # seed the tally with the candidate's own voted window
@@ -193,6 +198,7 @@ class RSPaxosKernel(MultiPaxosKernel):
             & (s["win_abs"] == abs_ad)
             & (s["win_bal"] > 0)
         )
+        c.own_vote = own_vote
         own_bit = (jnp.uint32(1) << c.rid.astype(jnp.uint32))[..., None]
         s["prep_voters"] = jnp.where(
             explode[..., None],
@@ -210,6 +216,11 @@ class RSPaxosKernel(MultiPaxosKernel):
         )
 
     # -------------------------------------------------- step-up + adoption
+    def _prep_recover_need(self, s):
+        """Hook: per-slot distinct-voter count needed to rebuild a tallied
+        value (Crossword derives it from the voted assignment widths)."""
+        return jnp.full((self.G, self.R, self.W), self.num_data, jnp.int32)
+
     def _win_condition(self, s, c):
         W = self.W
         cfg = self.config
@@ -218,7 +229,8 @@ class RSPaxosKernel(MultiPaxosKernel):
         tallied = abs_ad < s["prep_hi"][..., None]
         cnt = popcount(s["prep_voters"])
         # slot resolvable: untouched, or enough distinct shards to rebuild
-        slot_ok = ~tallied | (s["prep_pbal"] == 0) | (cnt >= self.num_data)
+        need = self._prep_recover_need(s)
+        slot_ok = ~tallied | (s["prep_pbal"] == 0) | (cnt >= need)
         acks = popcount(s["prep_acks"])
         full_quorum = acks >= (self.R - cfg.fault_tolerance)
         return c.candidate & (
@@ -230,7 +242,8 @@ class RSPaxosKernel(MultiPaxosKernel):
         # shard-starved ones, provably uncommitted by the win condition)
         # become no-ops — all stamped at the new ballot
         cnt = popcount(s["prep_voters"])
-        recover = m_re & (s["prep_pbal"] > 0) & (cnt >= self.num_data)
+        need = self._prep_recover_need(s)
+        recover = m_re & (s["prep_pbal"] > 0) & (cnt >= need)
         s["win_val"] = jnp.where(
             m_re, jnp.where(recover, s["prep_pval"], NULL_VAL), s["win_val"]
         )
@@ -289,15 +302,11 @@ class RSPaxosKernel(MultiPaxosKernel):
         )
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
         cover = jnp.where(eye, own_cover[..., None], s["recon_cover"])
-        d_cover = kth_largest(cover, self.num_data)
-        s["full_bar"] = jnp.clip(
-            jnp.maximum(s["full_bar"], d_cover),
-            s["full_bar"],
-            s["commit_bar"],
-        )
+        self._advance_full_bar(s, cover)
 
         # send RECON_REQ every recon_interval ticks while starved
-        needy = s["full_bar"] < s["commit_bar"]
+        goal = self._recon_goal(s)
+        needy = s["full_bar"] < goal
         s["recon_cnt"] = jnp.where(needy, s["recon_cnt"] - 1, cfg.recon_interval)
         fire = needy & (s["recon_cnt"] <= 0)
         s["recon_cnt"] = jnp.where(fire, cfg.recon_interval, s["recon_cnt"])
@@ -305,7 +314,7 @@ class RSPaxosKernel(MultiPaxosKernel):
         oflags = oflags | jnp.where(do_rq, jnp.uint32(RECON_REQ), 0)
         out["rq_bal"] = jnp.where(do_rq, s["bal_max"][..., None], 0)
         out["rq_lo"] = jnp.where(do_rq, s["full_bar"][..., None], 0)
-        out["rq_hi"] = jnp.where(do_rq, s["commit_bar"][..., None], 0)
+        out["rq_hi"] = jnp.where(do_rq, goal[..., None], 0)
 
         # serve RECON_REQ: my current run covers [rq_lo, min(rq_hi,
         # vote_bar)) iff it reaches back to rq_lo and is at a ballot >= the
@@ -328,6 +337,21 @@ class RSPaxosKernel(MultiPaxosKernel):
         oflags = oflags | jnp.where(do_rr, jnp.uint32(RECON_REPLY), 0)
         out["rr_hi"] = jnp.where(do_rr, cover_hi, 0)
         return oflags
+
+    def _advance_full_bar(self, s, cover):
+        """Hook: advance the contiguous full-data frontier from per-peer
+        cover frontiers (Crossword uses a per-slot assignment-aware tally)."""
+        d_cover = kth_largest(cover, self.num_data)
+        s["full_bar"] = jnp.clip(
+            jnp.maximum(s["full_bar"], d_cover),
+            s["full_bar"],
+            s["commit_bar"],
+        )
+
+    def _recon_goal(self, s):
+        """Hook: upper end of the wanted reconstruct range (Crossword
+        subtracts the gossip tail-ignore margin)."""
+        return s["commit_bar"]
 
     def _effects_extra(self, s, c):
         return {"full_bar": s["full_bar"]}

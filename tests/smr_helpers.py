@@ -8,7 +8,7 @@ import numpy as np
 
 
 def run_segment(eng, state, ns, ticks, n_prop=0, alive=None, link_up=None,
-                base_start=0):
+                base_start=0, collect=False):
     """Run `ticks` ticks with constant control masks; returns (state, ns, fx).
 
     Proposal value ids are ``(base_start + tick) * P + i`` so that in a
@@ -27,7 +27,7 @@ def run_segment(eng, state, ns, ticks, n_prop=0, alive=None, link_up=None,
         seq["alive"] = jnp.broadcast_to(alive, (ticks,) + alive.shape)
     if link_up is not None:
         seq["link_up"] = jnp.broadcast_to(link_up, (ticks,) + link_up.shape)
-    return eng.run_ticks(state, ns, seq)
+    return eng.run_ticks(state, ns, seq, collect=collect)
 
 
 def committed_values(state, g, r, window, val_key="win_val"):

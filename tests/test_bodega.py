@@ -1,0 +1,236 @@
+"""Vectorized Bodega kernel tests: config leases, always-local reads at
+roster responders, the all-responders write barrier, conf changes with the
+revoke-then-adopt install barrier, and conf-based failover (reference
+behaviors: ``bodega/conflease.rs:10-47``, ``localread.rs:8-56``,
+``heartbeat.rs:85-108``, ``durability.rs:137-175``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from smr_helpers import check_agreement, committed_values, run_segment
+from summerset_tpu.core import Engine
+from summerset_tpu.protocols import make_protocol
+from summerset_tpu.protocols.bodega import ReplicaConfigBodega
+
+
+def make_kernel(G, R, W, P, **kw):
+    cfg = ReplicaConfigBodega(max_proposals_per_tick=P, **kw)
+    return make_protocol("bodega", G, R, W, cfg)
+
+
+def np_state(state):
+    return {k: np.asarray(v) for k, v in state.items()}
+
+
+def run_with_conf(eng, state, ns, ticks, n_prop, conf=None, alive=None,
+                  base_start=0):
+    """Segment runner that can carry a conf-change input on the first tick.
+
+    ``conf`` = (init_replica, leader_target, resp_bitmap, bucket or -1).
+    """
+    G = eng.kernel.G
+    P = eng.kernel.config.max_proposals_per_tick
+    t = jnp.arange(ticks, dtype=jnp.int32)
+    seq = {
+        "n_proposals": jnp.full((ticks, G), n_prop, jnp.int32),
+        "value_base": jnp.broadcast_to(
+            ((base_start + t) * P)[:, None], (ticks, G)
+        ),
+    }
+    if conf is not None:
+        init, lead, resp, bucket = conf
+        first = (t == 0).astype(jnp.int32)
+        seq["conf_init"] = jnp.broadcast_to(
+            jnp.where(first, init, -1)[:, None], (ticks, G)
+        )
+        seq["conf_leader_target"] = jnp.full((ticks, G), lead, jnp.int32)
+        seq["conf_resp_target"] = jnp.full((ticks, G), resp, jnp.int32)
+        seq["conf_bucket"] = jnp.full((ticks, G), bucket, jnp.int32)
+    if alive is not None:
+        seq["alive"] = jnp.broadcast_to(alive, (ticks,) + alive.shape)
+    return eng.run_ticks(state, ns, seq)
+
+
+class TestSteadyState:
+    def test_commit_flow_and_values(self):
+        G, R, W, P = 4, 5, 32, 4
+        k = make_kernel(G, R, W, P)
+        eng = Engine(k)
+        state, ns = eng.init()
+        T = 50
+        state, ns, _ = run_segment(eng, state, ns, T, n_prop=P)
+        st = np_state(state)
+        assert (st["commit_bar"][:, 0] >= (T - 6) * P).all(), st["commit_bar"]
+        for g in range(G):
+            vals = committed_values(st, g, 0, W)
+            assert vals
+            for slot, v in vals.items():
+                assert v == slot
+        check_agreement(st, G, R, W)
+
+    def test_sparse_heartbeats_no_spurious_failover(self):
+        # AN beacons keep conf_alive fresh every tick, so sparse heartbeats
+        # (interval near the conf timeout) cause no spurious conf failover
+        G, R, W, P = 2, 5, 32, 4
+        k = make_kernel(
+            G, R, W, P, hb_send_interval=8, conf_timeout=12,
+            hear_timeout_lo=60, hear_timeout_hi=90,
+        )
+        eng = Engine(k)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 60, n_prop=P)
+        st = np_state(state)
+        bal0 = (1 << 8) | 0
+        assert (st["conf_bal"] == bal0).all(), st["conf_bal"]
+        assert (st["conf_leader"] == 0).all()
+
+
+class TestConfLeases:
+    def test_roster_grants_and_local_reads(self):
+        # install a conf (leader 0, responders {0,1,2} on all buckets);
+        # after grants propagate, responders serve local reads on all
+        # buckets once drained (no pending writes)
+        G, R, W, P = 2, 5, 32, 4
+        k = make_kernel(G, R, W, P)
+        eng = Engine(k)
+        state, ns = eng.init()
+        resp = 0b00111
+        state, ns, _ = run_with_conf(
+            eng, state, ns, 60, n_prop=P, conf=(0, 0, resp, -1)
+        )
+        # drain writes, keep ticking so leases refresh
+        state, ns, fx = run_segment(eng, state, ns, 40, n_prop=0,
+                                    collect=True)
+        st = np_state(state)
+        K = k.config.num_key_buckets
+        assert (st["conf_leader"] == 0).all()
+        assert (st["conf_resp"] == resp).all()
+        fxe = {kk: np.asarray(v) for kk, v in fx.extra.items()}
+        last_buckets = fxe["local_read_buckets"][-1]
+        full = (1 << K) - 1
+        for r in range(3):
+            assert (last_buckets[:, r] == full).all(), (r, last_buckets)
+        for r in range(3, R):
+            assert (last_buckets[:, r] == 0).all(), (r, last_buckets)
+        assert fxe["stable_leader"][-1][:, 0].all()
+
+    def test_write_barrier_blocks_on_dead_responder_then_conf_heals(self):
+        # responder 4 dies: writes must stop committing (its ack is
+        # required); after conf failover drops it from the roster, commits
+        # resume
+        G, R, W, P = 2, 5, 64, 2
+        k = make_kernel(G, R, W, P, conf_timeout=12)
+        eng = Engine(k)
+        state, ns = eng.init()
+        resp = 0b11000  # responders {3, 4}
+        state, ns, _ = run_with_conf(
+            eng, state, ns, 40, n_prop=P, conf=(0, 0, resp, -1)
+        )
+        st = np_state(state)
+        assert (st["conf_resp"] == resp).all()
+        pre_cb = st["commit_bar"][:, 0].copy()
+        assert (pre_cb > 0).all()
+
+        alive = jnp.ones((G, R), jnp.bool_).at[:, 4].set(False)
+        # short window: barrier blocks before failover kicks in
+        state, ns, _ = run_segment(
+            eng, state, ns, 10, n_prop=P, alive=alive, base_start=1000
+        )
+        mid = np_state(state)
+        assert (mid["commit_bar"][:, 0] <= pre_cb + 3 * P).all(), (
+            pre_cb, mid["commit_bar"][:, 0],
+        )
+        # long window: conf failover drops 4, commits resume
+        state, ns, _ = run_segment(
+            eng, state, ns, 150, n_prop=P, alive=alive, base_start=2000
+        )
+        post = np_state(state)
+        assert (post["conf_resp"][:, 0] & (1 << 4) == 0).all(), (
+            post["conf_resp"][:, 0],
+        )
+        assert (post["commit_bar"][:, 0] > mid["commit_bar"][:, 0] + 5).all()
+        check_agreement(post, G, R, W)
+
+    def test_per_bucket_conf_change(self):
+        # responders set on one bucket only
+        G, R, W, P = 2, 5, 32, 2
+        k = make_kernel(G, R, W, P)
+        eng = Engine(k)
+        state, ns = eng.init()
+        state, ns, _ = run_with_conf(
+            eng, state, ns, 50, n_prop=P, conf=(0, 0, 0b00110, 3)
+        )
+        st = np_state(state)
+        K = k.config.num_key_buckets
+        for b in range(K):
+            want = 0b00110 if b == 3 else 0
+            assert (st["conf_resp"][:, :, b] == want).all(), (b, st["conf_resp"])
+
+
+class TestConfFailover:
+    def test_leader_death_conf_takeover(self):
+        # conf leader dies; a live replica volunteers via a filtered conf
+        # at a higher ballot and steps up through the campaign path
+        G, R, W, P = 2, 5, 64, 2
+        k = make_kernel(G, R, W, P, conf_timeout=12)
+        eng = Engine(k, seed=7)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 30, n_prop=P)
+        pre = np_state(state)
+        pre_committed = [committed_values(pre, g, 1, W) for g in range(G)]
+
+        alive = jnp.ones((G, R), jnp.bool_).at[:, 0].set(False)
+        state, ns, _ = run_segment(
+            eng, state, ns, 300, n_prop=P, alive=alive, base_start=1000
+        )
+        post = np_state(state)
+        # some live replica is the new conf leader and committed new slots
+        for g in range(G):
+            lead = post["conf_leader"][g, 1:]
+            assert (lead >= 1).all(), post["conf_leader"][g]
+        assert (
+            post["commit_bar"][:, 1:].max(axis=1)
+            > pre["commit_bar"][:, 1:].max(axis=1)
+        ).all()
+        # previously committed values survive
+        for g in range(G):
+            for r in range(1, R):
+                if int(post["leader"][g, r]) == r:
+                    vals = committed_values(post, g, r, W)
+                    for slot, v in pre_committed[g].items():
+                        if slot in vals:
+                            assert vals[slot] == v
+        check_agreement(post, G, R, W)
+
+
+class TestInstallBarrier:
+    def test_conf_install_waits_for_outgoing_leases(self):
+        # a replica with outgoing grants must wait out (or actively revoke)
+        # them before installing a pending conf: conf_bal stays until then
+        G, R, W, P = 2, 3, 32, 2
+        k = make_kernel(
+            G, R, W, P, lease_len=20, lease_margin=6, grant_interval=4
+        )
+        eng = Engine(k)
+        state, ns = eng.init()
+        # let leases get granted at the initial conf
+        state, ns, _ = run_segment(eng, state, ns, 12, n_prop=P)
+        st0 = np_state(state)
+        bal0 = st0["conf_bal"][0, 0]
+        assert (st0["lease_out"].max(axis=2) > 0).any()
+
+        # stage a conf change; with active revoke it installs well before
+        # the full lease_len + margin wait, but not instantly
+        state, ns, _ = run_with_conf(
+            eng, state, ns, 3, n_prop=P, conf=(1, 1, 0b011, -1),
+            base_start=100,
+        )
+        mid = np_state(state)
+        # install happened (revoke round trips are fast) or is pending
+        state, ns, _ = run_segment(eng, state, ns, 40, n_prop=P,
+                                   base_start=200)
+        fin = np_state(state)
+        assert (fin["conf_bal"] > bal0).all()
+        assert (fin["conf_leader"] == 1).all()
+        check_agreement(fin, G, R, W)

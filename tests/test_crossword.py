@@ -1,0 +1,245 @@
+"""Vectorized Crossword kernel tests: the quorum-size vs. shards-per-replica
+commit tradeoff, adaptive assignment widening under peer stall, gossip-based
+follower catch-up, and shard-aware failover (reference behaviors:
+``crossword/messages.rs:15-62,481-560``, ``adaptive.rs:274+``,
+``gossiping.rs:14-193``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from smr_helpers import check_agreement, committed_values, run_segment
+from summerset_tpu.core import Engine, NetConfig
+from summerset_tpu.protocols import make_protocol
+from summerset_tpu.protocols.crossword import ReplicaConfigCrossword
+
+
+def make_kernel(G, R, W, P, **kw):
+    cfg = ReplicaConfigCrossword(max_proposals_per_tick=P, **kw)
+    return make_protocol("crossword", G, R, W, cfg)
+
+
+def np_state(state):
+    return {k: np.asarray(v) for k, v in state.items()}
+
+
+class TestSteadyState:
+    def test_commit_flow_and_values(self):
+        G, R, W, P = 4, 5, 32, 4
+        k = make_kernel(G, R, W, P, fault_tolerance=1)
+        eng = Engine(k)
+        state, ns = eng.init()
+        T = 50
+        state, ns, _ = run_segment(eng, state, ns, T, n_prop=P)
+        st = np_state(state)
+        assert (st["commit_bar"][:, 0] >= (T - 6) * P).all(), st["commit_bar"]
+        for g in range(G):
+            vals = committed_values(st, g, 0, W)
+            assert vals
+            for slot, v in vals.items():
+                assert v == slot
+        check_agreement(st, G, R, W)
+
+    def test_diagonal_assignment_needs_rspaxos_quorum(self):
+        # spr = 1 (diagonal), f = 1, R = 5, d = 3: per-slot commit need is
+        # max(3, 1+1+(3-1)) = 4 acks — same threshold as RSPaxos; with only
+        # 3 alive the leader must stall commits
+        G, R, W, P = 2, 5, 32, 4
+        k = make_kernel(
+            G, R, W, P, fault_tolerance=1, assignment_adaptive=False
+        )
+        eng = Engine(k)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 20, n_prop=P)
+        pre = np.asarray(state["commit_bar"]).copy()
+
+        alive = (
+            jnp.ones((G, R), jnp.bool_).at[:, 3].set(False).at[:, 4].set(False)
+        )
+        state, ns, _ = run_segment(
+            eng, state, ns, 80, n_prop=P, alive=alive, base_start=1000
+        )
+        mid = np_state(state)
+        assert (mid["commit_bar"][:, 0] <= pre[:, 0] + 4 * P).all()
+        check_agreement(mid, G, R, W)
+
+    def test_full_copy_commits_at_majority(self):
+        # spr = d = 3: full-copy assignment commits at plain majority (3 of
+        # 5) even with 2 replicas down — the MultiPaxos end of the tradeoff
+        G, R, W, P = 2, 5, 32, 4
+        k = make_kernel(
+            G, R, W, P, fault_tolerance=1, init_spr=3,
+            assignment_adaptive=False,
+        )
+        eng = Engine(k)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 20, n_prop=P)
+        pre = np.asarray(state["commit_bar"]).copy()
+
+        alive = (
+            jnp.ones((G, R), jnp.bool_).at[:, 3].set(False).at[:, 4].set(False)
+        )
+        state, ns, _ = run_segment(
+            eng, state, ns, 60, n_prop=P, alive=alive, base_start=1000
+        )
+        mid = np_state(state)
+        assert (mid["commit_bar"][:, 0] > pre[:, 0] + 2 * P).all(), (
+            pre[:, 0],
+            mid["commit_bar"][:, 0],
+        )
+        check_agreement(mid, G, R, W)
+
+
+class TestAdaptive:
+    def test_widens_on_peer_stall_and_recovers(self):
+        # adaptive: with all peers live the leader uses the bandwidth-optimal
+        # diagonal (spr=1); after 2 peers stall it widens to spr=2 — the
+        # minimal width whose coverage bound (3-1-1)*1 + 2 = 3 >= d holds
+        # with only 3 ack frontiers.  Pre-stall narrow slots keep their
+        # fixed assignment (reference: per-instance assignment is set at
+        # propose time), so the ordered commit frontier wedges behind them
+        # until peers heal; then everything drains at the narrow width again
+        G, R, W, P = 2, 5, 64, 4
+        k = make_kernel(G, R, W, P, fault_tolerance=1, lag_threshold=6)
+        eng = Engine(k)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 30, n_prop=P)
+        st = np_state(state)
+        assert (st["cur_spr"][:, 0] == 1).all(), st["cur_spr"]
+        pre_cb = st["commit_bar"][:, 0].copy()
+
+        alive = (
+            jnp.ones((G, R), jnp.bool_).at[:, 3].set(False).at[:, 4].set(False)
+        )
+        state, ns, _ = run_segment(
+            eng, state, ns, 120, n_prop=P, alive=alive, base_start=1000
+        )
+        mid = np_state(state)
+        assert (mid["cur_spr"][:, 0] == 2).all(), mid["cur_spr"]
+        # pre-stall narrow slots wedge the ordered frontier (bounded creep
+        # from in-flight acks only)
+        assert (mid["commit_bar"][:, 0] <= pre_cb + 6 * P).all(), (
+            pre_cb,
+            mid["commit_bar"][:, 0],
+        )
+        check_agreement(mid, G, R, W)
+
+        # heal -> narrows back to diagonal and the backlog drains
+        state, ns, _ = run_segment(
+            eng, state, ns, 120, n_prop=P, base_start=2000
+        )
+        fin = np_state(state)
+        assert (fin["cur_spr"][:, 0] == 1).all(), fin["cur_spr"]
+        assert (fin["commit_bar"][:, 0] > mid["commit_bar"][:, 0] + 20 * P
+                ).all(), (mid["commit_bar"][:, 0], fin["commit_bar"][:, 0])
+        check_agreement(fin, G, R, W)
+
+    def test_host_override_input(self):
+        # the host perf-model plane may force a width per group
+        G, R, W, P = 2, 5, 32, 2
+        k = make_kernel(G, R, W, P, fault_tolerance=1)
+        eng = Engine(k)
+        state, ns = eng.init()
+        T = 20
+        t = jnp.arange(T, dtype=jnp.int32)
+        seq = {
+            "n_proposals": jnp.full((T, G), P, jnp.int32),
+            "value_base": jnp.broadcast_to((t * P)[:, None], (T, G)),
+            "spr_override": jnp.full((T, G), 2, jnp.int32),
+        }
+        state, ns, _ = eng.run_ticks(state, ns, seq)
+        st = np_state(state)
+        assert (st["cur_spr"][:, 0] == 2).all(), st["cur_spr"]
+        check_agreement(st, G, R, W)
+
+
+class TestGossip:
+    def test_followers_catch_up_via_gossip(self):
+        # diagonal assignment: followers hold 1 shard each and need 3 covers
+        # (d - spr + 1 = 3) to rebuild; exec/full bars catch up via gossip
+        G, R, W, P = 2, 5, 32, 2
+        k = make_kernel(
+            G, R, W, P, fault_tolerance=1, recon_interval=2,
+            assignment_adaptive=False,
+        )
+        eng = Engine(k)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 40, n_prop=P)
+        state, ns, _ = run_segment(eng, state, ns, 30, n_prop=0)
+        st = np_state(state)
+        assert (st["commit_bar"][:, 0] > 0).all()
+        cb = st["commit_bar"].max(axis=1, keepdims=True)
+        assert (st["full_bar"] >= cb).all(), (st["full_bar"], cb)
+        assert (st["exec_bar"] >= cb).all()
+
+    def test_gossip_tail_ignores(self):
+        # with a tail margin, gossip stops short of the commit frontier
+        # while proposals keep arriving
+        G, R, W, P = 2, 5, 32, 2
+        tail = 8
+        k = make_kernel(
+            G, R, W, P, fault_tolerance=1, recon_interval=2,
+            assignment_adaptive=False, gossip_tail_ignores=tail,
+        )
+        eng = Engine(k)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 60, n_prop=P)
+        st = np_state(state)
+        cb = st["commit_bar"][:, 0]
+        # followers' full bars trail by at most the tail margin (+ inflight)
+        for r in range(1, R):
+            assert (st["full_bar"][:, r] >= cb - tail - 6 * P).all(), (
+                st["full_bar"],
+                cb,
+            )
+
+
+class TestFailover:
+    def test_leader_crash_recovers_committed_values(self):
+        G, R, W, P = 4, 5, 32, 4
+        k = make_kernel(G, R, W, P, fault_tolerance=1)
+        eng = Engine(k, seed=5)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 30, n_prop=P)
+        pre = np_state(state)
+        pre_committed = [committed_values(pre, g, 1, W) for g in range(G)]
+        assert all(len(c) > 0 for c in pre_committed)
+
+        alive = jnp.ones((G, R), jnp.bool_).at[:, 0].set(False)
+        state, ns, _ = run_segment(
+            eng, state, ns, 400, n_prop=P, alive=alive, base_start=1000
+        )
+        post = np_state(state)
+        live_cb = post["commit_bar"][:, 1:]
+        assert (
+            live_cb.max(axis=1) > pre["commit_bar"][:, 1:].max(axis=1)
+        ).all(), (pre["commit_bar"], post["commit_bar"])
+        for g in range(G):
+            live = [r for r in range(1, R) if int(post["leader"][g, r]) == r]
+            for r in live:
+                vals = committed_values(post, g, r, W)
+                for slot, v in pre_committed[g].items():
+                    if slot in vals:
+                        assert vals[slot] == v, (g, r, slot, v, vals[slot])
+        check_agreement(post, G, R, W)
+
+
+class TestLossyNetwork:
+    def test_agreement_under_drops(self):
+        G, R, W, P = 2, 5, 64, 4
+        cfg = ReplicaConfigCrossword(
+            max_proposals_per_tick=P,
+            fault_tolerance=1,
+            hear_timeout_lo=40,
+            hear_timeout_hi=80,
+        )
+        k = make_protocol("crossword", G, R, W, cfg)
+        net = NetConfig(
+            delay_ticks=1, jitter_ticks=2, drop_rate=0.2, max_delay_ticks=4
+        )
+        eng = Engine(k, netcfg=net, seed=23)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 400, n_prop=P)
+        st = np_state(state)
+        assert (st["commit_bar"].max(axis=1) > 50).all()
+        check_agreement(st, G, R, W)

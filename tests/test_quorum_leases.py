@@ -121,6 +121,53 @@ class TestWriteBarrier:
         check_agreement(fin, G, R, W)
 
 
+class TestPartitionSafety:
+    def test_minority_partitioned_responder_loses_lease(self):
+        # regression: a deposed leader partitioned together with a
+        # responder must NOT be able to keep that responder serving local
+        # reads while the majority side commits new writes.  With
+        # majority-grantor leases the minority responder's lease count
+        # falls below quorum (only the old leader + itself refresh), so
+        # lease_held drops; the majority side's writes stay safe.
+        G, R, W, P = 2, 5, 48, 2
+        k = make_kernel(G, R, W, P, lease_len=12, lease_margin=4,
+                        hear_timeout_lo=30, hear_timeout_hi=50)
+        eng = Engine(k, seed=3)
+        state, ns = eng.init()
+        conf = 0b00110  # grantees {1, 2}
+        state, ns, _ = run_with_conf(eng, state, ns, 30, P, conf)
+
+        # partition {0, 1} | {2, 3, 4}
+        link = np.ones((G, R, R), bool)
+        for a in (0, 1):
+            for b in (2, 3, 4):
+                link[:, a, b] = link[:, b, a] = False
+        seq_ticks = 200
+        t = jnp.arange(seq_ticks, dtype=jnp.int32)
+        seq = {
+            "n_proposals": jnp.full((seq_ticks, G), P, jnp.int32),
+            "value_base": jnp.broadcast_to(
+                ((1000 + t) * P)[:, None], (seq_ticks, G)
+            ),
+            "conf_target": jnp.full((seq_ticks, G), conf, jnp.int32),
+            "link_up": jnp.broadcast_to(
+                jnp.asarray(link), (seq_ticks, G, R, R)
+            ),
+        }
+        state, ns, fx = eng.run_ticks(state, ns, seq, collect=True)
+        st = {k_: np.asarray(v) for k_, v in state.items()}
+        lease = np.asarray(fx.extra["lease_held"])[-1]
+        # responder 1 (minority side) lost its majority lease
+        assert not lease[:, 1].any(), lease
+        # majority side elected a leader and kept committing
+        assert (st["commit_bar"][:, 2:].max(axis=1) > 30).all(), (
+            st["commit_bar"]
+        )
+        # responder 2 (majority side) still holds a majority lease
+        assert lease[:, 2].all(), lease
+        check_agreement(st, G, R, W)
+
+
 class TestLeaderLease:
     def test_leader_reads_and_stability(self):
         G, R, W, P = 2, 5, 32, 2
