@@ -44,6 +44,7 @@ from . import bodega  # noqa: E402,F401
 from . import chain_rep  # noqa: E402,F401
 from . import craft  # noqa: E402,F401
 from . import crossword  # noqa: E402,F401
+from . import epaxos  # noqa: E402,F401
 from . import multipaxos  # noqa: E402,F401
 from . import quorum_leases  # noqa: E402,F401
 from . import raft  # noqa: E402,F401

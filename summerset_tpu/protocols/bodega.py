@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from . import register_protocol
 from .common import (
+    INF as _INF,
     initial_ballot,
     kth_largest,
     make_greater_ballot,
@@ -79,7 +80,6 @@ REVOKE = 4096        # active revoke request
 REVOKE_REPLY = 8192  # holder confirms the lease is dropped
 AN = 16384           # accept-frontier notice (AcceptNotice analog)
 
-_INF = jnp.int32(1 << 30)
 _EPOCH_BITS = jnp.uint32(ACCEPT | PREPARE | HEARTBEAT | SNAPSHOT)
 
 

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 NULL_VAL = jnp.int32(0)   # reserved value id: no-op filler
 NO_SLOT = jnp.int32(-1)   # empty window position marker
+INF = jnp.int32(1 << 30)  # frontier-min sentinel (safe to add small ints)
 
 
 # ----------------------------------------------------------------- ballots --

@@ -49,10 +49,14 @@ import dataclasses
 import jax.numpy as jnp
 
 from . import register_protocol
-from .common import advance_durability, not_self, range_cover, take_lane
+from .common import (
+    INF as _INF,
+    advance_durability,
+    not_self,
+    range_cover,
+    take_lane,
+)
 from .rspaxos import ReplicaConfigRSPaxos, RSPaxosKernel
-
-_INF = jnp.int32(1 << 30)
 
 
 @dataclasses.dataclass

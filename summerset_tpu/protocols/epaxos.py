@@ -1,0 +1,1119 @@
+"""Vectorized EPaxos: leaderless consensus over a 2-D instance space.
+
+Parity target: reference ``src/protocols/epaxos/`` (SURVEY.md §2.5) —
+Egalitarian Paxos with a 2-D instance space ``SlotIdx(row, col)``
+(``epaxos/mod.rs:199``), per-instance sequence numbers and per-row
+dependency frontiers (``DepSet``, ``mod.rs:110-124``: "dependencies here
+are naturally transitive, we just need to record the highest interfering
+column index for each row"), a fast path committing at the optimized
+super quorum ``N/2 + ceil((N/2)/2)`` when enough PreAccept replies agree
+(``mod.rs:694-697``, ``dependency.rs:180-240``), a slow Accept round at
+the simple majority otherwise, per-row ``ExpPrepare`` failover with the
+reference's decision ladder (committed > accepting > >= quorum-1
+identical non-owner preaccepts > re-propose-with-voted-value > no-op;
+``dependency.rs:249-330``), and dependency-graph execution ordering
+(``execution.rs:11-87``).
+
+TPU-first redesign (lockstep, struct-of-arrays):
+
+- **Interference tables instead of per-instance reply payloads.**  Every
+  replica broadcasts, per tick, its per-bucket interference table
+  (``tb_col[k][row]`` = highest same-bucket column bar per row,
+  ``tb_seq[k][row]`` = max seq there) plus its contiguous per-row ingest
+  frontier ``sb``.  A command leader reconstructs any peer's PreAccept
+  merge from that peer's table; the stored merge at ingest and the
+  leader-side check use the same deterministic formula (max/union over
+  rows other than the instance's own — own-row interference is always in
+  the owner's ``deps0``, since the owner knows its own row).  Tables are
+  monotone, so a fast-path identity check that passes against a *later*
+  table also passed at ingest time: fast commits are sound, and
+  interference merely demotes to the slow path — exactly EPaxos's
+  behavior.  The "two interfering commits: at least one deps the other"
+  invariant holds because a leader counts a peer only once the peer's
+  ``sb`` covers the instance, and ``sb`` ships with the same tick's
+  table.
+- **Window-bitmask acks**: acceptors report, per row owner, a uint32
+  bitmask over that row's window held at Accepting-or-higher at the
+  row's current ballot (``rp_acc``); slow-path commits tally bit counts.
+  Requires ``window <= 32``.
+- **Execution** (device-only mode) is a row-frontier heuristic: per row,
+  the first unexecuted instance; a row-level dependency closure (R x R
+  boolean squaring) detects cycles, broken by ``(seq, row)`` order — the
+  reference's SCC-topo + seq-within-SCC order at row granularity.  Known
+  deviation: chains mixing distinct instance-level SCCs inside one
+  row-level cycle may execute in seq order rather than topo order; the
+  host applier (``exec_floor_rows`` input) is the authoritative path and
+  runs exact Tarjan per committed frontier (SURVEY.md §7).
+- **Row failover**: the nearest alive ring predecessor-chain successor
+  volunteers as recoverer for a dead row, campaigns with per-row ERP
+  ballots, gathers survivors' stored copies through response lanes, walks
+  the reference's decision ladder, and drives outcomes through recovery
+  lanes at the ERP ballot.  A replica whose own row wedges (e.g. revived
+  after its row was partially recovered at a higher ballot) heals by
+  running the same machinery on its own row.
+
+Caveat (mirrored from the reference): the decision ladder implements the
+original EPaxos paper's recovery, whose optimized-quorum corner (a fast
+commit whose surviving identical preaccepts fall below ``quorum - 1`` in
+the recovery quorum) is known to be unsound in theory ("EPaxos
+Revisited", NSDI'21).  The reference carries the same semantics
+(``dependency.rs:288-307``); we match it rather than silently diverge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from types import SimpleNamespace
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from ..core.protocol import ProtocolKernel, StepEffects
+from . import register_protocol
+from .common import INF as _INF, make_greater_ballot, range_cover
+
+# flag bits
+BEACON = 1    # ow/tb/sb/rp_acc lanes valid (sent every tick)
+ERP = 2       # explicit-prepare campaign for erp_row at erp_bal
+RV = 4        # rv_* lanes carry my stored copy of rv_row (ERP response)
+RO = 8        # ro_* lanes drive a recovered row at ro_bal
+
+# status codes (parity: Status enum, epaxos/mod.rs:137-146)
+NULL, PREACC, ACCEPTING, COMMITTED = 0, 1, 2, 3
+
+
+@dataclasses.dataclass
+class ReplicaConfigEPaxos:
+    """Static knobs (parity: ``ReplicaConfigEPaxos``, ``epaxos/mod.rs``)."""
+
+    max_proposals_per_tick: int = 8   # per group, split across replicas
+    num_key_buckets: int = 8          # conflict-detection key buckets
+    optimized_quorum: bool = True     # super = N/2 + ceil((N/2)/2)
+    alive_timeout: int = 30           # ticks silent -> peer considered dead
+    stall_timeout: int = 40           # own-row wedge -> self-ERP heal
+    exec_follows_commit: bool = True  # device-only exec heuristic on
+
+
+@register_protocol("EPaxos")
+class EPaxosKernel(ProtocolKernel):
+    broadcast_lanes = frozenset({
+        "ow_abs", "ow_phase", "ow_bal", "ow_seq", "ow_val", "ow_noop",
+        "ow_deps", "tb_col", "tb_seq", "sb",
+        "ro_row", "ro_bal", "ro_abs", "ro_phase", "ro_seq", "ro_val",
+        "ro_noop", "ro_deps",
+        "rv_row", "rv_bal", "rv_abs", "rv_st", "rv_vbal", "rv_seq",
+        "rv_val", "rv_noop", "rv_deps",
+    })
+
+    def __init__(
+        self,
+        num_groups: int,
+        population: int,
+        window: int = 32,
+        config: ReplicaConfigEPaxos | None = None,
+    ):
+        super().__init__(num_groups, population, window)
+        if window > 32:
+            raise ValueError("epaxos window must be <= 32 (uint32 ack masks)")
+        self.config = config or ReplicaConfigEPaxos()
+        half = population // 2
+        self.simple_q = half + 1
+        self.super_q = (
+            half + -(-half // 2) if self.config.optimized_quorum else 2 * half
+        )
+        self.super_q = max(self.simple_q, min(self.super_q, population))
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, seed: int = 0):
+        G, R, W, K = self.G, self.R, self.W, self.config.num_key_buckets
+        i32 = jnp.int32
+        z = lambda *s: jnp.zeros(s, i32)  # noqa: E731
+        return {
+            "own_next": z(G, R),
+            # 2-D instance space [G, R, row, W] (+ deps [..., R]); window
+            # position p of a row holds the column c == p (mod W), with
+            # abs2 recording which c (-1 = empty)
+            "abs2": jnp.full((G, R, R, W), -1, i32),
+            "st2": z(G, R, R, W),
+            "bal2": z(G, R, R, W),
+            "seq2": z(G, R, R, W),
+            "val2": z(G, R, R, W),
+            "noop2": jnp.zeros((G, R, R, W), jnp.bool_),
+            "deps2": z(G, R, R, W, R),
+            # per-row frontiers
+            "seen_bar": z(G, R, R),
+            "cmt_row": z(G, R, R),
+            "exec_row": z(G, R, R),
+            "ext_row": z(G, R, R),
+            # per-bucket interference tables
+            "it_col": z(G, R, K, R),
+            "it_seq": z(G, R, K, R),
+            # per-row ballot ceiling + the column extent it protects
+            "rbm": z(G, R, R),
+            "rbm_ext": z(G, R, R),
+            # liveness + recovery driver + own-row wedge detector
+            "alive_cnt": jnp.full((G, R, R), self.config.alive_timeout, i32),
+            "rec_row": jnp.full((G, R), -1, i32),
+            "rec_bal": z(G, R),
+            "stall_cnt": jnp.full((G, R), self.config.stall_timeout, i32),
+            "last_cmt": z(G, R),
+            # engine-required aggregate bars
+            "commit_bar": z(G, R),
+            "exec_bar": z(G, R),
+        }
+
+    def zero_outbox(self):
+        G, R, W, K = self.G, self.R, self.W, self.config.num_key_buckets
+        i32 = jnp.int32
+        wl = lambda: jnp.zeros((G, R, W), i32)  # noqa: E731
+        wb = lambda: jnp.zeros((G, R, W), jnp.bool_)  # noqa: E731
+        pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        return {
+            "flags": jnp.zeros((G, R, R), jnp.uint32),
+            "rp_acc": jnp.zeros((G, R, R), jnp.uint32),
+            "erp_row": pair(), "erp_bal": pair(), "erp_ext": pair(),
+            "ow_abs": jnp.full((G, R, W), -1, i32),
+            "ow_phase": wl(), "ow_bal": wl(), "ow_seq": wl(), "ow_val": wl(),
+            "ow_noop": wb(), "ow_deps": jnp.zeros((G, R, W, R), i32),
+            "tb_col": jnp.zeros((G, R, K, R), i32),
+            "tb_seq": jnp.zeros((G, R, K, R), i32),
+            "sb": jnp.zeros((G, R, R), i32),
+            "ro_row": jnp.full((G, R), -1, i32), "ro_bal": jnp.zeros((G, R), i32),
+            "ro_abs": jnp.full((G, R, W), -1, i32),
+            "ro_phase": wl(), "ro_seq": wl(), "ro_val": wl(),
+            "ro_noop": wb(), "ro_deps": jnp.zeros((G, R, W, R), i32),
+            "rv_row": jnp.full((G, R), -1, i32), "rv_bal": jnp.zeros((G, R), i32),
+            "rv_abs": jnp.full((G, R, W), -1, i32),
+            "rv_st": wl(), "rv_vbal": wl(), "rv_seq": wl(), "rv_val": wl(),
+            "rv_noop": wb(), "rv_deps": jnp.zeros((G, R, W, R), i32),
+        }
+
+    # ------------------------------------------------------------- helpers
+    def _default_bal(self, row):
+        """Default (pre-failover) ballot of a row."""
+        return (jnp.int32(1) << 8) | row
+
+    def _row_slice(self, s, key, row):
+        """Gather s[key][g, r, row[g, r]] -> [G, R, W(, R)]."""
+        G = self.G
+        gar = jnp.arange(G)[:, None]
+        rar = jnp.arange(self.R)[None, :]
+        return s[key][gar, rar, row]
+
+    def _own_scatter(self, s, c, key, lane):
+        """Scatter a [G, R, W(, R)] lane into s[key] at row = own rid."""
+        R = self.R
+        if s[key].ndim == 5:
+            sel = (
+                jnp.arange(R)[None, None, :, None, None]
+                == c.rid[:, :, None, None, None]
+            )
+            s[key] = jnp.where(sel, lane[:, :, None], s[key])
+        else:
+            sel = (
+                jnp.arange(R)[None, None, :, None]
+                == c.rid[:, :, None, None]
+            )
+            s[key] = jnp.where(sel, lane[:, :, None], s[key])
+
+    def _bucket_gather(self, table, bucket):
+        """table [G, A, K, R], bucket [G, A, ...] -> [G, A, ..., R]."""
+        K = self.config.num_key_buckets
+        G, A = table.shape[0], table.shape[1]
+        gar = jnp.arange(G).reshape((G,) + (1,) * (bucket.ndim - 1))
+        aar = jnp.arange(A).reshape((1, A) + (1,) * (bucket.ndim - 2))
+        return table[gar, aar, bucket.clip(0, K - 1)]
+
+    def _bump_tables(self, s, m, abs_col, bucket, seq):
+        """Fold applied instances (masked [G, R, row, W]) into tables."""
+        K = self.config.num_key_buckets
+        kar = jnp.arange(K)[None, None, :, None, None]
+        mb = m[:, :, None] & (bucket[:, :, None] == kar)  # [G,R,K,row,W]
+        col_c = jnp.max(jnp.where(mb, abs_col[:, :, None] + 1, 0), axis=4)
+        seq_c = jnp.max(jnp.where(mb, seq[:, :, None], 0), axis=4)
+        s["it_col"] = jnp.maximum(s["it_col"], col_c)
+        s["it_seq"] = jnp.maximum(s["it_seq"], seq_c)
+
+    # ------------------------------------------------------------------ step
+    def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
+        s = dict(state)
+        c = SimpleNamespace(inbox=inbox, inputs=inputs, flags=inbox["flags"])
+        G, R = self.G, self.R
+        c.rid = jnp.broadcast_to(
+            jnp.arange(R, dtype=jnp.int32)[None, :], (G, R)
+        )
+        c.eye = jnp.eye(R, dtype=jnp.bool_)[None]
+        c.heard = c.flags != 0
+
+        self._liveness(s, c)
+        self._ingest_erp(s, c)
+        self._ingest_recovery_drive(s, c)
+        self._ingest_own_streams(s, c)
+        self._leader_decide(s, c)
+        self._recovery_control(s, c)
+        self._propose(s, c)
+        self._advance_commit_rows(s, c)
+        self._execute(s, c)
+        out = self._build_outbox(s, c)
+        fx = self._effects(s, c)
+        return s, out, fx
+
+    # ========== liveness
+    def _liveness(self, s, c):
+        s["alive_cnt"] = jnp.where(
+            c.heard | c.eye,
+            self.config.alive_timeout,
+            jnp.maximum(s["alive_cnt"] - 1, 0),
+        )
+
+    # ========== ERP campaigns (acceptor side): raise per-row ballot ceiling
+    def _ingest_erp(self, s, c):
+        inbox = c.inbox
+        erp_valid = (c.flags & ERP) != 0           # [G, R, src]
+        rows = jnp.arange(self.R)[None, None, :, None]
+        m = erp_valid[:, :, None, :] & (
+            inbox["erp_row"][:, :, None, :] == rows
+        )
+        best = jnp.max(jnp.where(m, inbox["erp_bal"][:, :, None, :], 0),
+                       axis=3)                     # [G, R, row]
+        ext = jnp.max(jnp.where(m, inbox["erp_ext"][:, :, None, :], 0),
+                      axis=3)
+        newer = best > s["rbm"]
+        s["rbm"] = jnp.where(newer, best, s["rbm"])
+        s["rbm_ext"] = jnp.where(
+            newer, jnp.maximum(s["rbm_ext"], ext), s["rbm_ext"]
+        )
+
+    # ========== shared ingestion core
+    def _apply_lanes(self, s, c, lanes, bal_lane, row_mask):
+        """Apply per-(row, window) lanes (abs/phase/seq/val/noop/deps) onto
+        the 2-D log.  ``row_of``: [G, R, row] bool — which (replica, row)
+        pairs these lanes target; lanes are [G, R, row, W(, R)].  Phase 1
+        entries merge against my tables; phases 2/3 adopt verbatim.
+        Committed entries never regress.  Returns the applied mask."""
+        K = self.config.num_key_buckets
+        W, R = self.W, self.R
+        l_abs, l_ph, l_seq, l_val, l_noop, l_deps = lanes
+        pos_ok = (
+            row_mask
+            & (l_abs >= 0)
+            & (l_abs % W == jnp.arange(W)[None, None, None, :])
+            & (l_ph >= PREACC)
+            & (bal_lane > 0)
+            & (l_abs >= s["exec_row"][..., None])
+            & (l_abs < s["exec_row"][..., None] + W)
+        )
+        # ballot gates: entries under a row's ERP-protected extent need the
+        # ceiling ballot; above it the default ballot is fine
+        bal_ok = (bal_lane >= s["rbm"][..., None]) | (
+            l_abs >= s["rbm_ext"][..., None]
+        )
+        pos_ok = pos_ok & bal_ok
+
+        cur = s["abs2"] == l_abs
+        fresh = pos_ok & ~cur & (
+            (s["abs2"] < l_abs) | (s["st2"] == NULL)
+        )
+        upgrade = pos_ok & cur & (
+            (l_ph > s["st2"]) | ((l_ph == s["st2"]) & (bal_lane > s["bal2"]))
+        ) & ~((s["st2"] == COMMITTED) & (l_ph < COMMITTED))
+        apply_m = fresh | upgrade
+
+        # phase-1 merge against my (pre-application) tables
+        bucket = l_val % K
+        itc = self._bucket_gather(s["it_col"], bucket)  # [G,R,row,W,R]
+        its = self._bucket_gather(s["it_seq"], bucket)
+        not_own = (
+            jnp.arange(R)[None, None, None, None, :]
+            != jnp.arange(R)[None, None, :, None, None]
+        )
+        merge_seq = jnp.maximum(
+            l_seq, 1 + jnp.max(jnp.where(not_own, its, 0), axis=4)
+        )
+        merge_deps = jnp.where(not_own, jnp.maximum(l_deps, itc), l_deps)
+        is_pre = l_ph == PREACC
+        take_seq = jnp.where(is_pre & fresh, merge_seq, l_seq)
+        take_deps = jnp.where(
+            (is_pre & fresh)[..., None], merge_deps, l_deps
+        )
+
+        s["abs2"] = jnp.where(apply_m, l_abs, s["abs2"])
+        s["st2"] = jnp.where(apply_m, l_ph, s["st2"])
+        s["bal2"] = jnp.where(apply_m, bal_lane, s["bal2"])
+        s["seq2"] = jnp.where(apply_m, take_seq, s["seq2"])
+        s["val2"] = jnp.where(apply_m, l_val, s["val2"])
+        s["noop2"] = jnp.where(apply_m, l_noop, s["noop2"])
+        s["deps2"] = jnp.where(apply_m[..., None], take_deps, s["deps2"])
+        self._bump_tables(
+            s, apply_m & ~l_noop, l_abs, bucket, take_seq
+        )
+        return apply_m
+
+    # ========== recovery drive lanes (acceptor side)
+    def _ingest_recovery_drive(self, s, c):
+        G, R, W = self.G, self.R, self.W
+        inbox = c.inbox
+        has = (c.flags & RO) != 0                          # [G, R, src]
+        rows = jnp.arange(R)[None, None, :, None]
+        m = has[:, :, None, :] & (
+            inbox["ro_row"][:, None, None, :] == rows
+        )
+        eff = jnp.where(m, inbox["ro_bal"][:, None, None, :], 0)
+        best_bal = eff.max(axis=3)                         # [G, R, row]
+        best_src = eff.argmax(axis=3).astype(jnp.int32)
+        ok_row = (best_bal > 0) & (best_bal >= s["rbm"])
+        s["rbm"] = jnp.where(ok_row, best_bal, s["rbm"])
+
+        gar = jnp.arange(G)[:, None, None]
+
+        def lane(name):
+            return inbox[name][gar, best_src]  # [G, R, row, W(, R)]
+
+        lanes = (lane("ro_abs"), lane("ro_phase"), lane("ro_seq"),
+                 lane("ro_val"), lane("ro_noop"), lane("ro_deps"))
+        bal_lane = jnp.where(
+            lanes[1] > 0, best_bal[..., None], 0
+        )
+        self._apply_lanes(s, c, lanes, bal_lane, ok_row[..., None])
+
+    # ========== own-row preaccept/accept/commit stream ingestion
+    def _ingest_own_streams(self, s, c):
+        G, R, W = self.G, self.R, self.W
+        inbox = c.inbox
+        beacon = ((c.flags & BEACON) != 0) & ~c.eye  # [G, R, src(=row)]
+
+        bshape = (G, R, R, W)
+
+        def lane(name, extra=()):
+            return jnp.broadcast_to(
+                inbox[name][:, None], bshape + extra
+            )
+
+        lanes = (
+            lane("ow_abs"), lane("ow_phase"), lane("ow_seq"),
+            lane("ow_val"), lane("ow_noop"), lane("ow_deps", (R,)),
+        )
+        bal_lane = lane("ow_bal")
+        self._apply_lanes(s, c, lanes, bal_lane, beacon[..., None])
+
+        # advance contiguous ingest frontiers: seen_bar per row walks over
+        # stored entries (abs2 alignment), independent of this tick's lanes
+        _, abs_w = range_cover(s["seen_bar"], s["seen_bar"] + W, W)
+        present = (s["abs2"] == abs_w) & (s["st2"] >= PREACC)
+        gap = (abs_w >= s["seen_bar"][..., None]) & ~present
+        first_gap = jnp.min(jnp.where(gap, abs_w, _INF), axis=3)
+        s["seen_bar"] = jnp.clip(
+            first_gap, s["seen_bar"], s["seen_bar"] + W
+        )
+        # extent: max of my frontier and peers' reported frontiers
+        sb_peers = jnp.broadcast_to(inbox["sb"][:, None], (G, R, R, R))
+        sb_max = jnp.max(
+            jnp.where(beacon[..., None], sb_peers, 0), axis=2
+        )
+        s["ext_row"] = jnp.maximum(
+            jnp.maximum(s["ext_row"], s["seen_bar"]), sb_max
+        )
+
+    # ========== command-leader decisions on own row (fast/slow/commit)
+    def _leader_decide(self, s, c):
+        G, R, W, K = self.G, self.R, self.W, self.config.num_key_buckets
+        inbox = c.inbox
+        rid = c.rid
+        beacon = ((c.flags & BEACON) != 0) & ~c.eye
+
+        st_o = self._row_slice(s, "st2", rid)
+        abs_o = self._row_slice(s, "abs2", rid)
+        bal_o = self._row_slice(s, "bal2", rid)
+        seq_o = self._row_slice(s, "seq2", rid)
+        val_o = self._row_slice(s, "val2", rid)
+        deps_o = self._row_slice(s, "deps2", rid)  # [G, R, W, R]
+        dbal = self._default_bal(rid)[..., None]
+        live = (abs_o >= 0) & (abs_o < s["own_next"][..., None]) & (
+            bal_o == dbal
+        )
+
+        # peers' ingest coverage of my row: inbox sb is [G, src, row];
+        # swap to [G, row(me), src]
+        sb_for_me = jnp.swapaxes(inbox["sb"], 1, 2)
+        gar = jnp.arange(G)[:, None, None]
+        sb_mine = sb_for_me[gar[..., 0], rid]          # [G, R(me), src]
+        sb_mine = jnp.where(beacon, sb_mine, 0)
+        ing = sb_mine[:, :, :, None] > abs_o[:, :, None, :]  # [G,me,p,W]
+
+        # fast-path identity reconstruction from peers' tables
+        bucket = val_o % K
+        gar4 = jnp.arange(G)[:, None, None, None]
+        par = jnp.arange(R)[None, None, :, None]
+        bidx = bucket[:, :, None, :]
+        tbc = inbox["tb_col"][gar4, par, bidx.clip(0, K - 1)]
+        tbs = inbox["tb_seq"][gar4, par, bidx.clip(0, K - 1)]
+        # tbc/tbs: [G, me, p, W, row]
+        not_own = (
+            jnp.arange(R)[None, None, None, None, :]
+            != rid[:, :, None, None, None]
+        )
+        extra_seq = 1 + jnp.max(jnp.where(not_own, tbs, 0), axis=4)
+        seq_same = extra_seq <= seq_o[:, :, None, :]
+        deps_same = ~jnp.any(
+            not_own & (tbc > deps_o[:, :, None, :, :]), axis=4
+        )
+        identical = seq_same & deps_same              # [G, me, p, W]
+
+        fast_votes = 1 + jnp.sum(
+            (ing & identical).astype(jnp.int32), axis=2
+        )
+        ing_cnt = 1 + jnp.sum(ing.astype(jnp.int32), axis=2)
+        alive_total = jnp.sum(
+            (s["alive_cnt"] > 0).astype(jnp.int32), axis=2
+        )  # includes self
+
+        pending = live & (st_o == PREACC)
+        decide = pending & (
+            (ing_cnt >= self.super_q)
+            | ((ing_cnt >= self.simple_q)
+               & (ing_cnt >= alive_total[..., None]))
+        )
+        fast = decide & (fast_votes >= self.super_q)
+        slow = decide & ~fast
+
+        # slow-path union attrs from ingested peers' tables + my own
+        u_seq = jnp.maximum(
+            seq_o, jnp.max(jnp.where(ing, extra_seq, 0), axis=2)
+        )
+        u_deps = jnp.maximum(
+            deps_o, jnp.max(jnp.where(ing[..., None], tbc, 0), axis=2)
+        )
+        own_r = jnp.arange(R)[None, None, None, :] == rid[..., None, None]
+        u_deps = jnp.where(own_r, deps_o, u_deps)
+
+        # accept tally via rp_acc bitmasks at the row's current ballot
+        accing = live & (st_o == ACCEPTING)
+        acc_bits = jnp.where(beacon, inbox["rp_acc"], jnp.uint32(0))
+        bitpos = (abs_o % W).astype(jnp.uint32)
+        acc_cnt = 1 + jnp.sum(
+            ((acc_bits[:, :, :, None] >> bitpos[:, :, None, :]) & 1).astype(
+                jnp.int32
+            ),
+            axis=2,
+        )
+        acc_done = accing & (acc_cnt >= self.simple_q)
+
+        new_st = jnp.where(
+            fast | acc_done, COMMITTED, jnp.where(slow, ACCEPTING, st_o)
+        )
+        new_seq = jnp.where(slow, u_seq, seq_o)
+        new_deps = jnp.where(slow[..., None], u_deps, deps_o)
+        self._own_scatter(s, c, "st2", new_st)
+        self._own_scatter(s, c, "seq2", new_seq)
+        self._own_scatter(s, c, "deps2", new_deps)
+        # slow-path seq bumps also feed the tables
+        own_sel = jnp.arange(R)[None, None, :, None] == rid[:, :, None, None]
+        self._bump_tables(
+            s,
+            (slow & ~self._row_slice(s, "noop2", rid))[:, :, None, :]
+            & own_sel,
+            jnp.broadcast_to(abs_o[:, :, None, :], (G, R, R, W)),
+            jnp.broadcast_to(bucket[:, :, None, :], (G, R, R, W)),
+            jnp.broadcast_to(new_seq[:, :, None, :], (G, R, R, W)),
+        )
+
+    # ========== recovery control: volunteer, campaign, decide, drive
+    def _recovery_control(self, s, c):
+        G, R = self.G, self.R
+        inbox = c.inbox
+        rid = c.rid
+        dead = (s["alive_cnt"] <= 0) & ~c.eye[0][None]  # [G, R, peer]
+
+        # volunteer for the nearest dead ring-predecessor whose in-between
+        # predecessors are all dead too (deterministic, collision-free
+        # among live replicas)
+        vol_tgt = jnp.full((G, R), -1, jnp.int32)
+        taken = jnp.zeros((G, R), jnp.bool_)
+        chain = jnp.ones((G, R), jnp.bool_)
+        for k in range(1, R):
+            cand = (rid - k) % R
+            cand_dead = jnp.take_along_axis(
+                dead, cand[..., None], axis=2
+            )[..., 0]
+            # skip rows already fully recovered so a chain of adjacent
+            # dead replicas gets each of its rows driven in turn
+            cand_done = (
+                jnp.take_along_axis(s["cmt_row"], cand[..., None], axis=2)
+                >= jnp.take_along_axis(s["ext_row"], cand[..., None], axis=2)
+            )[..., 0]
+            pick = cand_dead & ~cand_done & chain & ~taken
+            vol_tgt = jnp.where(pick, cand, vol_tgt)
+            taken = taken | pick
+            chain = chain & cand_dead
+
+        # own-row wedge detector -> self-ERP heal
+        own_cmt = jnp.take_along_axis(s["cmt_row"], rid[..., None], axis=2)[
+            ..., 0
+        ]
+        wedged = own_cmt < s["own_next"]
+        prog = own_cmt > s["last_cmt"]
+        s["last_cmt"] = own_cmt
+        s["stall_cnt"] = jnp.where(
+            prog | ~wedged,
+            self.config.stall_timeout,
+            jnp.maximum(s["stall_cnt"] - 1, 0),
+        )
+        self_heal = wedged & (s["stall_cnt"] <= 0)
+        vol_tgt = jnp.where(
+            (vol_tgt < 0) & self_heal, rid, vol_tgt
+        )
+
+        # start / continue / finish / abort
+        cur = s["rec_row"]
+        cur_c = jnp.maximum(cur, 0)
+        cur_dead = jnp.take_along_axis(dead, cur_c[..., None], axis=2)[
+            ..., 0
+        ]
+        cur_done = (
+            jnp.take_along_axis(s["cmt_row"], cur_c[..., None], axis=2)[
+                ..., 0
+            ]
+            >= jnp.take_along_axis(s["ext_row"], cur_c[..., None], axis=2)[
+                ..., 0
+            ]
+        )
+        keep = (cur >= 0) & ~cur_done & (cur_dead | (cur == rid))
+        start = (cur < 0) & (vol_tgt >= 0) | ((cur >= 0) & ~keep
+                                              & (vol_tgt >= 0))
+        tgt = jnp.where(keep, cur, jnp.where(start, vol_tgt, -1))
+        s["rec_row"] = tgt
+        tgt_c = jnp.maximum(tgt, 0)
+        tgt_rbm = jnp.take_along_axis(s["rbm"], tgt_c[..., None], axis=2)[
+            ..., 0
+        ]
+        # bid once per campaign; re-bid only when a strictly higher foreign
+        # ballot appears (the local rbm claim below equals rec_bal, so a
+        # non-strict check would re-bid every tick and outrun the
+        # one-delay echo in responders' RV replies)
+        need_bid = (tgt >= 0) & (start | (s["rec_bal"] < tgt_rbm))
+        s["rec_bal"] = jnp.where(
+            need_bid,
+            make_greater_ballot(tgt_rbm, rid),
+            jnp.where(tgt >= 0, s["rec_bal"], 0),
+        )
+        # claim the ballot ceiling locally
+        tgt_ext = jnp.take_along_axis(s["ext_row"], tgt_c[..., None],
+                                      axis=2)[..., 0]
+        claim = (
+            jnp.arange(R)[None, None, :] == tgt[..., None]
+        ) & (tgt >= 0)[..., None]
+        s["rbm"] = jnp.where(
+            claim, jnp.maximum(s["rbm"], s["rec_bal"][..., None]), s["rbm"]
+        )
+        s["rbm_ext"] = jnp.where(
+            claim, jnp.maximum(s["rbm_ext"], tgt_ext[..., None]),
+            s["rbm_ext"],
+        )
+
+        # tally this tick's RV responses to my campaign
+        rv_on = (c.flags & RV) != 0
+        rv_row_in = jnp.broadcast_to(inbox["rv_row"][:, None], (G, R, R))
+        rv_bal_in = jnp.broadcast_to(inbox["rv_bal"][:, None], (G, R, R))
+        rv_mine = (
+            rv_on
+            & (rv_row_in == tgt[..., None])
+            & (rv_bal_in == s["rec_bal"][..., None])
+            & (tgt >= 0)[..., None]
+        )
+        c.rec_tgt = tgt
+        c.rv_mine = rv_mine
+        c.rec_have_q = 1 + jnp.sum(
+            rv_mine.astype(jnp.int32), axis=2
+        ) >= self.simple_q
+
+    # ========== proposals (every replica is a command leader)
+    def _propose(self, s, c):
+        G, R, W, K = self.G, self.R, self.W, self.config.num_key_buckets
+        i32 = jnp.int32
+        rid = c.rid
+        n_prop = jnp.broadcast_to(
+            c.inputs["n_proposals"][:, None].astype(i32), (G, R)
+        )
+        share = n_prop // R + (rid < (n_prop % R)).astype(i32)
+        own_exec = jnp.take_along_axis(
+            s["exec_row"], rid[..., None], axis=2
+        )[..., 0]
+        space = jnp.maximum(own_exec + W - s["own_next"], 0)
+        n_new = jnp.minimum(share, space)
+        vbase = jnp.broadcast_to(
+            c.inputs["value_base"][:, None].astype(i32), (G, R)
+        )
+        m_new, abs_new = range_cover(s["own_next"], s["own_next"] + n_new, W)
+        off = abs_new - s["own_next"][..., None]
+        # distinct value ids across replicas: interleave by rid
+        new_vals = vbase[..., None] * R + rid[..., None] + off * R
+        bucket = new_vals % K
+
+        # seq0/deps0 from my tables
+        itc = self._bucket_gather(s["it_col"], bucket)  # [G,R,W,row]
+        its = self._bucket_gather(s["it_seq"], bucket)
+        seq0 = 1 + jnp.max(its, axis=3)
+        deps0 = itc
+        # intra-batch same-bucket chaining: rank among same-bucket batch
+        # positions bumps seq; dep on the immediately preceding one
+        for kb in range(K):
+            mk = m_new & (bucket == kb)
+            before = (
+                mk[..., None, :] & mk[..., :, None]
+                & (off[..., None, :] < off[..., :, None])
+            )  # [G,R,W(i),W(j<i)]
+            rank = jnp.sum(before.astype(i32), axis=3)
+            seq0 = jnp.where(mk, seq0 + rank, seq0)
+            prev_bar = jnp.max(
+                jnp.where(before, abs_new[..., None, :] + 1, 0), axis=3
+            )
+            own_sel = (
+                jnp.arange(R)[None, None, None, :] == rid[..., None, None]
+            )
+            deps0 = jnp.where(
+                own_sel & mk[..., None],
+                jnp.maximum(deps0, prev_bar[..., None]),
+                deps0,
+            )
+
+        dlane = jnp.broadcast_to(
+            self._default_bal(rid)[..., None], (G, R, W)
+        )
+        for key, lane in (
+            ("abs2", jnp.where(m_new, abs_new, self._row_slice(s, "abs2", rid))),
+            ("st2", jnp.where(m_new, PREACC, self._row_slice(s, "st2", rid))),
+            ("bal2", jnp.where(m_new, dlane, self._row_slice(s, "bal2", rid))),
+            ("seq2", jnp.where(m_new, seq0, self._row_slice(s, "seq2", rid))),
+            ("val2", jnp.where(m_new, new_vals,
+                               self._row_slice(s, "val2", rid))),
+            ("noop2", jnp.where(m_new, False,
+                                self._row_slice(s, "noop2", rid))),
+        ):
+            self._own_scatter(s, c, key, lane)
+        deps_lane = jnp.where(
+            m_new[..., None], deps0, self._row_slice(s, "deps2", rid)
+        )
+        self._own_scatter(s, c, "deps2", deps_lane)
+        s["own_next"] = s["own_next"] + n_new
+        own_sel3 = jnp.arange(R)[None, None, :] == rid[..., None]
+        s["seen_bar"] = jnp.where(
+            own_sel3, s["own_next"][..., None], s["seen_bar"]
+        )
+        s["ext_row"] = jnp.maximum(s["ext_row"], s["seen_bar"])
+        self._bump_tables(
+            s,
+            m_new[:, :, None, :] & own_sel3[..., None],
+            jnp.broadcast_to(abs_new[:, :, None, :], (G, R, R, W)),
+            jnp.broadcast_to(bucket[:, :, None, :], (G, R, R, W)),
+            jnp.broadcast_to(seq0[:, :, None, :], (G, R, R, W)),
+        )
+        c.n_new = n_new
+
+    # ========== per-row contiguous commit frontier
+    def _advance_commit_rows(self, s, c):
+        W = self.W
+        _, abs_w = range_cover(s["cmt_row"], s["cmt_row"] + W, W)
+        ok = (s["abs2"] == abs_w) & (s["st2"] == COMMITTED)
+        fail = (abs_w >= s["cmt_row"][..., None]) & ~ok
+        first_fail = jnp.min(jnp.where(fail, abs_w, _INF), axis=3)
+        s["cmt_row"] = jnp.clip(
+            first_fail, s["cmt_row"], s["cmt_row"] + W
+        )
+        s["commit_bar"] = jnp.sum(s["cmt_row"], axis=2)
+
+    # ========== execution: row-frontier heuristic with cycle breaking
+    def _execute(self, s, c):
+        G, R, W = self.G, self.R, self.W
+        if not self.config.exec_follows_commit:
+            floor = c.inputs["exec_floor_rows"].astype(jnp.int32)
+            s["exec_row"] = jnp.clip(floor, s["exec_row"], s["cmt_row"])
+            s["exec_bar"] = jnp.sum(s["exec_row"], axis=2)
+            return
+        gar = jnp.arange(G)[:, None, None]
+        rar = jnp.arange(R)[None, :, None]
+        rowar = jnp.arange(R)[None, None, :]
+        # R passes per tick: a row-cycle of m rows drains one instance per
+        # pass (min key first), so R passes keep up with a full round of
+        # per-row commits each tick
+        go_passes, seq_passes, val_passes = [], [], []
+        for _ in range(R):
+            pos = s["exec_row"] % W
+            x_seq = s["seq2"][gar, rar, rowar, pos]
+            x_deps = s["deps2"][gar, rar, rowar, pos]   # [G, R, a, b]
+            committed = s["exec_row"] < s["cmt_row"]
+            edge = (x_deps > s["exec_row"][:, :, None, :]) & ~jnp.eye(
+                R, dtype=jnp.bool_
+            )[None, None]
+            edge = edge & committed[..., None]
+            clo = edge
+            for _ in range(max(1, (R - 1).bit_length())):
+                nxt = jnp.einsum(
+                    "grab,grbc->grac",
+                    clo.astype(jnp.int32), clo.astype(jnp.int32),
+                ) > 0
+                clo = clo | nxt
+            key_less = (
+                x_seq[:, :, :, None] < x_seq[:, :, None, :]
+            ) | (
+                (x_seq[:, :, :, None] == x_seq[:, :, None, :])
+                & (jnp.arange(R)[None, None, :, None]
+                   < jnp.arange(R)[None, None, None, :])
+            )
+            cyc_ok = (
+                jnp.swapaxes(clo, 2, 3)
+                & committed[:, :, None, :]
+                & key_less
+            )
+            blocked = jnp.any(edge & ~cyc_ok, axis=3)
+            go = committed & ~blocked
+            s["exec_row"] = s["exec_row"] + go.astype(jnp.int32)
+            go_passes.append(go)
+            x_val = s["val2"][gar, rar, rowar, pos]
+            seq_passes.append(jnp.where(go, x_seq, 0))
+            val_passes.append(jnp.where(go, x_val, 0))
+        s["exec_bar"] = jnp.sum(s["exec_row"], axis=2)
+        # per-pass execution events [G, R, row, pass] for lossless
+        # host-side order reconstruction (pass order, then (seq, row))
+        c.exec_go = jnp.stack(go_passes, axis=-1)
+        c.exec_seq = jnp.stack(seq_passes, axis=-1)
+        c.exec_val = jnp.stack(val_passes, axis=-1)
+
+    # ========== outbox
+    def _ring_abs(self, top):
+        """[..., W]: largest col < top at each ring position (may be < 0 =
+        empty; consumers also check the stored abs lanes)."""
+        W = self.W
+        p = jnp.arange(W, dtype=jnp.int32)
+        t = top[..., None]
+        return t - 1 - ((t - 1 - p) % W)
+
+    def _build_outbox(self, s, c):
+        G, R, W = self.G, self.R, self.W
+        out = self.zero_outbox()
+        rid = c.rid
+        ns_mask = jnp.broadcast_to(~c.eye, (G, R, R))
+        oflags = jnp.where(ns_mask, jnp.uint32(BEACON), jnp.uint32(0))
+
+        # own-row stream straight from the 2-D log
+        st_o = self._row_slice(s, "st2", rid)
+        abs_o = self._row_slice(s, "abs2", rid)
+        live = (st_o > NULL) & (abs_o >= 0)
+        out["ow_abs"] = jnp.where(live, abs_o, -1)
+        out["ow_phase"] = jnp.where(live, st_o, 0)
+        out["ow_bal"] = jnp.where(live, self._row_slice(s, "bal2", rid), 0)
+        out["ow_seq"] = jnp.where(live, self._row_slice(s, "seq2", rid), 0)
+        out["ow_val"] = jnp.where(live, self._row_slice(s, "val2", rid), 0)
+        out["ow_noop"] = jnp.where(
+            live, self._row_slice(s, "noop2", rid), False
+        )
+        out["ow_deps"] = jnp.where(
+            live[..., None], self._row_slice(s, "deps2", rid), 0
+        )
+        out["tb_col"] = s["it_col"]
+        out["tb_seq"] = s["it_seq"]
+        out["sb"] = s["seen_bar"]
+
+        # rp_acc: per destination row owner d, the bitmask over d's row of
+        # entries held Accepting+ at the row's DEFAULT ballot.  Entries
+        # stored at recovery ballots are deliberately excluded: a revived
+        # row owner must not count them as acks of its own (possibly
+        # different) attrs — its tally wedges instead, and the stall
+        # detector walks it through self-ERP to learn the recovered
+        # outcomes.  Recovery-driven instances commit via the racc tally.
+        dbal_rows = self._default_bal(
+            jnp.arange(R, dtype=jnp.int32)
+        )[None, None, :, None]
+        accmask = (s["st2"] >= ACCEPTING) & (s["bal2"] == dbal_rows)
+        bits = jnp.sum(
+            jnp.where(
+                accmask,
+                jnp.uint32(1)
+                << (s["abs2"].clip(0) % W).astype(jnp.uint32),
+                jnp.uint32(0),
+            ),
+            axis=3,
+            dtype=jnp.uint32,
+        )  # [G, R, row] -> per-pair [G, src, dst=row]
+        out["rp_acc"] = bits
+
+        # ERP campaign
+        rec_on = s["rec_row"] >= 0
+        do_erp = rec_on[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_erp, jnp.uint32(ERP), 0)
+        out["erp_row"] = jnp.where(do_erp, s["rec_row"][..., None], 0)
+        out["erp_bal"] = jnp.where(do_erp, s["rec_bal"][..., None], 0)
+        tgt_ext = jnp.take_along_axis(
+            s["ext_row"], jnp.maximum(s["rec_row"], 0)[..., None], axis=2
+        )[..., 0]
+        out["erp_ext"] = jnp.where(do_erp, tgt_ext[..., None], 0)
+
+        # RV responses: serve the highest-ballot ERP heard this tick
+        erp_in = (c.flags & ERP) != 0
+        erp_bal_in = jnp.where(erp_in, c.inbox["erp_bal"], 0)
+        best_bal = erp_bal_in.max(axis=2)
+        best_src = erp_bal_in.argmax(axis=2)[..., None]
+        serve = best_bal > 0
+        srow = jnp.take_along_axis(c.inbox["erp_row"], best_src, axis=2)[
+            ..., 0
+        ]
+        srow_c = jnp.maximum(srow, 0)
+        out["rv_row"] = jnp.where(serve, srow, -1)
+        out["rv_bal"] = jnp.where(serve, best_bal, 0)
+        rv_live = (self._row_slice(s, "st2", srow_c) > NULL) & serve[
+            ..., None
+        ]
+        out["rv_abs"] = jnp.where(
+            rv_live, self._row_slice(s, "abs2", srow_c), -1
+        )
+        out["rv_st"] = jnp.where(rv_live, self._row_slice(s, "st2", srow_c), 0)
+        out["rv_vbal"] = jnp.where(
+            rv_live, self._row_slice(s, "bal2", srow_c), 0
+        )
+        out["rv_seq"] = jnp.where(
+            rv_live, self._row_slice(s, "seq2", srow_c), 0
+        )
+        out["rv_val"] = jnp.where(
+            rv_live, self._row_slice(s, "val2", srow_c), 0
+        )
+        out["rv_noop"] = jnp.where(
+            rv_live, self._row_slice(s, "noop2", srow_c), False
+        )
+        out["rv_deps"] = jnp.where(
+            rv_live[..., None], self._row_slice(s, "deps2", srow_c), 0
+        )
+        do_rv = serve[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_rv, jnp.uint32(RV), 0)
+
+        # RO drive lanes from the decision ladder
+        ro = self._recovery_apply(s, c)
+        out.update(ro)
+        do_ro = (out["ro_row"] >= 0)[..., None] & ns_mask
+        oflags = oflags | jnp.where(do_ro, jnp.uint32(RO), 0)
+
+        out["flags"] = oflags
+        return out
+
+    # ========== recovery decision ladder (recoverer side)
+    def _recovery_apply(self, s, c):
+        G, R, W, K = self.G, self.R, self.W, self.config.num_key_buckets
+        inbox = c.inbox
+        tgt = c.rec_tgt
+        tgt_c = jnp.maximum(tgt, 0)
+        rv_mine = c.rv_mine                      # [G, me, src]
+
+        tgt_ext = jnp.take_along_axis(
+            s["ext_row"], tgt_c[..., None], axis=2
+        )[..., 0]
+        tgt_cmt = jnp.take_along_axis(
+            s["cmt_row"], tgt_c[..., None], axis=2
+        )[..., 0]
+        my_ring = self._ring_abs(tgt_ext)        # [G, R, W]
+
+        def rin(name, extra=()):
+            return jnp.broadcast_to(
+                inbox[name][:, None], (G, R, R, W) + extra
+            )
+
+        align = (
+            rv_mine[..., None]
+            & (rin("rv_abs") == my_ring[:, :, None, :])
+            & (my_ring[:, :, None, :] >= 0)
+        )
+        rv_st = jnp.where(align, rin("rv_st"), 0)
+        rv_vbal = jnp.where(align, rin("rv_vbal"), 0)
+        rv_seq = rin("rv_seq")
+        rv_val = rin("rv_val")
+        rv_noop = rin("rv_noop")
+        rv_deps = rin("rv_deps", (R,))
+
+        own_st = self._row_slice(s, "st2", tgt_c)
+        own_abs = self._row_slice(s, "abs2", tgt_c)
+        own_ok = own_abs == my_ring
+        own_st = jnp.where(own_ok, own_st, 0)
+        own_vbal = jnp.where(own_ok, self._row_slice(s, "bal2", tgt_c), 0)
+        own_seq = self._row_slice(s, "seq2", tgt_c)
+        own_val = self._row_slice(s, "val2", tgt_c)
+        own_noop = self._row_slice(s, "noop2", tgt_c)
+        own_deps = self._row_slice(s, "deps2", tgt_c)
+
+        unresolved = (
+            (my_ring >= tgt_cmt[..., None])
+            & (my_ring < tgt_ext[..., None])
+            & (own_st < COMMITTED)
+        )
+        act = c.rec_have_q[..., None] & unresolved & (tgt >= 0)[..., None]
+
+        def from_src(lane, ownl, src, use_own):
+            got = jnp.take_along_axis(
+                jnp.swapaxes(lane, 2, 3), src, axis=3
+            )[..., 0]
+            return jnp.where(use_own, ownl, got)
+
+        def from_src_d(lane, ownl, src, use_own):
+            got = jnp.take_along_axis(
+                jnp.swapaxes(lane, 2, 3), src[..., None], axis=3
+            )[..., 0, :]
+            return jnp.where(use_own[..., None], ownl, got)
+
+        # ladder 1: committed copy anywhere
+        own_cmt = own_st >= COMMITTED
+        any_cmt = act & (jnp.any(rv_st >= COMMITTED, axis=2) | own_cmt)
+        csrc = jnp.argmax((rv_st >= COMMITTED), axis=2)[..., None]
+        c_seq = from_src(rv_seq, own_seq, csrc, own_cmt)
+        c_val = from_src(rv_val, own_val, csrc, own_cmt)
+        c_noop = from_src(rv_noop, own_noop, csrc, own_cmt)
+        c_deps = from_src_d(rv_deps, own_deps, csrc, own_cmt)
+
+        # ladder 2: accepting copy at the max voted ballot
+        accm = rv_st == ACCEPTING
+        own_acc = own_st == ACCEPTING
+        acc_best = jnp.maximum(
+            jnp.max(jnp.where(accm, rv_vbal, 0), axis=2),
+            jnp.where(own_acc, own_vbal, 0),
+        )
+        any_acc = act & ~any_cmt & (acc_best > 0)
+        use_own_a = own_acc & (own_vbal >= acc_best)
+        asrc = jnp.argmax(jnp.where(accm, rv_vbal, -1), axis=2)[..., None]
+        a_seq = from_src(rv_seq, own_seq, asrc, use_own_a)
+        a_val = from_src(rv_val, own_val, asrc, use_own_a)
+        a_noop = from_src(rv_noop, own_noop, asrc, use_own_a)
+        a_deps = from_src_d(rv_deps, own_deps, asrc, use_own_a)
+
+        # ladder 3: >= simple_q - 1 identical non-owner preaccepts at the
+        # row's default ballot (candidate loop over responders + self)
+        dbal = self._default_bal(tgt_c)[..., None]        # [G, R, 1]
+        pre = (rv_st == PREACC) & (rv_vbal == dbal[:, :, None, :])
+        own_pre = (own_st == PREACC) & (own_vbal == dbal)
+        best_cnt = jnp.zeros((G, R, W), jnp.int32)
+        best_cand = jnp.full((G, R, W), -1, jnp.int32)
+        for cand in range(R + 1):
+            if cand < R:
+                cok = pre[:, :, cand, :]
+                cs, cv = rv_seq[:, :, cand, :], rv_val[:, :, cand, :]
+                cd = rv_deps[:, :, cand, :, :]
+            else:
+                cok = own_pre
+                cs, cv, cd = own_seq, own_val, own_deps
+            same = (
+                pre
+                & (rv_seq == cs[:, :, None, :])
+                & (rv_val == cv[:, :, None, :])
+                & jnp.all(rv_deps == cd[:, :, None, :, :], axis=4)
+            )
+            cnt = jnp.sum(same.astype(jnp.int32), axis=2) + (
+                own_pre
+                & (own_seq == cs)
+                & (own_val == cv)
+                & jnp.all(own_deps == cd, axis=3)
+            ).astype(jnp.int32)
+            cnt = jnp.where(cok, cnt, 0)
+            upd = cnt > best_cnt
+            best_cnt = jnp.where(upd, cnt, best_cnt)
+            best_cand = jnp.where(upd, cand, best_cand)
+        ident = act & ~any_cmt & ~any_acc & (
+            best_cnt >= self.simple_q - 1
+        )
+        use_own_i = best_cand == R
+        isrc = jnp.minimum(best_cand, R - 1)[..., None]
+        i_seq = from_src(rv_seq, own_seq, isrc, use_own_i)
+        i_val = from_src(rv_val, own_val, isrc, use_own_i)
+        i_noop = from_src(rv_noop, own_noop, isrc, use_own_i)
+        i_deps = from_src_d(rv_deps, own_deps, isrc, use_own_i)
+
+        # ladder 4: any preaccept -> re-propose the voted value with a
+        # fresh merge from my tables (no quorum fast-committed it);
+        # ladder 5: nothing -> no-op
+        any_pre = jnp.any(pre, axis=2) | own_pre
+        repro = act & ~any_cmt & ~any_acc & ~ident & any_pre
+        noopf = act & ~any_cmt & ~any_acc & ~ident & ~any_pre
+        use_own_p = own_pre & ~jnp.any(pre, axis=2)
+        psrc = jnp.argmax(pre, axis=2)[..., None]
+        p_val = from_src(rv_val, own_val, psrc, use_own_p)
+        p_noop = from_src(rv_noop, own_noop, psrc, use_own_p)
+        pbucket = p_val % K
+        itc = self._bucket_gather(s["it_col"], pbucket)
+        its = self._bucket_gather(s["it_seq"], pbucket)
+        p_seq = 1 + jnp.max(its, axis=3)
+        p_deps = itc
+
+        phase = jnp.where(
+            any_cmt,
+            COMMITTED,
+            jnp.where(any_acc | ident | repro | noopf, ACCEPTING, 0),
+        )
+        o_seq = jnp.where(any_cmt, c_seq, jnp.where(
+            any_acc, a_seq, jnp.where(ident, i_seq, jnp.where(
+                repro, p_seq, 1))))
+        o_val = jnp.where(any_cmt, c_val, jnp.where(
+            any_acc, a_val, jnp.where(ident, i_val, jnp.where(
+                repro, p_val, 0))))
+        o_noop = jnp.where(any_cmt, c_noop, jnp.where(
+            any_acc, a_noop, jnp.where(ident, i_noop, jnp.where(
+                repro, p_noop, True))))
+        o_deps = jnp.where(any_cmt[..., None], c_deps, jnp.where(
+            any_acc[..., None], a_deps, jnp.where(
+                ident[..., None], i_deps, jnp.where(
+                    repro[..., None], p_deps, 0))))
+
+        # accept tally for driven instances: responders that already show
+        # ACCEPTING at >= my ERP ballot for this position
+        racc = 1 + jnp.sum(
+            (align & (rv_st == ACCEPTING)
+             & (rv_vbal >= s["rec_bal"][..., None, None])).astype(jnp.int32),
+            axis=2,
+        )
+        promote = act & (phase == ACCEPTING) & (racc >= self.simple_q)
+        phase = jnp.where(promote, COMMITTED, phase)
+
+        # store outcomes locally (the recoverer is an acceptor too)
+        tgt_sel = (
+            jnp.arange(R)[None, None, :, None] == tgt[:, :, None, None]
+        ) & (tgt >= 0)[:, :, None, None]
+        act4 = tgt_sel & (phase > 0)[:, :, None, :]
+        keep_cmt = act4 & (s["st2"] == COMMITTED) & (
+            phase[:, :, None, :] < COMMITTED
+        )
+        act4 = act4 & ~keep_cmt
+        s["abs2"] = jnp.where(act4, my_ring[:, :, None, :], s["abs2"])
+        s["st2"] = jnp.where(act4, phase[:, :, None, :], s["st2"])
+        s["bal2"] = jnp.where(act4, s["rec_bal"][:, :, None, None], s["bal2"])
+        s["seq2"] = jnp.where(act4, o_seq[:, :, None, :], s["seq2"])
+        s["val2"] = jnp.where(act4, o_val[:, :, None, :], s["val2"])
+        s["noop2"] = jnp.where(act4, o_noop[:, :, None, :], s["noop2"])
+        s["deps2"] = jnp.where(
+            act4[..., None], o_deps[:, :, None, :, :], s["deps2"]
+        )
+
+        rec_on = (tgt >= 0) & c.rec_have_q
+        return {
+            "ro_row": jnp.where(rec_on, tgt, -1),
+            "ro_bal": jnp.where(rec_on, s["rec_bal"], 0),
+            "ro_abs": jnp.where(rec_on[..., None] & (phase > 0), my_ring, -1),
+            "ro_phase": jnp.where(rec_on[..., None], phase, 0),
+            "ro_seq": jnp.where(rec_on[..., None], o_seq, 0),
+            "ro_val": jnp.where(rec_on[..., None], o_val, 0),
+            "ro_noop": jnp.where(rec_on[..., None], o_noop, False),
+            "ro_deps": jnp.where(rec_on[..., None, None], o_deps, 0),
+        }
+
+    # ------------------------------------------------------------- effects
+    def _effects(self, s, c):
+        G, R = self.G, self.R
+        zero = jnp.zeros((G, R, R, R), jnp.bool_)
+        return StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": c.n_new,
+                "cmt_row": s["cmt_row"],
+                "exec_row": s["exec_row"],
+                "rec_row": s["rec_row"],
+                "exec_go": getattr(c, "exec_go", zero),
+                "exec_seq": getattr(
+                    c, "exec_seq", zero.astype(jnp.int32)
+                ),
+                "exec_val": getattr(
+                    c, "exec_val", zero.astype(jnp.int32)
+                ),
+            },
+        )

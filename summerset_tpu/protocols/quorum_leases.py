@@ -61,14 +61,12 @@ import jax.numpy as jnp
 
 from ..utils.bitmap import popcount
 from . import register_protocol
-from .common import range_cover
-from .multipaxos import HB_REPLY, MultiPaxosKernel, ReplicaConfigMultiPaxos
+from .common import INF as _INF, range_cover
+from .multipaxos import MultiPaxosKernel, ReplicaConfigMultiPaxos
 
 GRANT = 1024      # quorum-lease grant/refresh: grantor -> grantee
 GRANT_ACK = 2048  # grantee -> grantor (liveness for refresh gating)
 GSET = 4096       # per-tick outstanding-grant bitmap beacon (to the leader)
-
-_INF = jnp.int32(1 << 30)
 
 
 @dataclasses.dataclass
@@ -171,15 +169,34 @@ class QuorumLeasesKernel(MultiPaxosKernel):
     # ------------------------------------------------------ leader leases
     def _ingest_heartbeat(self, s, c):
         super()._ingest_heartbeat(s, c)
+        cfg = self.config
+        inbox = c.inbox
         # countdowns tick once per lockstep tick (done here: the first
         # phase to run); holder promises refresh on an accepted heartbeat
         for k in ("ql_out", "ql_in", "grant_cnt", "gset_ttl", "ll_left",
                   "ll_in", "alive_cnt"):
             s[k] = jnp.maximum(s[k] - 1, 0)
-        if self.config.enable_leader_leases:
+        if cfg.enable_leader_leases:
             s["ll_left"] = jnp.where(
-                c.hb_ok, self.config.leader_lease_len, s["ll_left"]
+                c.hb_ok, cfg.leader_lease_len, s["ll_left"]
             )
+        # lease-plane ingest must precede the commit tally in
+        # _advance_bars: the write barrier may never lag the ack frontiers
+        # it is compared against (reference carries grant_set inside
+        # AcceptReply for the same reason, quorum_leases/messages.rs:367)
+        g_valid = (c.flags & GRANT) != 0
+        s["ql_in"] = jnp.where(g_valid, inbox["gr_len"], s["ql_in"])
+        s["ql_slot"] = jnp.where(g_valid, inbox["gr_slot"], s["ql_slot"])
+        c.ql_ga = g_valid  # ack back to the grantor in _extra_sends
+        ga_valid = (c.flags & GRANT_ACK) != 0
+        s["alive_cnt"] = jnp.where(
+            ga_valid, cfg.alive_timeout, s["alive_cnt"]
+        )
+        gs_valid = (c.flags & GSET) != 0
+        s["rep_gset"] = jnp.where(gs_valid, inbox["gs_bits"], s["rep_gset"])
+        s["gset_ttl"] = jnp.where(
+            gs_valid, cfg.lease_len + cfg.lease_margin, s["gset_ttl"]
+        )
 
     def _vote_gate(self, s, c, p_bal, p_src):
         if not self.config.enable_leader_leases:
@@ -309,28 +326,13 @@ class QuorumLeasesKernel(MultiPaxosKernel):
     def _extra_sends(self, s, c, out, oflags):
         R = self.R
         cfg = self.config
-        inbox = c.inbox
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
         ns_mask = ~eye
 
-        # ingest GRANT: hold the lease, bound to the grantor's conf slot
-        g_valid = (c.flags & GRANT) != 0
-        s["ql_in"] = jnp.where(g_valid, inbox["gr_len"], s["ql_in"])
-        s["ql_slot"] = jnp.where(g_valid, inbox["gr_slot"], s["ql_slot"])
-        # ack back to the grantor (directed: inbox axis 2 is the source)
-        do_ga = g_valid & ns_mask
+        # ack received grants back to their grantors (directed: the inbox
+        # mask c.ql_ga is [G, self, src], matching the outbox [G, self, dst])
+        do_ga = c.ql_ga & ns_mask
         oflags = oflags | jnp.where(do_ga, jnp.uint32(GRANT_ACK), 0)
-        ga_valid = (c.flags & GRANT_ACK) != 0
-        s["alive_cnt"] = jnp.where(
-            ga_valid, cfg.alive_timeout, s["alive_cnt"]
-        )
-
-        # ingest GSET beacons: peers' outstanding-grant claims
-        gs_valid = (c.flags & GSET) != 0
-        s["rep_gset"] = jnp.where(gs_valid, inbox["gs_bits"], s["rep_gset"])
-        s["gset_ttl"] = jnp.where(
-            gs_valid, cfg.lease_len + cfg.lease_margin, s["gset_ttl"]
-        )
 
         # every replica refreshes grants to alive configured grantees
         fire = s["grant_cnt"] <= 0
