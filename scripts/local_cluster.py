@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Launch a local cluster: manager + N server replica processes.
+
+Parity: reference ``scripts/local_cluster.py`` (:199-260) — spawns the
+manager, waits for it, spawns servers with per-replica ports and config
+strings, and waits for each replica's "accepting clients" readiness log
+line (the de-facto API, ``workflow_test.py:57-68``).
+
+Usage:
+    python scripts/local_cluster.py -p MultiPaxos -n 3 [--base-port 52600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def protocol_defaults(protocol: str, n: int) -> str:
+    """Per-protocol default config strings (parity: local_cluster.py:35-54,
+    e.g. RSPaxos gets fault_tolerance=(n//2)//2)."""
+    p = protocol.lower()
+    if p in ("rspaxos", "craft", "crossword"):
+        return f"fault_tolerance={(n // 2) // 2}"
+    return ""
+
+
+def wait_for_line(proc: subprocess.Popen, needle: str, timeout: float) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        sys.stderr.write(line)
+        if needle in line:
+            return True
+    return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-p", "--protocol", default="MultiPaxos")
+    ap.add_argument("-n", "--num-replicas", type=int, default=3)
+    ap.add_argument("--base-port", type=int, default=52600)
+    ap.add_argument("-c", "--config", default="")
+    ap.add_argument("--backer-dir", default="/tmp/summerset_tpu/cluster")
+    ap.add_argument("--fresh", action="store_true",
+                    help="wipe backer dir before launch")
+    args = ap.parse_args()
+
+    if args.fresh and os.path.isdir(args.backer_dir):
+        import shutil
+
+        shutil.rmtree(args.backer_dir)
+    os.makedirs(args.backer_dir, exist_ok=True)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("PYTHONUNBUFFERED", "1")
+
+    bp = args.base_port
+    procs = []
+
+    def spawn(mod, *argv):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", mod, *argv],
+            env=env,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        procs.append(proc)
+        return proc
+
+    man = spawn(
+        "summerset_tpu.cli.manager",
+        "-p", args.protocol,
+        "--srv-port", str(bp), "--cli-port", str(bp + 1),
+        "-n", str(args.num_replicas),
+    )
+    if not wait_for_line(man, "manager up", 15):
+        print("manager failed to start", file=sys.stderr)
+        return 1
+
+    cfg = args.config or protocol_defaults(args.protocol, args.num_replicas)
+    servers = []
+    for r in range(args.num_replicas):
+        srv = spawn(
+            "summerset_tpu.cli.server",
+            "-p", args.protocol,
+            "-a", str(bp + 10 + r),
+            "-i", str(bp + 30 + r),
+            "-m", f"127.0.0.1:{bp}",
+            "--backer-dir", args.backer_dir,
+            *(["-c", cfg] if cfg else []),
+        )
+        servers.append(srv)
+    for r, srv in enumerate(servers):
+        if not wait_for_line(srv, "accepting clients", 90):
+            print(f"server {r} failed to start", file=sys.stderr)
+            return 1
+    print(f"cluster ready: manager @ 127.0.0.1:{bp + 1} "
+          f"({args.num_replicas} replicas)")
+
+    def shutdown(*_):
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+    # babysit: exit if any child dies
+    while True:
+        time.sleep(1)
+        for p in procs:
+            if p.poll() is not None:
+                print("a cluster process exited; shutting down",
+                      file=sys.stderr)
+                shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,61 @@
+"""summerset_server analog (reference summerset_server/src/main.rs).
+
+Config strings use ``a=1+b='x'`` with ``+`` -> newline like the reference
+(main.rs:112), parsed by ``utils.config.parsed_config``.  The replica runs
+in a crash-restart while loop: ``run()`` returning True restarts
+(main.rs:127-160).
+"""
+
+from __future__ import annotations
+
+import argparse
+import tomllib
+
+from ..host.server import ServerReplica
+from ..utils.logging import logger_init, pf_info, pf_logger
+
+logger = pf_logger("server_main")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="summerset_tpu server replica")
+    ap.add_argument("-p", "--protocol", default="MultiPaxos")
+    ap.add_argument("--bind-ip", default="127.0.0.1")
+    ap.add_argument("-a", "--api-port", type=int, default=52700)
+    ap.add_argument("-i", "--p2p-port", type=int, default=52800)
+    ap.add_argument("-m", "--manager", default="127.0.0.1:52600")
+    ap.add_argument("-c", "--config", default="")
+    ap.add_argument("-g", "--num-groups", type=int, default=1)
+    ap.add_argument("--window", type=int, default=64)
+    ap.add_argument("--tick-interval", type=float, default=0.002)
+    ap.add_argument("--backer-dir", default="/tmp/summerset_tpu")
+    args = ap.parse_args(argv)
+
+    logger_init()
+    mhost, mport = args.manager.rsplit(":", 1)
+    cfg = (
+        tomllib.loads(args.config.replace("+", "\n"))
+        if args.config
+        else {}
+    )
+    while True:
+        replica = ServerReplica(
+            args.protocol,
+            (args.bind_ip, args.api_port),
+            (args.bind_ip, args.p2p_port),
+            (mhost, int(mport)),
+            config=cfg,
+            num_groups=args.num_groups,
+            window=args.window,
+            tick_interval=args.tick_interval,
+            backer_dir=args.backer_dir,
+        )
+        restart = replica.run()
+        replica.shutdown()
+        if not restart:
+            break
+        pf_info(logger, "restarting replica (reset)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""Client library: endpoint, data/control stubs, drivers, and utilities.
+
+Parity: reference ``src/client/`` + ``summerset_client`` toolkit (SURVEY.md
+§2.4/§2.6) — ``GenericEndpoint`` (endpoint.rs:17-54), ``ClientApiStub``
+(apistub.rs:16-95), ``ClientCtrlStub`` (ctrlstub.rs), the closed/open-loop
+drivers, and the bench / tester / repl / mess utility modes.
+"""
+
+from .endpoint import ClientApiStub, ClientCtrlStub, GenericEndpoint  # noqa
+from .drivers import DriverClosedLoop, DriverOpenLoop  # noqa: F401

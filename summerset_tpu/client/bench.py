@@ -1,0 +1,156 @@
+"""ClientBench: throughput/latency benchmark mode.
+
+Parity: reference ``summerset_client/src/clients/bench.rs`` — open-loop
+driver with target frequency pacing (0 = unlimited), put ratio, value
+sizes with "t1:v1/t2:v2" schedules, key count with preloading, normal /
+uniform size distributions, optional YCSB-style trace replay, and
+periodic interval stats lines ``tput ... lat p50/p99 ...`` parsed by the
+orchestration scripts (bench.rs:28-130).
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import time
+from typing import List, Optional, Tuple
+
+from ..host.statemach import Command
+from ..utils.logging import pf_info, pf_logger
+from .drivers import DriverOpenLoop
+from .endpoint import GenericEndpoint
+
+logger = pf_logger("bench")
+
+
+def parse_value_schedule(spec: str) -> List[Tuple[float, int]]:
+    """"t1:v1/t2:v2" -> [(t_from, size)] (bench.rs value-size schedule)."""
+    out = []
+    for seg in spec.split("/"):
+        t, v = seg.split(":")
+        out.append((float(t), int(v)))
+    return sorted(out)
+
+
+class ClientBench:
+    def __init__(
+        self,
+        endpoint: GenericEndpoint,
+        secs: float = 10.0,
+        freq: float = 0.0,            # target reqs/sec; 0 = unlimited
+        put_ratio: float = 0.5,
+        value_size: str = "128",      # int or "t:v/t:v" schedule
+        num_keys: int = 5,
+        normal_stdev_ratio: float = 0.0,
+        trace: Optional[List[Tuple[str, str, Optional[str]]]] = None,
+        interval: float = 0.1,
+        seed: int = 0,
+    ):
+        self.ep = endpoint
+        self.secs = secs
+        self.freq = freq
+        self.put_ratio = put_ratio
+        self.schedule = parse_value_schedule(value_size)
+        self.num_keys = num_keys
+        self.stdev = normal_stdev_ratio
+        self.trace = trace
+        self.interval = interval
+        self.rng = random.Random(seed)
+        self.keys = [f"k{i}" for i in range(num_keys)]
+
+    def _value(self, now: float) -> str:
+        size = self.schedule[0][1]
+        for t, v in self.schedule:
+            if now >= t:
+                size = v
+        if self.stdev > 0:
+            size = max(1, int(self.rng.gauss(size, size * self.stdev)))
+        return "".join(
+            self.rng.choices(string.ascii_lowercase, k=size)
+        )
+
+    def _next_cmd(self, now: float, i: int) -> Command:
+        if self.trace:
+            op, key, val = self.trace[i % len(self.trace)]
+            if op == "put":
+                return Command("put", key, val or self._value(now))
+            return Command("get", key)
+        key = self.rng.choice(self.keys)
+        if self.rng.random() < self.put_ratio:
+            return Command("put", key, self._value(now))
+        return Command("get", key)
+
+    def run(self) -> dict:
+        drv = DriverOpenLoop(self.ep)
+        # preload every key once (bench.rs preloading)
+        for k in self.keys:
+            drv.issue(Command("put", k, self._value(0.0)))
+        for _ in self.keys:
+            drv.wait_reply(timeout=10)
+
+        t_start = time.monotonic()
+        issued = acked = 0
+        lats: List[float] = []
+        int_acked, int_lats = 0, []
+        t_int = t_start
+        pace = 1.0 / self.freq if self.freq > 0 else 0.0
+        t_next = t_start
+        while True:
+            now = time.monotonic()
+            if now - t_start >= self.secs:
+                break
+            if pace == 0.0 or now >= t_next:
+                drv.issue(self._next_cmd(now - t_start, issued))
+                issued += 1
+                t_next += pace
+            budget = max(0.0, min(
+                (t_next - now) if pace else 0.001, 0.01
+            ))
+            rep = drv.wait_reply(timeout=budget or 0.001)
+            if rep is not None and rep.kind == "success":
+                acked += 1
+                int_acked += 1
+                lats.append(rep.latency)
+                int_lats.append(rep.latency)
+            if now - t_int >= self.interval:
+                dt = now - t_int
+                tput = int_acked / dt
+                p50, p99 = _pctiles(int_lats)
+                pf_info(
+                    logger,
+                    f"tput {tput:10.2f} reqs/s  "
+                    f"lat p50 {p50 * 1e3:7.3f} p99 {p99 * 1e3:7.3f} ms",
+                )
+                t_int = now
+                int_acked, int_lats = 0, []
+
+        # drain stragglers briefly
+        t_end = time.monotonic() + 1.0
+        while drv.inflight and time.monotonic() < t_end:
+            rep = drv.wait_reply(timeout=0.1)
+            if rep is not None and rep.kind == "success":
+                acked += 1
+                lats.append(rep.latency)
+        dt = time.monotonic() - t_start
+        p50, p99 = _pctiles(lats)
+        summary = {
+            "issued": issued,
+            "acked": acked,
+            "tput": acked / dt,
+            "lat_p50_ms": p50 * 1e3,
+            "lat_p99_ms": p99 * 1e3,
+        }
+        pf_info(
+            logger,
+            f"total tput {summary['tput']:.2f} reqs/s  "
+            f"p50 {summary['lat_p50_ms']:.3f} p99 "
+            f"{summary['lat_p99_ms']:.3f} ms",
+        )
+        return summary
+
+
+def _pctiles(lats: List[float]) -> Tuple[float, float]:
+    if not lats:
+        return 0.0, 0.0
+    s = sorted(lats)
+    return s[len(s) // 2], s[min(len(s) - 1, int(len(s) * 0.99))]

@@ -1,0 +1,122 @@
+"""Closed-loop and open-loop request drivers.
+
+Parity: reference ``summerset_client/src/drivers/`` —
+``DriverClosedLoop`` issues one outstanding request with a timeout timer
+(closed_loop.rs; ``DriverReply::{Success{latency}, Redirect, Timeout}``,
+drivers/mod.rs:12-40); ``DriverOpenLoop`` pipelines issues and acks
+(open_loop.rs) with would-block-style retry awareness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+from ..host.statemach import Command, CommandResult
+from .endpoint import GenericEndpoint
+
+
+@dataclasses.dataclass
+class DriverReply:
+    kind: str                     # success | redirect | timeout | failure
+    latency: float = 0.0          # seconds (success)
+    result: Optional[CommandResult] = None
+    redirect: Optional[int] = None
+
+
+class DriverClosedLoop:
+    def __init__(self, endpoint: GenericEndpoint, timeout: float = 5.0):
+        self.ep = endpoint
+        self.timeout = timeout
+        self.next_req = 0
+
+    def _issue(self, cmd: Command) -> DriverReply:
+        rid = self.next_req
+        self.next_req += 1
+        t0 = time.monotonic()
+        self.ep.send_req(rid, cmd)
+        deadline = t0 + self.timeout
+        while True:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                return DriverReply("timeout")
+            try:
+                rep = self.ep.recv_reply(timeout=budget)
+            except Exception:
+                return DriverReply("failure")
+            if rep.req_id != rid:
+                continue  # stale reply from a previous timeout
+            if rep.kind == "redirect":
+                if rep.redirect is not None and rep.redirect >= 0:
+                    self.ep.reconnect(rep.redirect)
+                else:
+                    self.ep.reconnect()
+                return DriverReply("redirect", redirect=rep.redirect)
+            return DriverReply(
+                "success",
+                latency=time.monotonic() - t0,
+                result=rep.result,
+            )
+
+    def get(self, key: str) -> DriverReply:
+        return self._issue(Command("get", key))
+
+    def put(self, key: str, value: str) -> DriverReply:
+        return self._issue(Command("put", key, value))
+
+    def checked_put(self, key: str, value: str, retries: int = 20):
+        """Retry through redirects/timeouts until acked (tester helper,
+        parity: tester.rs checked_put)."""
+        for _ in range(retries):
+            rep = self.put(key, value)
+            if rep.kind == "success":
+                return rep
+            time.sleep(0.1)
+        raise AssertionError(f"checked_put({key}) failed after retries")
+
+    def checked_get(self, key: str, expect: Optional[str],
+                    retries: int = 20):
+        for _ in range(retries):
+            rep = self.get(key)
+            if rep.kind == "success":
+                got = rep.result.value if rep.result else None
+                assert got == expect, f"get({key}) = {got} != {expect}"
+                return rep
+            time.sleep(0.1)
+        raise AssertionError(f"checked_get({key}) failed after retries")
+
+
+class DriverOpenLoop:
+    """Pipelined issue/ack driver (parity: open_loop.rs)."""
+
+    def __init__(self, endpoint: GenericEndpoint, timeout: float = 5.0):
+        self.ep = endpoint
+        self.timeout = timeout
+        self.next_req = 0
+        self.inflight: Dict[int, float] = {}
+
+    def issue(self, cmd: Command) -> int:
+        rid = self.next_req
+        self.next_req += 1
+        self.ep.send_req(rid, cmd)
+        self.inflight[rid] = time.monotonic()
+        return rid
+
+    def wait_reply(self, timeout: Optional[float] = None
+                   ) -> Optional[DriverReply]:
+        try:
+            rep = self.ep.recv_reply(
+                timeout=self.timeout if timeout is None else timeout
+            )
+        except Exception:
+            return None
+        t0 = self.inflight.pop(rep.req_id, None)
+        if rep.kind == "redirect":
+            self.ep.reconnect(rep.redirect)
+            return DriverReply("redirect", redirect=rep.redirect)
+        return DriverReply(
+            "success",
+            latency=(time.monotonic() - t0) if t0 else 0.0,
+            result=rep.result,
+        )

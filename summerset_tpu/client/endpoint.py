@@ -1,0 +1,121 @@
+"""Client endpoint + stubs.
+
+Parity: ``ClientApiStub`` sends the client id as its first frame and then
+exchanges ``ApiRequest``/``ApiReply`` (apistub.rs:16-95); ``ClientCtrlStub``
+receives its assigned id on connect and exchanges ``CtrlRequest``/
+``CtrlReply`` (ctrlstub.rs); ``GenericEndpoint`` composes both and handles
+server (re)selection including leader redirects (endpoint.rs:17-54).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional, Tuple
+
+from ..host.messages import ApiReply, ApiRequest, CtrlReply, CtrlRequest
+from ..host.statemach import Command
+from ..utils import safetcp
+from ..utils.errors import SummersetError
+
+
+class ClientCtrlStub:
+    def __init__(self, manager_addr: Tuple[str, int]):
+        self.sock = socket.create_connection(manager_addr, timeout=15)
+        self.sock.settimeout(None)
+        self.id: int = int(safetcp.recv_msg_sync(self.sock))
+
+    def request(self, req: CtrlRequest, timeout: float = 30.0) -> CtrlReply:
+        self.sock.settimeout(timeout)
+        try:
+            safetcp.send_msg_sync(self.sock, req)
+            return safetcp.recv_msg_sync(self.sock)
+        finally:
+            self.sock.settimeout(None)
+
+    def close(self) -> None:
+        try:
+            safetcp.send_msg_sync(self.sock, CtrlRequest("leave"))
+            safetcp.recv_msg_sync(self.sock)
+        except Exception:
+            pass
+        self.sock.close()
+
+
+class ClientApiStub:
+    def __init__(self, client_id: int, api_addr: Tuple[str, int]):
+        self.sock = socket.create_connection(tuple(api_addr), timeout=15)
+        self.sock.settimeout(None)
+        safetcp.send_msg_sync(self.sock, client_id)
+
+    def send_req(self, req: ApiRequest) -> None:
+        safetcp.send_msg_sync(self.sock, req)
+
+    def recv_reply(self, timeout: Optional[float] = None) -> ApiReply:
+        self.sock.settimeout(timeout)
+        try:
+            return safetcp.recv_msg_sync(self.sock)
+        finally:
+            self.sock.settimeout(None)
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class GenericEndpoint:
+    """Manager-guided endpoint with redirect-aware server selection."""
+
+    def __init__(self, manager_addr: Tuple[str, int],
+                 server_id: Optional[int] = None):
+        self.ctrl = ClientCtrlStub(manager_addr)
+        self.id = self.ctrl.id
+        self.prefer = server_id
+        self.api: Optional[ClientApiStub] = None
+        self.servers = {}
+        self.current: Optional[int] = None
+
+    def connect(self) -> None:
+        info = self.ctrl.request(CtrlRequest("query_info"))
+        if not info.servers:
+            raise SummersetError("no servers joined yet")
+        self.servers = info.servers
+        target = self.prefer
+        if target is None or target not in info.servers:
+            target = (
+                info.leader
+                if info.leader is not None and info.leader in info.servers
+                else sorted(info.servers)[0]
+            )
+        self._connect_to(target)
+
+    def _connect_to(self, sid: int) -> None:
+        if self.api is not None:
+            self.api.close()
+        api_addr, _ = self.servers[sid]
+        self.api = ClientApiStub(self.id, api_addr)
+        self.current = sid
+
+    def reconnect(self, sid: Optional[int] = None) -> None:
+        if sid is not None and sid in self.servers:
+            self._connect_to(sid)
+        else:
+            self.connect()
+
+    def send_req(self, req_id: int, cmd: Command) -> None:
+        assert self.api is not None, "connect() first"
+        self.api.send_req(ApiRequest("req", req_id=req_id, cmd=cmd))
+
+    def recv_reply(self, timeout: Optional[float] = None) -> ApiReply:
+        assert self.api is not None
+        return self.api.recv_reply(timeout=timeout)
+
+    def leave(self, keep_ctrl: bool = False) -> None:
+        if self.api is not None:
+            try:
+                self.api.send_req(ApiRequest("leave"))
+                self.api.recv_reply(timeout=2)
+            except Exception:
+                pass
+            self.api.close()
+            self.api = None
+        if not keep_ctrl:
+            self.ctrl.close()

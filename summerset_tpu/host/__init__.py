@@ -1,0 +1,12 @@
+"""Host-side runtime around the device engine.
+
+Parity: the reference's server runtime modules (``src/server/``,
+SURVEY.md §2.2) — StateMachine, StorageHub, ExternalApi, ControlHub,
+TransportHub — re-homed as the host half of the TPU-native design: the
+device runs the vectorized consensus control plane; these modules own
+client I/O, durability, the KV store, and the control plane.
+"""
+
+from .payload import PayloadStore  # noqa: F401
+from .statemach import Command, CommandResult, StateMachine  # noqa: F401
+from .storage import LogAction, LogResult, StorageHub  # noqa: F401

@@ -1,0 +1,70 @@
+"""ControlHub: the server's channel to the cluster manager.
+
+Parity: reference ``src/server/control.rs`` — connect to the manager, read
+the assigned ``(id, population)`` handshake, then exchange framed
+``CtrlMsg``s through send/recv queues owned by a messenger task
+(control.rs:19-252).  Deviation: the handshake rides a normal frame rather
+than 2 raw bytes (symmetric framing everywhere).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Optional, Tuple
+
+from ..utils import safetcp
+from ..utils.errors import SummersetError
+from ..utils.logging import pf_logger, set_me
+from .messages import CtrlMsg
+
+logger = pf_logger("control")
+
+
+class ControlHub:
+    def __init__(self, manager_addr: Tuple[str, int], timeout: float = 15.0):
+        self.sock = socket.create_connection(manager_addr, timeout=timeout)
+        self.sock.settimeout(None)
+        me_id, population = safetcp.recv_msg_sync(self.sock)
+        self.me: int = int(me_id)
+        self.population: int = int(population)
+        set_me(str(self.me))
+        self._recv: queue.Queue = queue.Queue()
+        self._alive = True
+        self._reader = threading.Thread(target=self._recv_loop, daemon=True)
+        self._reader.start()
+        self._wlock = threading.Lock()
+
+    def send_ctrl(self, msg: CtrlMsg) -> None:
+        with self._wlock:
+            safetcp.send_msg_sync(self.sock, msg)
+
+    def recv_ctrl(self, timeout: Optional[float] = None) -> CtrlMsg:
+        msg = self._recv.get(timeout=timeout)
+        if msg is None:
+            raise SummersetError("manager connection closed")
+        return msg
+
+    def try_recv_ctrl(self) -> Optional[CtrlMsg]:
+        try:
+            msg = self._recv.get_nowait()
+        except queue.Empty:
+            return None
+        if msg is None:
+            raise SummersetError("manager connection closed")
+        return msg
+
+    def close(self) -> None:
+        self._alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _recv_loop(self) -> None:
+        try:
+            while self._alive:
+                self._recv.put(safetcp.recv_msg_sync(self.sock))
+        except Exception:
+            self._recv.put(None)

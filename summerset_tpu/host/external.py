@@ -1,0 +1,141 @@
+"""ExternalApi: the client-facing TCP plane with request batching.
+
+Parity: reference ``src/server/external.rs`` — an acceptor task spawning a
+servant task per client, plus a **batch ticker**: requests accumulate in a
+queue that the replica drains every ``batch_interval`` seconds (capped at
+``max_batch_size``), matching the reference's Notify-based ticker dump
+(external.rs:697-730).  Clients identify themselves by sending their
+assigned id as the first frame.  Replies are routed back through the
+servant owning that client's connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import safetcp
+from ..utils.logging import pf_debug, pf_info, pf_logger
+from .messages import ApiReply, ApiRequest
+
+logger = pf_logger("external")
+
+
+class ExternalApi:
+    def __init__(
+        self,
+        api_addr: Tuple[str, int],
+        batch_interval: float = 0.001,
+        max_batch_size: int = 5000,
+    ):
+        self.api_addr = api_addr
+        self.batch_interval = batch_interval
+        self.max_batch_size = max_batch_size
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server = None
+        self._writers: Dict[int, asyncio.StreamWriter] = {}
+        self._pending: List[Tuple[int, ApiRequest]] = []
+        self._batch_ready = threading.Event()
+        self._lock = threading.Lock()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    # -- hub API (called from the replica thread) ---------------------------
+    def get_req_batch(
+        self, timeout: Optional[float] = None
+    ) -> List[Tuple[int, ApiRequest]]:
+        """Blocking batch take (parity: ``get_req_batch``,
+        external.rs:323-345): waits for the ticker, returns <= max_batch
+        requests (possibly empty on timeout)."""
+        if not self._batch_ready.wait(timeout=timeout):
+            return []
+        with self._lock:
+            batch = self._pending[: self.max_batch_size]
+            del self._pending[: len(batch)]
+            if not self._pending:
+                self._batch_ready.clear()
+        return batch
+
+    def send_reply(self, reply: ApiReply, client: int) -> None:
+        """Route a reply to the servant owning `client`'s connection."""
+        loop = self._loop
+        if loop is None:
+            return
+        asyncio.run_coroutine_threadsafe(
+            self._send(client, reply), loop
+        )
+
+    def stop(self) -> None:
+        loop = self._loop
+        if loop is not None:
+            loop.call_soon_threadsafe(loop.stop)
+        self._thread.join(timeout=5)
+
+    # -- event loop side -----------------------------------------------------
+    async def _send(self, client: int, reply: ApiReply) -> None:
+        w = self._writers.get(client)
+        if w is None:
+            return
+        try:
+            await safetcp.send_msg(w, reply)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            self._writers.pop(client, None)
+
+    async def _servant(self, reader, writer) -> None:
+        """Per-client servant task (parity: external.rs:500+)."""
+        try:
+            client = await safetcp.recv_msg(reader)  # first frame: client id
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        self._writers[int(client)] = writer
+        pf_debug(logger, f"accepted client {client}")
+        try:
+            while True:
+                req = await safetcp.recv_msg(reader)
+                if not isinstance(req, ApiRequest):
+                    continue
+                if req.kind == "leave":
+                    await safetcp.send_msg(
+                        writer, ApiReply(kind="leave", req_id=req.req_id)
+                    )
+                    break
+                with self._lock:
+                    self._pending.append((int(client), req))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            self._writers.pop(int(client), None)
+            writer.close()
+
+    async def _ticker(self) -> None:
+        """Batch ticker (parity: external.rs:697-730)."""
+        while True:
+            await asyncio.sleep(self.batch_interval)
+            with self._lock:
+                if self._pending:
+                    self._batch_ready.set()
+
+    async def _main(self) -> None:
+        host, port = self.api_addr
+        self._server = await safetcp.tcp_bind_with_retry(
+            host, port, self._servant
+        )
+        asyncio.ensure_future(self._ticker())
+        # readiness log line is a de-facto API parsed by cluster scripts
+        # (reference: workflow_test.py:57-68)
+        pf_info(logger, f"accepting clients @ {host}:{port}")
+        self._started.set()
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(self._main())
+        try:
+            loop.run_forever()
+        finally:
+            loop.close()

@@ -1,0 +1,79 @@
+"""Wire message types for the three TCP planes.
+
+Parity: reference ``ApiRequest``/``ApiReply`` (``src/server/external.rs:
+33-183``), ``CtrlMsg`` (``src/manager/reigner.rs:30-83``), ``CtrlRequest``/
+``CtrlReply`` (``src/manager/reactor.rs:29-105``).  Dataclasses are pickled
+through the safetcp frames; field names track the reference closely so the
+tester/bench clients port one-to-one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .statemach import Command, CommandResult
+
+
+# --------------------------------------------------------------- data plane
+@dataclasses.dataclass(frozen=True)
+class ApiRequest:
+    """Client -> server (parity: ``ApiRequest::{Req, Conf, Leave}``)."""
+
+    kind: str                      # "req" | "conf" | "leave"
+    req_id: int = 0
+    cmd: Optional[Command] = None  # kind == "req"
+    conf_delta: Optional[dict] = None  # kind == "conf" (protocol-specific)
+
+
+@dataclasses.dataclass(frozen=True)
+class ApiReply:
+    """Server -> client (parity: ``ApiReply``, external.rs:155-183)."""
+
+    kind: str                      # "reply" | "conf" | "redirect" | "leave"
+    req_id: int = 0
+    result: Optional[CommandResult] = None
+    redirect: Optional[int] = None  # hinted leader id
+    success: bool = True
+    rq_retry: bool = False          # read-query retry hint
+
+
+# ------------------------------------------------------------ control plane
+@dataclasses.dataclass(frozen=True)
+class CtrlMsg:
+    """Server <-> manager (parity: ``CtrlMsg``, reigner.rs:30-83)."""
+
+    kind: str
+    # kind-specific payload:
+    #   new_server_join: protocol, api_addr, p2p_addr
+    #   connect_to_peers: population, to_peers {id: p2p_addr}
+    #   leader_status: step_up (bool)
+    #   responders_conf: conf_num, new_conf
+    #   reset_state / pause / resume / take_snapshot (+ _reply forms)
+    #   snapshot_up_to: new_start
+    #   leave / leave_reply
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class CtrlRequest:
+    """Client -> manager (parity: ``CtrlRequest``, reactor.rs:29-64)."""
+
+    kind: str  # query_info | query_conf | reset_servers | pause_servers
+    #            | resume_servers | take_snapshot | leave
+    servers: Optional[List[int]] = None  # None = all
+    durable: bool = True                 # reset: keep durable files?
+
+
+@dataclasses.dataclass(frozen=True)
+class CtrlReply:
+    """Manager -> client (parity: ``CtrlReply``, reactor.rs:66-105)."""
+
+    kind: str
+    population: int = 0
+    servers: Dict[int, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )  # id -> (api_addr, p2p_addr)
+    leader: Optional[int] = None
+    conf: Optional[dict] = None
+    done: Optional[List[int]] = None

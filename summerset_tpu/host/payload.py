@@ -1,0 +1,50 @@
+"""PayloadStore: host-side value-id <-> request-batch storage.
+
+The device kernels commit int32 *references*; actual request batches
+(client id, request id, commands — up to 16MB values in the reference)
+never touch HBM (SURVEY.md §7 hard part (b)).  This store assigns dense
+per-group value ids, resolves them at execution time, and garbage-collects
+below the group's snapshot bar.
+
+The id space mirrors the synthetic-load convention used by the kernels'
+bench mode (``value_base`` input): ids are positive, 0 is reserved for the
+no-op filler (``protocols/common.py`` NULL_VAL).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class PayloadStore:
+    def __init__(self, num_groups: int = 1):
+        self._lock = threading.Lock()
+        self._next = [1] * num_groups
+        self._data: list[Dict[int, Any]] = [dict() for _ in range(num_groups)]
+
+    def put(self, group: int, batch: Any) -> int:
+        """Store a request batch, returning its value id (>= 1)."""
+        with self._lock:
+            vid = self._next[group]
+            self._next[group] = vid + 1
+            self._data[group][vid] = batch
+        return vid
+
+    def get(self, group: int, vid: int) -> Optional[Any]:
+        if vid == 0:
+            return None  # no-op filler
+        with self._lock:
+            return self._data[group].get(vid)
+
+    def gc_below(self, group: int, vid_floor: int) -> int:
+        """Drop payloads with id < vid_floor (snapshot GC); returns count."""
+        with self._lock:
+            drop = [v for v in self._data[group] if v < vid_floor]
+            for v in drop:
+                del self._data[group][v]
+        return len(drop)
+
+    def size(self, group: int) -> int:
+        with self._lock:
+            return len(self._data[group])

@@ -1,0 +1,405 @@
+"""ServerReplica: a real networked replica process around the device kernel.
+
+Parity: reference ``GenericReplica`` + ``summerset_server`` (SURVEY.md
+§2.2/§2.6) — ``new_and_setup`` composes ControlHub -> StateMachine ->
+StorageHub -> TransportHub -> ExternalApi, joins via the manager, then
+``run()`` drives the event loop; returning True means crash-restart
+(``summerset_server/src/main.rs:127-160``).
+
+TPU-native split: this process owns replica index ``me`` of every group.
+Each tick it (1) drains the client batch, (2) steps the vectorized kernel
+with the inbox assembled from peers' TCP frames, (3) sends its outbox
+slice + payload piggybacks, (4) WAL-logs newly committed slots, applies
+them to the KV store, and replies to clients it originated.  Consensus
+messages ride the device outbox; request payloads ride host frames keyed
+by value id (the device log stores int32 references only — SURVEY.md §7
+hard part (b)).
+
+Leadership, failover, leases, and commit tallies all happen inside the
+kernel; this loop only reflects ``is_leader`` edges to the manager and
+redirects clients when not serving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..protocols import make_protocol
+from ..utils.logging import pf_info, pf_logger, pf_warn
+from .control import ControlHub
+from .external import ExternalApi
+from .messages import ApiReply, ApiRequest, CtrlMsg
+from .payload import PayloadStore
+from .statemach import StateMachine, apply_command
+from .storage import LogAction, StorageHub
+from .transport import TransportHub
+
+logger = pf_logger("server")
+
+
+class ServerReplica:
+    def __init__(
+        self,
+        protocol: str,
+        api_addr: Tuple[str, int],
+        p2p_addr: Tuple[str, int],
+        manager_addr: Tuple[str, int],
+        config: Optional[dict] = None,
+        num_groups: int = 1,
+        window: int = 64,
+        tick_interval: float = 0.002,
+        backer_dir: str = "/tmp/summerset_tpu",
+    ):
+        cfg = dict(config or {})
+        self.protocol = protocol
+        self.api_addr = api_addr
+        self.p2p_addr = p2p_addr
+        self.tick_interval = tick_interval
+        self.G = num_groups
+        self.window = window
+
+        # control plane first: the manager assigns our id (control.rs:43)
+        self.ctrl = ControlHub(manager_addr)
+        self.me = self.ctrl.me
+        self.population = self.ctrl.population
+
+        # protocol kernel over [G, R]; host applier drives the exec bar.
+        # Supported here: the MultiPaxos-family kernels sharing the
+        # (n_proposals, value_base, exec_floor) input contract.
+        kercfg_cls = type(
+            make_protocol(protocol, 1, self.population, 64).config
+        )
+        known = {f.name for f in dataclasses.fields(kercfg_cls)}
+        kcfg = kercfg_cls(**{k: v for k, v in cfg.items() if k in known})
+        if hasattr(kcfg, "exec_follows_commit"):
+            kcfg.exec_follows_commit = False
+        if hasattr(kcfg, "max_proposals_per_tick"):
+            kcfg.max_proposals_per_tick = 1  # one ReqBatch per tick
+        self.kernel = make_protocol(
+            protocol, self.G, self.population, window, kcfg
+        )
+        self.state = self.kernel.init_state(seed=0)
+        self._step = jax.jit(self.kernel.step)
+
+        os.makedirs(backer_dir, exist_ok=True)
+        self.wal_path = os.path.join(backer_dir, f"r{self.me}.wal")
+        self.wal = StorageHub(self.wal_path)
+        self.snapdir = os.path.join(backer_dir, f"r{self.me}.snap")
+        self.statemach = StateMachine()
+        self.payloads = PayloadStore(self.G)
+        self.applied = [0] * self.G        # exec floor per group (own row)
+        self.origin: set = set()           # vids proposed by this server
+        self.missing: set = set()           # committed vids lacking payloads
+        self.kv_need = False
+        self.paused = False
+        self.stopping = False  # cooperative stop for embedded harnesses
+        self.was_leader = False
+        self.tick = 0
+        self._pending_serve: Dict[int, Any] = {}  # peers' payload requests
+        self._pending_kv_serve = False
+
+        self._recover_from_wal()
+
+        # p2p mesh join (multipaxos/mod.rs:717-737): proactively connect to
+        # lower-id peers, accept from higher ids.  The join is re-sent until
+        # the mesh completes — concurrent bring-up means a lower-id peer may
+        # join after us, so one connect_to_peers snapshot is not enough.
+        self.transport = TransportHub(self.me, self.population, p2p_addr)
+        join = CtrlMsg("new_server_join", {
+            "protocol": protocol,
+            "api_addr": api_addr,
+            "p2p_addr": p2p_addr,
+        })
+        connected: set = set()
+        deadline = time.monotonic() + 60
+        while True:
+            self.ctrl.send_ctrl(join)
+            try:
+                msg = self.ctrl.recv_ctrl(timeout=3)
+            except Exception:
+                msg = None
+            if msg is not None and msg.kind == "connect_to_peers":
+                for peer, addr in msg.payload["to_peers"].items():
+                    if int(peer) not in connected:
+                        self.transport.connect_to_peer(int(peer), addr)
+                        connected.add(int(peer))
+            try:
+                self.transport.wait_for_group(timeout=2)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+
+        self.external = ExternalApi(api_addr)
+        pf_info(logger, f"replica {self.me} ready")
+
+    # -------------------------------------------------------- WAL recovery
+    def _recover_from_wal(self) -> None:
+        """Replay committed records: payloads + KV + exec floors
+        (parity: recovery.rs replay loop, SURVEY.md §3.4)."""
+        off = 0
+        n = 0
+        while True:
+            res = self.wal.do_sync_action(LogAction("read", offset=off))
+            if not res.offset_ok or res.entry is None:
+                break
+            g, slot, vid, batch = res.entry
+            self.payloads._data[g][vid] = batch
+            self.payloads._next[g] = max(self.payloads._next[g], vid + 1)
+            if batch is not None:
+                for client, req in batch:
+                    if req.cmd is not None:
+                        apply_command(self.statemach._kv, req.cmd)
+            self.applied[g] = max(self.applied[g], slot + 1)
+            off = res.end_offset
+            n += 1
+        if n:
+            pf_info(logger, f"recovered {n} WAL records")
+
+    # ----------------------------------------------------------- tick I/O
+    def _slice_outbox(self, out) -> Dict[int, Dict[str, Any]]:
+        """Per-peer frame: per-pair fields sliced [G] at (me, dst),
+        broadcast lanes sent whole."""
+        lanes = self.kernel.broadcast_lanes
+        frames: Dict[int, Dict[str, Any]] = {}
+        np_out = {k: np.asarray(v) for k, v in out.items()}
+        for dst in range(self.population):
+            if dst == self.me:
+                continue
+            f = {}
+            for k, v in np_out.items():
+                f[k] = v[:, self.me] if k in lanes else v[:, self.me, dst]
+            frames[dst] = f
+        return frames
+
+    def _assemble_inbox(self, own_out, peer_frames) -> Dict[str, Any]:
+        """Receiver-oriented inbox: row `me` filled from peers + self."""
+        lanes = self.kernel.broadcast_lanes
+        zero = self.kernel.zero_outbox()
+        inbox = {}
+        for k, z in zero.items():
+            arr = np.zeros_like(np.asarray(z))
+            if k in lanes:
+                arr[:, self.me] = np.asarray(own_out[k])[:, self.me]
+                for src, f in peer_frames.items():
+                    if f is not None:
+                        arr[:, src] = f["msg"][k]
+            else:
+                # transposed orientation: [G, dst(me), src]
+                arr[:, self.me, self.me] = np.asarray(own_out[k])[
+                    :, self.me, self.me
+                ]
+                for src, f in peer_frames.items():
+                    if f is not None:
+                        arr[:, self.me, src] = f["msg"][k]
+            inbox[k] = jnp.asarray(arr)
+        return inbox
+
+    # --------------------------------------------------------- main loop
+    def run(self) -> bool:
+        """Event loop; returns True to request a crash-restart."""
+        last_out = {
+            k: jnp.asarray(v) for k, v in self.kernel.zero_outbox().items()
+        }
+        while True:
+            if self.stopping:
+                return False
+            t0 = time.monotonic()
+            restart = self._handle_ctrl()
+            if restart is not None:
+                return restart
+            if self.paused:
+                time.sleep(self.tick_interval)
+                continue
+
+            # 1. client intake -> payload ids (one ReqBatch per group/tick);
+            # non-leaders redirect with the hinted leader id
+            # (request.rs:128-154)
+            batch = self.external.get_req_batch(timeout=0)
+            n_prop = np.zeros((self.G,), np.int32)
+            vbase = np.zeros((self.G,), np.int32)
+            piggy: Dict[int, Any] = {}
+            if batch:
+                reqs = [(c, r) for c, r in batch if r.kind == "req"]
+                if reqs and not self.was_leader:
+                    hint = int(np.asarray(self.state["leader"])[0, self.me]
+                               ) if "leader" in self.state else -1
+                    for c, r in reqs:
+                        self.external.send_reply(
+                            ApiReply("redirect", req_id=r.req_id,
+                                     redirect=hint, success=False),
+                            c,
+                        )
+                    reqs = []
+                if reqs:
+                    g = 0  # client plane addresses group 0
+                    vid = self.payloads.put(g, reqs)
+                    self.origin.add(vid)
+                    n_prop[g] = 1
+                    vbase[g] = vid
+                    piggy[vid] = reqs
+
+            # 2. exchange tick frames and step the kernel
+            frames = self._slice_outbox(last_out)
+            deadline = t0 + self.tick_interval
+            piggy.update(self._pending_serve)
+            self._pending_serve = {}
+            payload_msg: Dict[str, Any] = {
+                "pp": piggy,
+                "need": sorted(self.missing)[:64],
+                "kv_need": self.kv_need,
+            }
+            if self._pending_kv_serve:
+                payload_msg["kv"] = self.statemach.snapshot_items()
+                payload_msg["kv_floor"] = self.applied[0]
+                self._pending_kv_serve = False
+            self.transport.send_tick(
+                self.tick,
+                {dst: {"msg": frames[dst], **payload_msg}
+                 for dst in frames},
+            )
+            got = self.transport.recv_tick(self.tick, deadline)
+            self._ingest_payloads(got)
+            inbox = self._assemble_inbox(last_out, got)
+            inputs = {
+                "n_proposals": jnp.asarray(n_prop),
+                "value_base": jnp.asarray(vbase),
+                "exec_floor": jnp.asarray(
+                    np.broadcast_to(
+                        np.asarray(self.applied, np.int32)[:, None],
+                        (self.G, self.population),
+                    )
+                ),
+            }
+            self.state, last_out, fx = self._step(
+                self.state, inbox, inputs
+            )
+
+            # 3. apply newly committed slots; reflect leadership
+            self._apply_committed(fx)
+            self._leader_edges(fx)
+            self.tick += 1
+
+            rem = deadline - time.monotonic()
+            if rem > 0:
+                time.sleep(rem)
+
+    # -------------------------------------------------- payload exchange
+    def _ingest_payloads(self, got) -> None:
+        for src, f in got.items():
+            if f is None:
+                continue
+            for vid, batch in f.get("pp", {}).items():
+                if self.payloads.get(0, vid) is None:
+                    self.payloads._data[0][vid] = batch
+                self.missing.discard(vid)
+            # serve peers' missing payloads / kv requests next tick by
+            # folding them into our own piggyback
+            for vid in f.get("need", []):
+                b = self.payloads.get(0, vid)
+                if b is not None:
+                    self._pending_serve[vid] = b
+            if f.get("kv_need") and not self.kv_need:
+                self._pending_kv_serve = True
+            if "kv" in f and self.kv_need:
+                self.statemach._kv.update(f["kv"])
+                self.applied[0] = max(self.applied[0], f["kv_floor"])
+                self.kv_need = False
+
+    # ------------------------------------------------------- application
+    def _apply_committed(self, fx) -> None:
+        cb = int(np.asarray(fx.commit_bar)[0, self.me])
+        g = 0
+        if cb <= self.applied[g]:
+            return
+        win_abs = np.asarray(self.state["win_abs"])[g, self.me]
+        win_val = np.asarray(self.state["win_val"])[g, self.me]
+        W = self.kernel.W
+        while self.applied[g] < cb:
+            slot = self.applied[g]
+            pos = np.where(win_abs == slot)[0]
+            if len(pos) == 0:
+                # below the window: an install-snapshot jumped us forward;
+                # fetch the KV state from peers host-side
+                self.kv_need = True
+                self.applied[g] = cb
+                return
+            vid = int(win_val[pos[0]])
+            batch = self.payloads.get(g, vid)
+            if vid != 0 and batch is None:
+                self.missing.add(vid)
+                return  # stall the exec floor until the payload arrives
+            # durability before client-visible effects (storage.rs intent)
+            self.wal.do_sync_action(LogAction(
+                "append", entry=(g, slot, vid, batch), sync=False
+            ))
+            if batch is not None:
+                mine = vid in self.origin
+                for client, req in batch:
+                    res = apply_command(self.statemach._kv, req.cmd)
+                    if mine:
+                        self.external.send_reply(
+                            ApiReply("reply", req_id=req.req_id,
+                                     result=res),
+                            client,
+                        )
+            self.applied[g] = slot + 1
+
+    def _leader_edges(self, fx) -> None:
+        is_l = bool(np.asarray(
+            fx.extra.get("is_leader", np.zeros((self.G, self.population)))
+        )[0, self.me])
+        if is_l != self.was_leader:
+            self.ctrl.send_ctrl(
+                CtrlMsg("leader_status", {"step_up": is_l})
+            )
+            self.was_leader = is_l
+
+    # ----------------------------------------------------------- control
+    def _handle_ctrl(self) -> Optional[bool]:
+        msg = self.ctrl.try_recv_ctrl()
+        if msg is None:
+            return None
+        if msg.kind == "pause":
+            self.paused = True
+            self.ctrl.send_ctrl(CtrlMsg("pause_reply"))
+        elif msg.kind == "resume":
+            self.paused = False
+            self.ctrl.send_ctrl(CtrlMsg("resume_reply"))
+        elif msg.kind == "reset_state":
+            if not msg.payload.get("durable", True):
+                self.wal.stop()
+                try:
+                    os.remove(self.wal_path)
+                except OSError:
+                    pass
+            self.ctrl.send_ctrl(CtrlMsg("reset_reply"))
+            return True
+        elif msg.kind == "take_snapshot":
+            kv = self.statemach.snapshot_items()
+            snap = StorageHub(self.snapdir)
+            snap.do_sync_action(LogAction(
+                "append", entry=("kv", kv, self.applied[0]), sync=True
+            ))
+            snap.stop()
+            self.ctrl.send_ctrl(CtrlMsg("snapshot_reply"))
+            self.ctrl.send_ctrl(CtrlMsg(
+                "snapshot_up_to", {"new_start": self.applied[0]}
+            ))
+        elif msg.kind == "leave":
+            return False
+        return None
+
+    def shutdown(self) -> None:
+        self.external.stop()
+        self.transport.close()
+        self.statemach.stop()
+        self.wal.stop()
+        self.ctrl.close()

@@ -1,0 +1,97 @@
+"""StateMachine: the in-memory KV applier behind exec/ack queues.
+
+Parity: reference ``src/server/statemach.rs`` — ``Command::{Get, Put}`` ->
+``CommandResult::{Get{value}, Put{old_value}}`` applied by an executor task
+owning a ``HashMap`` (statemach.rs:21-72, executor :170-219).  The applier
+core is a static function for testability, mirroring the reference's
+deliberate pattern (statemach.rs:191-193).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    """Get(key) or Put(key, value) (parity: ``Command``)."""
+
+    kind: str  # "get" | "put"
+    key: str
+    value: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CommandResult:
+    """Get -> value, Put -> old_value (parity: ``CommandResult``)."""
+
+    kind: str
+    value: Optional[str] = None
+    old_value: Optional[str] = None
+
+
+def apply_command(kv: Dict[str, str], cmd: Command) -> CommandResult:
+    """Pure applier core (parity: the static ``execute`` fn)."""
+    if cmd.kind == "get":
+        return CommandResult("get", value=kv.get(cmd.key))
+    if cmd.kind == "put":
+        old = kv.get(cmd.key)
+        kv[cmd.key] = cmd.value if cmd.value is not None else ""
+        return CommandResult("put", old_value=old)
+    raise ValueError(f"unknown command kind {cmd.kind}")
+
+
+class StateMachine:
+    """Executor-owned KV store with submit/ack queues.
+
+    ``submit_cmd``/``get_result`` mirror the reference hub channels
+    (statemach.rs:117-150); ``do_sync_cmd`` is the blocking path used by
+    snapshotting (:151).
+    """
+
+    def __init__(self):
+        self._in: queue.Queue = queue.Queue()
+        self._out: queue.Queue = queue.Queue()
+        self._kv: Dict[str, str] = {}
+        self._thread = threading.Thread(target=self._executor, daemon=True)
+        self._thread.start()
+
+    def submit_cmd(self, cmd_id: Any, cmd: Command) -> None:
+        self._in.put((cmd_id, cmd))
+
+    def get_result(self, timeout: Optional[float] = None
+                   ) -> Tuple[Any, CommandResult]:
+        return self._out.get(timeout=timeout)
+
+    def do_sync_cmd(self, cmd: Command) -> CommandResult:
+        done: queue.Queue = queue.Queue()
+        self._in.put((("__sync__", done), cmd))
+        return done.get()
+
+    def snapshot_items(self):
+        """Blocking consistent view for snapshot dumps (drains in-order)."""
+        done: queue.Queue = queue.Queue()
+        self._in.put((("__snap__", done), None))
+        return done.get()
+
+    def stop(self) -> None:
+        self._in.put(None)
+        self._thread.join(timeout=5)
+
+    def _executor(self) -> None:
+        while True:
+            item = self._in.get()
+            if item is None:
+                return
+            cmd_id, cmd = item
+            if isinstance(cmd_id, tuple) and cmd_id[0] == "__snap__":
+                cmd_id[1].put(dict(self._kv))
+                continue
+            res = apply_command(self._kv, cmd)
+            if isinstance(cmd_id, tuple) and cmd_id[0] == "__sync__":
+                cmd_id[1].put(res)
+            else:
+                self._out.put((cmd_id, res))

@@ -1,0 +1,245 @@
+"""StorageHub: durable WAL/snapshot logger behind a submit/result queue.
+
+Parity: reference ``src/server/storage.rs`` — a hub owning a logger task;
+actions ``Read/Write/Append/Truncate/Discard`` over 8-byte length-prefixed
+entries in a flat file, with optional fsync (``LogAction`` storage.rs:25-45,
+``LogResult`` :49-70, logger task :192-510).  The hot file path is the
+native C++ backend (``native/wal.cpp``) driven by a worker thread; a pure-
+Python mirror keeps toolchain-less hosts working.  Entries are pickled
+Python objects, mirroring the reference's bincode-serialized ``Ent``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import pickle
+import queue
+import struct
+import threading
+from typing import Any, Optional, Tuple
+
+from ..native import load_wal
+from ..utils.errors import SummersetError
+
+_LEN = struct.Struct("<Q")
+
+
+@dataclasses.dataclass
+class LogAction:
+    """One logger action (parity: ``LogAction``, storage.rs:25-45)."""
+
+    kind: str                 # read | write | append | truncate | discard
+    entry: Any = None         # write/append payload (any picklable object)
+    offset: int = 0           # read/write/truncate/discard target offset
+    keep: int = 0             # discard: bytes of header to keep
+    sync: bool = False        # fsync after mutating
+
+
+@dataclasses.dataclass
+class LogResult:
+    """Logger completion (parity: ``LogResult``, storage.rs:49-70)."""
+
+    kind: str
+    entry: Any = None           # read: the decoded entry (None past end)
+    end_offset: int = 0         # read/write/append: entry end offset
+    offset_ok: bool = True      # write/truncate/discard validity
+    now_size: int = 0           # truncate/discard: resulting log size
+
+
+class _PyWal:
+    """Pure-Python fallback mirror of native/wal.cpp."""
+
+    def __init__(self, path: str):
+        # r+b (not a+b): O_APPEND would ignore seeks on write
+        if not os.path.exists(path):
+            open(path, "wb").close()
+        self.f = open(path, "r+b")
+        self.f.seek(0, os.SEEK_END)
+        self.size = self.f.tell()
+
+    def append(self, body: bytes, sync: bool) -> int:
+        self.f.seek(self.size)
+        self.f.write(_LEN.pack(len(body)) + body)
+        self.size += 8 + len(body)
+        self.f.flush()
+        if sync:
+            os.fdatasync(self.f.fileno())
+        return self.size
+
+    def write_at(self, off: int, body: bytes, sync: bool) -> int:
+        self.f.seek(off)
+        self.f.write(_LEN.pack(len(body)) + body)
+        end = off + 8 + len(body)
+        self.size = max(self.size, end)
+        self.f.flush()
+        if sync:
+            os.fdatasync(self.f.fileno())
+        return end
+
+    def read(self, off: int) -> Optional[Tuple[bytes, int]]:
+        if off + 8 > self.size:
+            return None
+        self.f.seek(off)
+        (length,) = _LEN.unpack(self.f.read(8))
+        if off + 8 + length > self.size:
+            return None
+        return self.f.read(length), off + 8 + length
+
+    def truncate(self, off: int, sync: bool) -> bool:
+        if off > self.size:
+            return False
+        self.f.truncate(off)
+        self.size = off
+        if sync:
+            self.f.flush()
+            os.fdatasync(self.f.fileno())
+        return True
+
+    def discard(self, off: int, keep: int, sync: bool) -> bool:
+        if off < keep or off > self.size:
+            return False
+        self.f.seek(off)
+        tail = self.f.read(self.size - off)
+        self.f.seek(keep)
+        self.f.write(tail)
+        self.f.truncate(keep + len(tail))
+        self.size = keep + len(tail)
+        self.f.flush()
+        if sync:
+            os.fdatasync(self.f.fileno())
+        return True
+
+    def close(self):
+        self.f.close()
+
+
+class _NativeWal:
+    """ctypes facade over native/wal.cpp with the same method surface."""
+
+    def __init__(self, lib, path: str):
+        self.lib = lib
+        self.h = lib.wal_open(path.encode())
+        if not self.h:
+            raise SummersetError(f"wal_open failed for {path}")
+
+    @property
+    def size(self) -> int:
+        return self.lib.wal_size(self.h)
+
+    def append(self, body: bytes, sync: bool) -> int:
+        end = self.lib.wal_append(self.h, body, len(body), int(sync))
+        if end == 0:
+            raise SummersetError("wal_append failed")
+        return end
+
+    def write_at(self, off: int, body: bytes, sync: bool) -> int:
+        end = self.lib.wal_write_at(self.h, off, body, len(body), int(sync))
+        if end == 0:
+            raise SummersetError("wal_write_at failed")
+        return end
+
+    def read(self, off: int) -> Optional[Tuple[bytes, int]]:
+        cap = 1 << 16
+        while True:
+            buf = (ctypes.c_uint8 * cap)()
+            n = self.lib.wal_read(self.h, off, buf, cap)
+            if n == -1:
+                return None
+            if n == -2:
+                cap *= 4
+                continue
+            return bytes(buf[: int(n)]), off + 8 + int(n)
+
+    def truncate(self, off: int, sync: bool) -> bool:
+        return self.lib.wal_truncate(self.h, off, int(sync)) == 0
+
+    def discard(self, off: int, keep: int, sync: bool) -> bool:
+        return self.lib.wal_discard(self.h, off, keep, int(sync)) == 0
+
+    def close(self):
+        self.lib.wal_close(self.h)
+        self.h = None
+
+
+class StorageHub:
+    """Durable logger hub: submit actions, collect results in order.
+
+    The channel-based API mirrors the reference hub
+    (``submit_action``/``get_result``, storage.rs:137-190); the logger
+    thread owns the file, like the reference's spawned logger task.
+    """
+
+    def __init__(self, path: str, prefer_native: bool = True):
+        lib = load_wal() if prefer_native else None
+        self.backend = _NativeWal(lib, path) if lib else _PyWal(path)
+        self.native = lib is not None and prefer_native
+        self._in: queue.Queue = queue.Queue()
+        self._out: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._logger, daemon=True)
+        self._thread.start()
+
+    # -- channel API ---------------------------------------------------------
+    def submit_action(self, action_id: Any, action: LogAction) -> None:
+        self._in.put((action_id, action))
+
+    def get_result(self, timeout: Optional[float] = None):
+        """Blocking next (action_id, LogResult)."""
+        return self._out.get(timeout=timeout)
+
+    def do_sync_action(self, action: LogAction) -> LogResult:
+        """Convenience: run one action synchronously (reference
+        ``do_sync_action`` pattern, used by recovery replay)."""
+        self.submit_action(None, action)
+        aid, res = self.get_result()
+        assert aid is None
+        return res
+
+    def stop(self) -> None:
+        self._in.put(None)
+        self._thread.join(timeout=5)
+        self.backend.close()
+
+    @property
+    def size(self) -> int:
+        return self.backend.size
+
+    # -- logger thread -------------------------------------------------------
+    def _handle(self, a: LogAction) -> LogResult:
+        b = self.backend
+        if a.kind == "read":
+            got = b.read(a.offset)
+            if got is None:
+                return LogResult("read", entry=None, end_offset=a.offset,
+                                 offset_ok=False)
+            body, end = got
+            return LogResult("read", entry=pickle.loads(body),
+                             end_offset=end)
+        if a.kind == "append":
+            end = b.append(pickle.dumps(a.entry), a.sync)
+            return LogResult("append", end_offset=end)
+        if a.kind == "write":
+            if a.offset > b.size:
+                return LogResult("write", offset_ok=False)
+            end = b.write_at(a.offset, pickle.dumps(a.entry), a.sync)
+            return LogResult("write", end_offset=end)
+        if a.kind == "truncate":
+            ok = b.truncate(a.offset, a.sync)
+            return LogResult("truncate", offset_ok=ok, now_size=b.size)
+        if a.kind == "discard":
+            ok = b.discard(a.offset, a.keep, a.sync)
+            return LogResult("discard", offset_ok=ok, now_size=b.size)
+        raise SummersetError(f"unknown log action kind {a.kind}")
+
+    def _logger(self) -> None:
+        while True:
+            item = self._in.get()
+            if item is None:
+                return
+            action_id, action = item
+            try:
+                res = self._handle(action)
+            except Exception as e:  # surface backend errors to the caller
+                res = LogResult(action.kind, offset_ok=False, entry=e)
+            self._out.put((action_id, res))

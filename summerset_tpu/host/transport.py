@@ -1,0 +1,167 @@
+"""TransportHub: the server-to-server TCP mesh for real deployments.
+
+Parity: reference ``src/server/transport.rs`` — an acceptor task plus one
+messenger per peer, full mesh built by proactively connecting to lower-id
+peers and accepting from higher ids (transport.rs:388-849).
+
+Lockstep adaptation: a server process owns replica index ``me`` of every
+group.  Each tick it sends one frame per peer carrying (tick number, its
+outbox slices for that destination, an optional payload piggyback) and
+assembles the inbox for tick ``t`` from peers' frames.  A peer frame that
+misses the per-tick deadline is treated as dropped — the kernels' loss
+machinery (go-back-N streams, re-campaigns) recovers, matching the
+netmodel's loss semantics rather than TCP's infinite retry.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..utils import safetcp
+from ..utils.errors import SummersetError
+from ..utils.logging import pf_info, pf_logger, pf_warn
+
+logger = pf_logger("transport")
+
+
+class TransportHub:
+    def __init__(self, me: int, population: int, p2p_addr: Tuple[str, int]):
+        self.me = me
+        self.population = population
+        self.p2p_addr = p2p_addr
+        self._conns: Dict[int, socket.socket] = {}
+        self._wlocks: Dict[int, threading.Lock] = {}
+        # per-peer receive queues of (tick, payload)
+        self._rq: Dict[int, queue.Queue] = {
+            p: queue.Queue() for p in range(population) if p != me
+        }
+        self._stash: Dict[int, Dict[int, Any]] = {
+            p: {} for p in range(population) if p != me
+        }
+        self._listener = socket.create_server(
+            p2p_addr, reuse_port=False, backlog=population
+        )
+        self._accept_thread = threading.Thread(
+            target=self._acceptor, daemon=True
+        )
+        self._accept_thread.start()
+
+    # ---------------------------------------------------------- mesh setup
+    def connect_to_peer(self, peer: int, addr: Tuple[str, int]) -> None:
+        """Proactively connect to a lower-id peer (transport.rs:162)."""
+        sock = None
+        for _ in range(50):
+            try:
+                sock = socket.create_connection(tuple(addr), timeout=5.0)
+                break
+            except OSError:
+                import time
+
+                time.sleep(0.2)
+        if sock is None:
+            raise SummersetError(f"cannot connect to peer {peer} @ {addr}")
+        sock.settimeout(None)
+        safetcp.send_msg_sync(sock, self.me)  # identify ourselves
+        self._register(peer, sock)
+
+    def wait_for_group(self, timeout: float = 30.0) -> None:
+        """Block until the full mesh is connected (transport.rs:181)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while len(self._conns) < self.population - 1:
+            if time.monotonic() > deadline:
+                raise SummersetError(
+                    f"mesh incomplete: {sorted(self._conns)} of "
+                    f"{self.population - 1} peers"
+                )
+            time.sleep(0.05)
+        pf_info(logger, f"p2p mesh complete ({self.population} replicas)")
+
+    def _register(self, peer: int, sock: socket.socket) -> None:
+        self._conns[peer] = sock
+        self._wlocks[peer] = threading.Lock()
+        t = threading.Thread(
+            target=self._messenger_recv, args=(peer, sock), daemon=True
+        )
+        t.start()
+
+    def _acceptor(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                peer = int(safetcp.recv_msg_sync(sock))
+            except Exception:
+                sock.close()
+                continue
+            self._register(peer, sock)
+
+    def _messenger_recv(self, peer: int, sock: socket.socket) -> None:
+        try:
+            while True:
+                tick, payload = safetcp.recv_msg_sync(sock)
+                self._rq[peer].put((tick, payload))
+        except Exception:
+            pf_warn(logger, f"peer {peer} connection lost")
+            if self._conns.get(peer) is sock:
+                del self._conns[peer]
+
+    # ------------------------------------------------------------ tick I/O
+    def send_tick(self, tick: int, per_peer: Dict[int, Any]) -> None:
+        """Send this tick's outbox slice to each connected peer."""
+        for peer, payload in per_peer.items():
+            sock = self._conns.get(peer)
+            if sock is None:
+                continue
+            try:
+                with self._wlocks[peer]:
+                    safetcp.send_msg_sync(sock, (tick, payload))
+            except OSError:
+                self._conns.pop(peer, None)
+
+    def recv_tick(
+        self, tick: int, deadline: float
+    ) -> Dict[int, Optional[Any]]:
+        """Collect peers' frames for `tick`, waiting until `deadline`
+        (monotonic seconds).  Missing frames return None (dropped); frames
+        for future ticks are stashed, stale ones discarded."""
+        import time
+
+        out: Dict[int, Optional[Any]] = {}
+        for peer, q in self._rq.items():
+            stash = self._stash[peer]
+            if tick in stash:
+                out[peer] = stash.pop(tick)
+                continue
+            got = None
+            while True:
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    break
+                try:
+                    t, payload = q.get(timeout=budget)
+                except queue.Empty:
+                    break
+                if t == tick:
+                    got = payload
+                    break
+                if t > tick:
+                    stash[t] = payload
+                    break
+                # t < tick: stale, drop
+            out[peer] = got
+        return out
+
+    def close(self) -> None:
+        self._listener.close()
+        for sock in list(self._conns.values()):
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,9 @@
+"""Cluster manager oracle.
+
+Parity: reference ``src/manager/`` (SURVEY.md §2.3) — a standalone process
+that assigns replica IDs, distributes peer addresses, tracks leader status,
+and injects control actions (reset / pause / resume / snapshot).  It is
+explicitly *not* part of protocol logic (``clusman.rs:41-116``).
+"""
+
+from .clusman import ClusterManager  # noqa: F401

@@ -1,0 +1,220 @@
+"""ClusterManager: the oracle event loop plus its two TCP endpoints.
+
+Parity: reference ``src/manager/clusman.rs`` (oracle, :41-185) composed of
+``ServerReigner`` (server-facing control, ``reigner.rs:86-160``) and
+``ClientReactor`` (client-facing control, ``reactor.rs:108-140``).  Here
+the two endpoints are asyncio servers feeding one event loop; IDs are
+assigned on connect, joins answer with ``ConnectToPeers`` carrying the
+addresses of lower-id peers (the reference's proactive-connect rule,
+``multipaxos/mod.rs:717-737``), and client control requests (reset / pause
+/ resume / snapshot) fan out ``CtrlMsg``s and gather replies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+from ..host.messages import CtrlMsg, CtrlReply, CtrlRequest
+from ..utils import safetcp
+from ..utils.logging import pf_info, pf_logger, pf_warn, set_me
+
+logger = pf_logger("clusman")
+
+
+class _ServerConn:
+    def __init__(self, sid, reader, writer):
+        self.sid = sid
+        self.reader = reader
+        self.writer = writer
+        self.api_addr: Optional[Tuple[str, int]] = None
+        self.p2p_addr: Optional[Tuple[str, int]] = None
+        self.joined = False
+
+
+class ClusterManager:
+    def __init__(
+        self,
+        protocol: str,
+        srv_addr: Tuple[str, int],
+        cli_addr: Tuple[str, int],
+        population: int,
+    ):
+        self.protocol = protocol
+        self.srv_addr = srv_addr
+        self.cli_addr = cli_addr
+        self.population = population
+        self.servers: Dict[int, _ServerConn] = {}
+        self.leader: Optional[int] = None
+        self.conf: Optional[dict] = None
+        self._next_sid = 0
+        self._next_cid = 1000
+        self._pending_replies: Dict[str, asyncio.Queue] = {}
+
+    # ------------------------------------------------------- server plane
+    async def _serve_server(self, reader, writer) -> None:
+        # id assignment: reuse the lowest free id (a restarted server takes
+        # its old id back once the dead connection is reaped)
+        sid = None
+        for cand in range(self.population):
+            conn = self.servers.get(cand)
+            if conn is None or conn.writer.is_closing():
+                sid = cand
+                break
+        if sid is None:
+            writer.close()
+            return
+        conn = _ServerConn(sid, reader, writer)
+        self.servers[sid] = conn
+        await safetcp.send_msg(writer, (sid, self.population))
+        pf_info(logger, f"assigned server id {sid}")
+        try:
+            while True:
+                msg = await safetcp.recv_msg(reader)
+                if not isinstance(msg, CtrlMsg):
+                    continue
+                await self._handle_ctrl(conn, msg)
+                if msg.kind == "leave":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pf_warn(logger, f"server {sid} connection lost")
+        finally:
+            writer.close()
+
+    async def _handle_ctrl(self, conn: _ServerConn, msg: CtrlMsg) -> None:
+        p = msg.payload
+        if msg.kind == "new_server_join":
+            conn.api_addr = p["api_addr"]
+            conn.p2p_addr = p["p2p_addr"]
+            conn.joined = True
+            to_peers = {
+                s.sid: s.p2p_addr
+                for s in self.servers.values()
+                if s.joined and s.sid < conn.sid
+            }
+            await safetcp.send_msg(
+                conn.writer,
+                CtrlMsg(
+                    "connect_to_peers",
+                    {"population": self.population, "to_peers": to_peers},
+                ),
+            )
+            pf_info(logger, f"server {conn.sid} joined")
+        elif msg.kind == "leader_status":
+            if p.get("step_up"):
+                self.leader = conn.sid
+            elif self.leader == conn.sid:
+                self.leader = None
+            pf_info(logger, f"leader status: {self.leader}")
+        elif msg.kind == "responders_conf":
+            self.conf = p.get("new_conf")
+        elif msg.kind == "snapshot_up_to":
+            pf_info(
+                logger,
+                f"server {conn.sid} snapshot up to {p.get('new_start')}",
+            )
+        elif msg.kind in (
+            "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
+        ):
+            q = self._pending_replies.get(msg.kind)
+            if q is not None:
+                q.put_nowait(conn.sid)
+        elif msg.kind == "leave":
+            await safetcp.send_msg(conn.writer, CtrlMsg("leave_reply"))
+
+    # ------------------------------------------------------- client plane
+    async def _serve_client(self, reader, writer) -> None:
+        cid = self._next_cid
+        self._next_cid += 1
+        await safetcp.send_msg(writer, cid)
+        try:
+            while True:
+                req = await safetcp.recv_msg(reader)
+                if not isinstance(req, CtrlRequest):
+                    continue
+                if req.kind == "leave":
+                    await safetcp.send_msg(writer, CtrlReply("leave"))
+                    break
+                reply = await self._handle_request(req)
+                await safetcp.send_msg(writer, reply)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _targets(self, req: CtrlRequest):
+        ids = req.servers
+        return [
+            s for s in self.servers.values()
+            if s.joined and not s.writer.is_closing()
+            and (ids is None or s.sid in ids)
+        ]
+
+    async def _fanout_wait(
+        self, kind: str, reply_kind: str, req: CtrlRequest, extra=None
+    ) -> CtrlReply:
+        """Fan a CtrlMsg to target servers, await one reply from each
+        (parity: clusman.rs:382-606 orchestration handlers)."""
+        targets = self._targets(req)
+        q: asyncio.Queue = asyncio.Queue()
+        self._pending_replies[reply_kind] = q
+        payload = dict(extra or {})
+        for s in targets:
+            await safetcp.send_msg(s.writer, CtrlMsg(kind, payload))
+        done = []
+        try:
+            for _ in targets:
+                done.append(
+                    await asyncio.wait_for(q.get(), timeout=15.0)
+                )
+        except asyncio.TimeoutError:
+            pf_warn(logger, f"{kind}: timed out waiting for replies")
+        finally:
+            self._pending_replies.pop(reply_kind, None)
+        return CtrlReply(kind, done=done)
+
+    async def _handle_request(self, req: CtrlRequest) -> CtrlReply:
+        if req.kind == "query_info":
+            return CtrlReply(
+                "info",
+                population=self.population,
+                servers={
+                    s.sid: (s.api_addr, s.p2p_addr)
+                    for s in self.servers.values()
+                    if s.joined
+                },
+                leader=self.leader,
+            )
+        if req.kind == "query_conf":
+            return CtrlReply("conf", conf=self.conf, leader=self.leader)
+        if req.kind == "pause_servers":
+            return await self._fanout_wait("pause", "pause_reply", req)
+        if req.kind == "resume_servers":
+            return await self._fanout_wait("resume", "resume_reply", req)
+        if req.kind == "reset_servers":
+            return await self._fanout_wait(
+                "reset_state", "reset_reply", req,
+                {"durable": req.durable},
+            )
+        if req.kind == "take_snapshot":
+            return await self._fanout_wait(
+                "take_snapshot", "snapshot_reply", req
+            )
+        return CtrlReply("unknown")
+
+    # ------------------------------------------------------------- runner
+    async def run(self) -> None:
+        set_me("m")
+        srv = await safetcp.tcp_bind_with_retry(
+            self.srv_addr[0], self.srv_addr[1], self._serve_server
+        )
+        cli = await safetcp.tcp_bind_with_retry(
+            self.cli_addr[0], self.cli_addr[1], self._serve_client
+        )
+        pf_info(
+            logger,
+            f"manager up: srv @ {self.srv_addr} cli @ {self.cli_addr} "
+            f"population {self.population}",
+        )
+        async with srv, cli:
+            await asyncio.gather(srv.serve_forever(), cli.serve_forever())

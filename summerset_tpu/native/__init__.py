@@ -1,0 +1,76 @@
+"""Native (C++) components, loaded via ctypes — no pybind11 dependency.
+
+``load_wal()`` returns the ctypes handle to the WAL backend, building the
+shared object with the bundled Makefile on first use.  Build failures fall
+back to ``None``; callers (``host/storage.py``) must degrade to the pure-
+Python mirror so the framework stays usable on toolchain-less machines.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libsummerset_wal.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _configure(lib) -> None:
+    lib.wal_open.restype = ctypes.c_void_p
+    lib.wal_open.argtypes = [ctypes.c_char_p]
+    lib.wal_close.argtypes = [ctypes.c_void_p]
+    lib.wal_size.restype = ctypes.c_uint64
+    lib.wal_size.argtypes = [ctypes.c_void_p]
+    lib.wal_append.restype = ctypes.c_uint64
+    lib.wal_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.wal_write_at.restype = ctypes.c_uint64
+    lib.wal_write_at.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.wal_read.restype = ctypes.c_int64
+    lib.wal_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+    ]
+    lib.wal_truncate.restype = ctypes.c_int
+    lib.wal_truncate.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.wal_discard.restype = ctypes.c_int
+    lib.wal_discard.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+    ]
+
+
+def load_wal():
+    """The ctypes library handle, or None when the native build fails."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO)
+                < os.path.getmtime(os.path.join(_DIR, "wal.cpp"))
+            ):
+                subprocess.run(
+                    ["make", "-C", _DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            lib = ctypes.CDLL(_SO)
+            _configure(lib)
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib

@@ -1,0 +1,139 @@
+"""In-process cluster integration: a real manager + N ServerReplica event
+loops over localhost TCP, driven by the reference tester suite semantics
+(reset / pause / resume through the manager control plane — SURVEY.md §4
+tier 2).  All replicas share one process (and thus one jit cache); the
+sockets, WALs, and control flows are the real ones.
+"""
+
+import asyncio
+import shutil
+import socket
+import threading
+import time
+
+import pytest
+
+from summerset_tpu.client.tester import ClientTester
+from summerset_tpu.host.server import ServerReplica
+from summerset_tpu.manager import ClusterManager
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class Cluster:
+    def __init__(self, protocol, n, tmpdir, config=None, tick=0.005):
+        self.protocol = protocol
+        self.n = n
+        self.tmpdir = str(tmpdir)
+        self.config = config or {}
+        self.tick = tick
+        ports = free_ports(2 + 2 * n)
+        self.srv_port, self.cli_port = ports[0], ports[1]
+        self.api_ports = ports[2:2 + n]
+        self.p2p_ports = ports[2 + n:]
+        self.manager_addr = ("127.0.0.1", self.cli_port)
+        self.replicas = {}
+        self._threads = []
+        self._man_loop = None
+
+        man = ClusterManager(
+            protocol, ("127.0.0.1", self.srv_port),
+            ("127.0.0.1", self.cli_port), n,
+        )
+
+        def run_man():
+            loop = asyncio.new_event_loop()
+            self._man_loop = loop
+            asyncio.set_event_loop(loop)
+            try:
+                loop.run_until_complete(man.run())
+            except Exception:
+                pass
+
+        t = threading.Thread(target=run_man, daemon=True)
+        t.start()
+        self._threads.append(t)
+        time.sleep(0.3)
+
+        # replicas must come up concurrently (mesh barrier)
+        for r in range(n):
+            t = threading.Thread(
+                target=self._replica_loop, args=(r,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        deadline = time.monotonic() + 120
+        while len(self.replicas) < n:
+            assert time.monotonic() < deadline, "cluster failed to start"
+            time.sleep(0.1)
+        time.sleep(1.0)  # let the warm-start leader settle
+
+    def _replica_loop(self, slot: int) -> None:
+        """Crash-restart loop (parity: summerset_server main loop)."""
+        while True:
+            rep = ServerReplica(
+                self.protocol,
+                ("127.0.0.1", self.api_ports[slot]),
+                ("127.0.0.1", self.p2p_ports[slot]),
+                ("127.0.0.1", self.srv_port),
+                config=self.config,
+                tick_interval=self.tick,
+                window=32,
+                backer_dir=self.tmpdir,
+            )
+            self.replicas[rep.me] = rep
+            restart = rep.run()
+            rep.shutdown()
+            self.replicas.pop(rep.me, None)
+            if not restart:
+                return
+            time.sleep(0.2)
+
+    def stop(self):
+        for rep in list(self.replicas.values()):
+            rep.stopping = True
+        time.sleep(3 * self.tick + 0.2)
+        for rep in list(self.replicas.values()):
+            try:
+                rep.shutdown()
+            except Exception:
+                pass
+        if self._man_loop is not None:
+            self._man_loop.call_soon_threadsafe(self._man_loop.stop)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster("MultiPaxos", 3, tmp_path)
+    yield c
+    c.stop()
+
+
+class TestClusterMultiPaxos:
+    def test_tester_suite_basic(self, cluster):
+        t = ClientTester(cluster.manager_addr, settle=1.5)
+        results = t.run_tests([
+            "primitive_ops",
+            "client_reconnect",
+            "node_pause_resume",
+        ])
+        assert all(v == "PASS" for v in results.values()), results
+
+    def test_tester_suite_faults(self, cluster):
+        t = ClientTester(cluster.manager_addr, settle=2.5)
+        results = t.run_tests([
+            "non_leader_pause",
+            "leader_node_pause",
+            "non_leader_reset",
+        ])
+        assert all(v == "PASS" for v in results.values()), results

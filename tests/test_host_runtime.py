@@ -1,0 +1,159 @@
+"""Host runtime unit tests: native WAL semantics (against the reference's
+storage-action contract, storage.rs:511+), state machine semantics with a
+random oracle (statemach.rs:222-409 pattern), and the payload store."""
+
+import os
+import random
+
+import pytest
+
+from summerset_tpu.host import (
+    Command,
+    LogAction,
+    PayloadStore,
+    StateMachine,
+    StorageHub,
+)
+from summerset_tpu.host.statemach import apply_command
+from summerset_tpu.native import load_wal
+
+
+@pytest.fixture(params=["native", "python"])
+def hub(request, tmp_path):
+    if request.param == "native" and load_wal() is None:
+        pytest.skip("native WAL backend unavailable")
+    h = StorageHub(
+        str(tmp_path / "test.wal"),
+        prefer_native=(request.param == "native"),
+    )
+    yield h
+    h.stop()
+
+
+class TestStorage:
+    def test_append_read_roundtrip(self, hub):
+        entries = [("put", f"k{i}", "v" * i) for i in range(10)]
+        offs = [0]
+        for e in entries:
+            res = hub.do_sync_action(LogAction("append", entry=e))
+            offs.append(res.end_offset)
+        for i, e in enumerate(entries):
+            res = hub.do_sync_action(LogAction("read", offset=offs[i]))
+            assert res.offset_ok and res.entry == e
+            assert res.end_offset == offs[i + 1]
+        # read past end fails cleanly
+        res = hub.do_sync_action(LogAction("read", offset=offs[-1]))
+        assert not res.offset_ok
+
+    def test_write_truncate(self, hub):
+        a = hub.do_sync_action(LogAction("append", entry="one"))
+        b = hub.do_sync_action(LogAction("append", entry="two"))
+        # overwrite entry 2 in place
+        res = hub.do_sync_action(
+            LogAction("write", entry="TWO", offset=a.end_offset, sync=True)
+        )
+        assert res.end_offset >= b.end_offset - 1
+        got = hub.do_sync_action(LogAction("read", offset=a.end_offset))
+        assert got.entry == "TWO"
+        # truncate back to entry 1
+        res = hub.do_sync_action(
+            LogAction("truncate", offset=a.end_offset)
+        )
+        assert res.offset_ok and res.now_size == a.end_offset
+        assert not hub.do_sync_action(
+            LogAction("read", offset=a.end_offset)
+        ).offset_ok
+
+    def test_discard_keeps_header(self, hub):
+        head = hub.do_sync_action(LogAction("append", entry="header"))
+        mid = hub.do_sync_action(LogAction("append", entry="old"))
+        hub.do_sync_action(LogAction("append", entry="new"))
+        res = hub.do_sync_action(
+            LogAction("discard", offset=mid.end_offset,
+                      keep=head.end_offset)
+        )
+        assert res.offset_ok
+        assert hub.do_sync_action(
+            LogAction("read", offset=0)
+        ).entry == "header"
+        assert hub.do_sync_action(
+            LogAction("read", offset=head.end_offset)
+        ).entry == "new"
+
+    def test_reopen_preserves_log(self, tmp_path):
+        path = str(tmp_path / "re.wal")
+        h1 = StorageHub(path)
+        h1.do_sync_action(LogAction("append", entry={"x": 1}, sync=True))
+        end = h1.size
+        h1.stop()
+        h2 = StorageHub(path)
+        assert h2.size == end
+        assert h2.do_sync_action(
+            LogAction("read", offset=0)
+        ).entry == {"x": 1}
+        h2.stop()
+
+    def test_native_backend_used_when_available(self, tmp_path):
+        if load_wal() is None:
+            pytest.skip("no toolchain")
+        h = StorageHub(str(tmp_path / "n.wal"))
+        assert h.native
+        h.stop()
+
+
+class TestStateMachine:
+    def test_semantics(self):
+        sm = StateMachine()
+        assert sm.do_sync_cmd(Command("get", "a")).value is None
+        assert sm.do_sync_cmd(Command("put", "a", "1")).old_value is None
+        assert sm.do_sync_cmd(Command("get", "a")).value == "1"
+        assert sm.do_sync_cmd(Command("put", "a", "2")).old_value == "1"
+        assert sm.do_sync_cmd(Command("get", "a")).value == "2"
+        sm.stop()
+
+    def test_random_against_dict_oracle(self):
+        sm = StateMachine()
+        oracle = {}
+        rng = random.Random(7)
+        for _ in range(500):
+            key = f"k{rng.randrange(10)}"
+            if rng.random() < 0.5:
+                val = str(rng.randrange(1000))
+                res = sm.do_sync_cmd(Command("put", key, val))
+                assert res.old_value == oracle.get(key)
+                oracle[key] = val
+            else:
+                res = sm.do_sync_cmd(Command("get", key))
+                assert res.value == oracle.get(key)
+        assert sm.snapshot_items() == oracle
+        sm.stop()
+
+    def test_async_queue_ordering(self):
+        sm = StateMachine()
+        for i in range(100):
+            sm.submit_cmd(i, Command("put", "k", str(i)))
+        for i in range(100):
+            cid, res = sm.get_result(timeout=5)
+            assert cid == i
+        assert sm.do_sync_cmd(Command("get", "k")).value == "99"
+        sm.stop()
+
+    def test_apply_command_pure(self):
+        kv = {}
+        assert apply_command(kv, Command("put", "x", "1")).old_value is None
+        assert apply_command(kv, Command("get", "x")).value == "1"
+
+
+class TestPayloadStore:
+    def test_ids_and_gc(self):
+        ps = PayloadStore(2)
+        v1 = ps.put(0, ["a"])
+        v2 = ps.put(0, ["b"])
+        w1 = ps.put(1, ["c"])
+        assert (v1, v2, w1) == (1, 2, 1)
+        assert ps.get(0, v1) == ["a"]
+        assert ps.get(0, 0) is None  # no-op sentinel
+        assert ps.gc_below(0, v2) == 1
+        assert ps.get(0, v1) is None
+        assert ps.get(0, v2) == ["b"]
+        assert ps.get(1, w1) == ["c"]
