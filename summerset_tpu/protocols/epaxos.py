@@ -852,11 +852,18 @@ class EPaxosKernel(ProtocolKernel):
         erp_bal_in = jnp.where(erp_in, c.inbox["erp_bal"], 0)
         best_bal = erp_bal_in.max(axis=2)
         best_src = erp_bal_in.argmax(axis=2)[..., None]
-        serve = best_bal > 0
         srow = jnp.take_along_axis(c.inbox["erp_row"], best_src, axis=2)[
             ..., 0
         ]
         srow_c = jnp.maximum(srow, 0)
+        # never answer a campaign below a ballot already promised for that
+        # row (rbm was raised by _ingest_erp this tick, so this also means
+        # only the max concurrent campaign gets served) — otherwise two
+        # overlapping recoverers at different ballots can both reach quorum
+        srow_rbm = jnp.take_along_axis(s["rbm"], srow_c[..., None], axis=2)[
+            ..., 0
+        ]
+        serve = (best_bal > 0) & (best_bal >= srow_rbm)
         out["rv_row"] = jnp.where(serve, srow, -1)
         out["rv_bal"] = jnp.where(serve, best_bal, 0)
         rv_live = (self._row_slice(s, "st2", srow_c) > NULL) & serve[
@@ -1055,11 +1062,14 @@ class EPaxosKernel(ProtocolKernel):
                 ident[..., None], i_deps, jnp.where(
                     repro[..., None], p_deps, 0))))
 
-        # accept tally for driven instances: responders that already show
-        # ACCEPTING at >= my ERP ballot for this position
+        # accept tally for driven instances: responders ACCEPTING at
+        # exactly my ERP ballot — rec_bal embeds my replica id, so equality
+        # uniquely identifies entries driven by *this* campaign; a >= check
+        # would count a higher-ballot concurrent recoverer's different
+        # value as an ack of mine
         racc = 1 + jnp.sum(
             (align & (rv_st == ACCEPTING)
-             & (rv_vbal >= s["rec_bal"][..., None, None])).astype(jnp.int32),
+             & (rv_vbal == s["rec_bal"][..., None, None])).astype(jnp.int32),
             axis=2,
         )
         promote = act & (phase == ACCEPTING) & (racc >= self.simple_q)
