@@ -1,0 +1,151 @@
+"""Ablation profiler for the MultiPaxos tick: times run_synthetic variants
+to localize the per-tick cost (HBM traffic vs phase compute vs dispatch).
+
+Run on the real chip (leave JAX_PLATFORMS unset):
+    python scripts/profile_tick.py [--ticks N] [--deep]
+
+``--deep`` adds the phase-stub ablations (empty step floor, no accept
+ingest, ...) used for the PERF.md breakdown.
+
+Note: variants that stub prepare-reply work override
+``_gated_prepare_reply`` (not ``_ingest_prepare_reply``) — the production
+kernel wraps the latter in a ``lax.cond`` that never fires in steady
+state, so overriding the inner method would measure nothing.
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from summerset_tpu.core import Engine
+from summerset_tpu.core.protocol import StepEffects
+from summerset_tpu.protocols import make_protocol
+from summerset_tpu.protocols.multipaxos import (
+    MultiPaxosKernel,
+    ReplicaConfigMultiPaxos,
+)
+
+
+def time_engine(eng, ticks, proposals):
+    state, ns = eng.init()
+    # compile the exact (ticks, proposals) variant AND run it once untimed:
+    # the first post-compile call carries one-time overhead on this backend
+    state, ns = eng.run_synthetic(state, ns, ticks, proposals)
+    jax.block_until_ready(state["commit_bar"])
+    state, ns = eng.run_synthetic(state, ns, ticks, proposals)
+    jax.block_until_ready(state["commit_bar"])
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        state, ns = eng.run_synthetic(state, ns, ticks, proposals)
+        jax.block_until_ready(state["commit_bar"])
+        best = min(best, time.perf_counter() - t0)
+    return best / ticks
+
+
+def build(G=4096, R=5, W=64, P=16, kernel_cls=None, **kw):
+    cfg = ReplicaConfigMultiPaxos(
+        max_proposals_per_tick=P, chunk_size=P * 2, **kw
+    )
+    if kernel_cls is None:
+        kernel = make_protocol("multipaxos", G, R, W, cfg)
+    else:
+        kernel = kernel_cls(G, R, W, cfg)
+    return Engine(kernel)
+
+
+class UngatedPrepareReply(MultiPaxosKernel):
+    """Round-1 behavior: adoption tensors materialized every tick."""
+
+    def _gated_prepare_reply(self, s, c):
+        c.candidate = self._candidate_mask(s)
+        self._ingest_prepare_reply(s, c)
+
+
+class NoPrepareReply(MultiPaxosKernel):
+    """Prepare-reply dropped entirely (even during campaigns)."""
+
+    def _gated_prepare_reply(self, s, c):
+        c.candidate = self._candidate_mask(s)
+
+
+class EmptyStep(MultiPaxosKernel):
+    """Floor: state passthrough + zero outbox (scan + netmodel only)."""
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        s["commit_bar"] = s["commit_bar"] + inbox["acc_bal"][:, :, 0] * 0
+        out = self.zero_outbox()
+        fx = StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"],
+            extra={"n_accepted": s["commit_bar"] * 0,
+                   "is_leader": s["commit_bar"] > 0,
+                   "snap_bar": s["exec_bar"]},
+        )
+        return s, out, fx
+
+
+class NoAcceptIngest(MultiPaxosKernel):
+    def _ingest_accept(self, s, c):
+        G, R = self.G, self.R
+        z = jnp.zeros((G, R), jnp.bool_)
+        zi = jnp.zeros((G, R), jnp.int32)
+        c.nack, c.nack_hint = z, zi
+        c.a_ok, c.a_src, c.a_bal = z, zi, zi
+        c.a_new_run, c.a_applied = z, z
+        c.m_acc = jnp.zeros((G, R, self.W), jnp.bool_)
+        c.a_lo, c.a_hi = zi, zi
+
+
+class NoLeaderPropose(MultiPaxosKernel):
+    def _leader_propose(self, s, c):
+        G, R = self.G, self.R
+        i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (
+            s["bal_prepared"] > 0
+        )
+        c.active_leader = i_am_leader & (s["leader"] == c.rid)
+        c.n_new = jnp.zeros((G, R), jnp.int32)
+        c.m_new = jnp.zeros((G, R, self.W), jnp.bool_)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ticks", type=int, default=512)
+    ap.add_argument("--groups", type=int, default=4096)
+    ap.add_argument("--deep", action="store_true")
+    args = ap.parse_args()
+    G, P = args.groups, 16
+    print(f"platform={jax.devices()[0].platform} G={G} P={P}")
+
+    variants = [
+        ("gated baseline W=64", dict()),
+        ("ungated (round-1) prepare-reply", dict(kernel_cls=UngatedPrepareReply)),
+        ("no prepare-reply at all", dict(kernel_cls=NoPrepareReply)),
+        ("W=32", dict(W=32)),
+    ]
+    if args.deep:
+        variants += [
+            ("empty step (scan+net floor)", dict(kernel_cls=EmptyStep)),
+            ("no accept ingest", dict(kernel_cls=NoAcceptIngest)),
+            ("no leader propose", dict(kernel_cls=NoLeaderPropose)),
+            ("G x2", dict(G=G * 2)),
+            ("G /2", dict(G=G // 2)),
+        ]
+    base = None
+    for name, kw in variants:
+        g = kw.pop("G", G)
+        eng = build(G=g, P=P, **kw)
+        per = time_engine(eng, args.ticks, P)
+        rate = g * P / per
+        if base is None:
+            base = per
+        print(
+            f"{name:34s} {per * 1e3:8.3f} ms/tick  "
+            f"{rate / 1e6:7.2f}M slots/s  ({per / base * 100:5.1f}%)"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,7 @@ import dataclasses
 from types import SimpleNamespace
 from typing import Any, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..core.protocol import ProtocolKernel, StepEffects
@@ -231,7 +232,7 @@ class MultiPaxosKernel(ProtocolKernel):
         self._ingest_accept(s, c)
         self._ingest_accept_reply(s, c)
         self._ingest_hb_reply(s, c)
-        self._ingest_prepare_reply(s, c)
+        self._gated_prepare_reply(s, c)
         self._election(s, c)
         self._try_step_up(s, c)
         self._leader_propose(s, c)
@@ -429,13 +430,47 @@ class MultiPaxosKernel(ProtocolKernel):
             s["peer_exec"],
         )
 
+    def _candidate_mask(self, s):
+        """[G, R] bool: replicas mid-campaign (prepare sent, not yet won)."""
+        return (s["bal_prep_sent"] == s["bal_max"]) & (
+            s["bal_prepared"] != s["bal_max"]
+        )
+
+    # -- prepare-reply gate --------------------------------------------------
+    def _gated_prepare_reply(self, s, c):
+        """Run ``_ingest_prepare_reply`` only when some candidate actually
+        received a PREPARE_REPLY this tick.
+
+        The adoption path materializes ``[G, R, R_src, W]`` tensors — ~87%
+        of steady-state tick time at bench shapes — yet is a provable no-op
+        whenever ``pr_mine`` is all-false (tally ORs zero bits, adoption
+        mask is all-false; same for the RSPaxos/Crossword overrides).  A
+        global ``lax.cond`` lets XLA skip it at runtime; campaigns are rare
+        (elections only), so the heavy branch almost never executes.
+
+        Contract for ``_ingest_prepare_reply`` and its hook family: all
+        effects must land in the state dict ``s`` — context attributes set
+        on ``c`` inside the branch are DISCARDED (the branch runs on a
+        throwaway namespace copy so branch-local tracers cannot leak).
+        """
+        c.candidate = self._candidate_mask(s)
+        any_pr = jnp.any(
+            ((c.flags & PREPARE_REPLY) != 0) & c.candidate[..., None]
+        )
+
+        def heavy(sd):
+            cc = SimpleNamespace(**vars(c))
+            sd = dict(sd)
+            self._ingest_prepare_reply(sd, cc)
+            return sd
+
+        s.update(jax.lax.cond(any_pr, heavy, lambda sd: dict(sd), dict(s)))
+
     # -- prepare-reply shared prologue (tally + voted-lane views) ------------
     def _prep_reply_common(self, s, c):
         R, W = self.R, self.W
         inbox = c.inbox
-        candidate = (s["bal_prep_sent"] == s["bal_max"]) & (
-            s["bal_prepared"] != s["bal_max"]
-        )
+        candidate = self._candidate_mask(s)
         pr_valid = (c.flags & PREPARE_REPLY) != 0
         pr_mine = (
             pr_valid
