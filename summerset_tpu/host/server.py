@@ -95,6 +95,10 @@ class ServerReplica:
         self.statemach = StateMachine()
         self.payloads = PayloadStore(self.G)
         self.applied = [0] * self.G        # exec floor per group (own row)
+        self._voted_logged: Dict[int, tuple] = {}   # g -> last logged vote
+        self._logged_vids: Dict[int, set] = {
+            g: set() for g in range(self.G)
+        }
         self.origin: set = set()           # vids proposed by this server
         self.missing: set = set()           # committed vids lacking payloads
         self.kv_need = False
@@ -142,26 +146,126 @@ class ServerReplica:
 
     # -------------------------------------------------------- WAL recovery
     def _recover_from_wal(self) -> None:
-        """Replay committed records: payloads + KV + exec floors
-        (parity: recovery.rs replay loop, SURVEY.md §3.4)."""
+        """Replay the WAL: apply records rebuild payloads + KV + exec
+        floors; the last vote record per group rebuilds the kernel row's
+        acceptor state (parity: recovery.rs replay loop SURVEY.md §3.4 +
+        raft durable curr_term/voted_for, raft/mod.rs:144-176)."""
         off = 0
         n = 0
+        votes: Dict[int, dict] = {}
         while True:
             res = self.wal.do_sync_action(LogAction("read", offset=off))
             if not res.offset_ok or res.entry is None:
                 break
-            g, slot, vid, batch = res.entry
-            self.payloads._data[g][vid] = batch
-            self.payloads._next[g] = max(self.payloads._next[g], vid + 1)
-            if batch is not None:
-                for client, req in batch:
-                    if req.cmd is not None:
-                        apply_command(self.statemach._kv, req.cmd)
-            self.applied[g] = max(self.applied[g], slot + 1)
+            rec = res.entry
+            if isinstance(rec, tuple) and rec and rec[0] == "vote":
+                g, v = rec[1], rec[2]
+                votes[g] = v
+                for vid, batch in v.get("pp", {}).items():
+                    self.payloads._data[g].setdefault(vid, batch)
+                    self.payloads._next[g] = max(
+                        self.payloads._next[g], vid + 1
+                    )
+                    self._logged_vids[g].add(vid)
+            else:
+                g, slot, vid, batch = rec
+                self.payloads._data[g][vid] = batch
+                self.payloads._next[g] = max(self.payloads._next[g], vid + 1)
+                if batch is not None:
+                    for client, req in batch:
+                        if req.cmd is not None:
+                            apply_command(self.statemach._kv, req.cmd)
+                self.applied[g] = max(self.applied[g], slot + 1)
             off = res.end_offset
             n += 1
+        for g, v in votes.items():
+            self._restore_vote_row(g, v)
         if n:
-            pf_info(logger, f"recovered {n} WAL records")
+            pf_info(
+                logger,
+                f"recovered {n} WAL records ({len(votes)} vote rows)",
+            )
+
+    def _restore_vote_row(self, g: int, v: dict) -> None:
+        """Reinstate our acceptor row in the kernel state from a logged
+        vote record — a crash-restarted replica must not forget its
+        promises/votes (double-vote) nor its voted window content."""
+        st = self.state
+        if "vote_bal" not in st:
+            return  # kernel family without the vote-run contract
+        me = self.me
+        i32 = jnp.int32
+        floor = i32(self.applied[g])
+        st["bal_max"] = st["bal_max"].at[g, me].max(i32(v["bal_max"]))
+        st["vote_bal"] = st["vote_bal"].at[g, me].set(i32(v["vote_bal"]))
+        st["vote_from"] = st["vote_from"].at[g, me].set(i32(v["vote_from"]))
+        st["vote_bar"] = st["vote_bar"].at[g, me].max(floor)
+        st["vote_bar"] = st["vote_bar"].at[g, me].max(i32(v["vote_bar"]))
+        st["dur_bar"] = st["dur_bar"].at[g, me].set(
+            jnp.maximum(i32(v["vote_bar"]), floor)
+        )
+        st["commit_bar"] = st["commit_bar"].at[g, me].max(floor)
+        st["exec_bar"] = st["exec_bar"].at[g, me].max(floor)
+        st["win_abs"] = st["win_abs"].at[g, me].set(
+            jnp.asarray(v["win_abs"], i32)
+        )
+        st["win_bal"] = st["win_bal"].at[g, me].set(
+            jnp.asarray(v["win_bal"], i32)
+        )
+        st["win_val"] = st["win_val"].at[g, me].set(
+            jnp.asarray(v["win_val"], i32)
+        )
+
+    def _log_votes(self) -> None:
+        """Durably log acceptor-state changes BEFORE the outbox carrying
+        the corresponding acks is released (next tick's send).
+
+        Parity: the reference appends PrepareBal/AcceptData and fsyncs
+        before a follower sends AcceptReply (durability.rs:85-216) and
+        Raft persists curr_term/voted_for (raft/mod.rs:144-176).  Payload
+        batches for newly voted value ids ride the same record so a
+        crashed-and-recovered quorum can re-serve committed values even if
+        every replica restarts."""
+        st = self.state
+        if "vote_bal" not in st:
+            return
+        me = self.me
+        bal_max = np.asarray(st["bal_max"])[:, me]
+        vote_bal = np.asarray(st["vote_bal"])[:, me]
+        vote_from = np.asarray(st["vote_from"])[:, me]
+        vote_bar = np.asarray(st["vote_bar"])[:, me]
+        win_abs = np.asarray(st["win_abs"])[:, me]
+        win_bal = np.asarray(st["win_bal"])[:, me]
+        win_val = np.asarray(st["win_val"])[:, me]
+        for g in range(self.G):
+            key = (
+                int(bal_max[g]), int(vote_bal[g]), int(vote_from[g]),
+                int(vote_bar[g]), win_abs[g].tobytes(),
+                win_bal[g].tobytes(), win_val[g].tobytes(),
+            )
+            if self._voted_logged.get(g) == key:
+                continue
+            self._voted_logged[g] = key
+            new_pp = {}
+            for vid in set(int(x) for x in win_val[g]):
+                if vid and vid not in self._logged_vids[g]:
+                    b = self.payloads.get(g, vid)
+                    if b is not None:
+                        new_pp[vid] = b
+                        self._logged_vids[g].add(vid)
+            rec = ("vote", g, {
+                "bal_max": int(bal_max[g]),
+                "vote_bal": int(vote_bal[g]),
+                "vote_from": int(vote_from[g]),
+                "vote_bar": int(vote_bar[g]),
+                "win_abs": win_abs[g].tolist(),
+                "win_bal": win_bal[g].tolist(),
+                "win_val": win_val[g].tolist(),
+                "pp": new_pp,
+            })
+            self.wal.do_sync_action(
+                LogAction("append", entry=rec, sync=True)
+            )
 
     # ----------------------------------------------------------- tick I/O
     def _slice_outbox(self, out) -> Dict[int, Dict[str, Any]]:
@@ -180,7 +284,12 @@ class ServerReplica:
         return frames
 
     def _assemble_inbox(self, own_out, peer_frames) -> Dict[str, Any]:
-        """Receiver-oriented inbox: row `me` filled from peers + self."""
+        """Receiver-oriented inbox: row `me` filled from peers + self.
+
+        ``peer_frames`` maps src -> list of frames (oldest..newest) or
+        None; kernel lanes come from the newest frame only — they carry
+        cumulative state, so the latest supersedes (transport docstring).
+        """
         lanes = self.kernel.broadcast_lanes
         zero = self.kernel.zero_outbox()
         inbox = {}
@@ -188,17 +297,17 @@ class ServerReplica:
             arr = np.zeros_like(np.asarray(z))
             if k in lanes:
                 arr[:, self.me] = np.asarray(own_out[k])[:, self.me]
-                for src, f in peer_frames.items():
-                    if f is not None:
-                        arr[:, src] = f["msg"][k]
+                for src, fl in peer_frames.items():
+                    if fl:
+                        arr[:, src] = fl[-1]["msg"][k]
             else:
                 # transposed orientation: [G, dst(me), src]
                 arr[:, self.me, self.me] = np.asarray(own_out[k])[
                     :, self.me, self.me
                 ]
-                for src, f in peer_frames.items():
-                    if f is not None:
-                        arr[:, self.me, src] = f["msg"][k]
+                for src, fl in peer_frames.items():
+                    if fl:
+                        arr[:, self.me, src] = fl[-1]["msg"][k]
             inbox[k] = jnp.asarray(arr)
         return inbox
 
@@ -282,7 +391,9 @@ class ServerReplica:
                 self.state, inbox, inputs
             )
 
-            # 3. apply newly committed slots; reflect leadership
+            # 3. durability before the acks in last_out leave (top of next
+            # iteration); then apply newly committed slots + leadership
+            self._log_votes()
             self._apply_committed(fx)
             self._leader_edges(fx)
             self.tick += 1
@@ -293,25 +404,27 @@ class ServerReplica:
 
     # -------------------------------------------------- payload exchange
     def _ingest_payloads(self, got) -> None:
-        for src, f in got.items():
-            if f is None:
-                continue
-            for vid, batch in f.get("pp", {}).items():
-                if self.payloads.get(0, vid) is None:
-                    self.payloads._data[0][vid] = batch
-                self.missing.discard(vid)
-            # serve peers' missing payloads / kv requests next tick by
-            # folding them into our own piggyback
-            for vid in f.get("need", []):
-                b = self.payloads.get(0, vid)
-                if b is not None:
-                    self._pending_serve[vid] = b
-            if f.get("kv_need") and not self.kv_need:
-                self._pending_kv_serve = True
-            if "kv" in f and self.kv_need:
-                self.statemach._kv.update(f["kv"])
-                self.applied[0] = max(self.applied[0], f["kv_floor"])
-                self.kv_need = False
+        # payload piggybacks are unioned across ALL frames a peer sent
+        # since our last tick (unlike kernel lanes, they are not
+        # cumulative — skipping one could drop a served payload)
+        for src, fl in got.items():
+            for f in fl or ():
+                for vid, batch in f.get("pp", {}).items():
+                    if self.payloads.get(0, vid) is None:
+                        self.payloads._data[0][vid] = batch
+                    self.missing.discard(vid)
+                # serve peers' missing payloads / kv requests next tick by
+                # folding them into our own piggyback
+                for vid in f.get("need", []):
+                    b = self.payloads.get(0, vid)
+                    if b is not None:
+                        self._pending_serve[vid] = b
+                if f.get("kv_need") and not self.kv_need:
+                    self._pending_kv_serve = True
+                if "kv" in f and self.kv_need:
+                    self.statemach._kv.update(f["kv"])
+                    self.applied[0] = max(self.applied[0], f["kv_floor"])
+                    self.kv_need = False
 
     # ------------------------------------------------------- application
     def _apply_committed(self, fx) -> None:
@@ -336,9 +449,11 @@ class ServerReplica:
             if vid != 0 and batch is None:
                 self.missing.add(vid)
                 return  # stall the exec floor until the payload arrives
-            # durability before client-visible effects (storage.rs intent)
+            # durability before client-visible effects (storage.rs intent):
+            # the apply record is fsynced before the reply below, so an
+            # acked write survives machine crash, not just process restart
             self.wal.do_sync_action(LogAction(
-                "append", entry=(g, slot, vid, batch), sync=False
+                "append", entry=(g, slot, vid, batch), sync=True
             ))
             if batch is not None:
                 mine = vid in self.origin

@@ -7,10 +7,21 @@ peers and accepting from higher ids (transport.rs:388-849).
 Lockstep adaptation: a server process owns replica index ``me`` of every
 group.  Each tick it sends one frame per peer carrying (tick number, its
 outbox slices for that destination, an optional payload piggyback) and
-assembles the inbox for tick ``t`` from peers' frames.  A peer frame that
-misses the per-tick deadline is treated as dropped — the kernels' loss
-machinery (go-back-N streams, re-campaigns) recovers, matching the
-netmodel's loss semantics rather than TCP's infinite retry.
+assembles its inbox from the freshest frame available from each peer.
+
+Delivery semantics are deliberately NOT tick-aligned: replica tick
+counters skew freely (jit compile pauses, GIL scheduling, restarts), so
+matching frames by tick number would wedge the mesh the moment counters
+diverge.  Instead ``recv_tick`` waits until the deadline for at least one
+frame per peer and returns every frame that arrived, oldest to newest.
+Consumers take the *kernel* lanes from the newest frame only (they carry
+cumulative state — go-back-N ranges, frontier bars, ballot maxima — so a
+newer frame supersedes an older one exactly like the netmodel delivering
+only the latest broadcast) and union the *payload* piggybacks from all
+frames (payload delivery is request/serve and self-heals via the ``need``
+lists).  A peer with no frame by the deadline is a drop — the kernels'
+loss machinery recovers, matching the netmodel's loss semantics rather
+than TCP's infinite retry.
 """
 
 from __future__ import annotations
@@ -37,9 +48,6 @@ class TransportHub:
         # per-peer receive queues of (tick, payload)
         self._rq: Dict[int, queue.Queue] = {
             p: queue.Queue() for p in range(population) if p != me
-        }
-        self._stash: Dict[int, Dict[int, Any]] = {
-            p: {} for p in range(population) if p != me
         }
         self._listener = socket.create_server(
             p2p_addr, reuse_port=False, backlog=population
@@ -127,36 +135,45 @@ class TransportHub:
 
     def recv_tick(
         self, tick: int, deadline: float
-    ) -> Dict[int, Optional[Any]]:
-        """Collect peers' frames for `tick`, waiting until `deadline`
-        (monotonic seconds).  Missing frames return None (dropped); frames
-        for future ticks are stashed, stale ones discarded."""
+    ) -> Dict[int, Optional[list]]:
+        """Collect peers' queued frames, waiting until ``deadline``
+        (monotonic seconds) for at least one frame from each connected
+        peer.  Returns ``{peer: [frame, ...] oldest-to-newest}`` with
+        ``None`` for peers that produced nothing (drop semantics).  Frame
+        tick tags are ignored — counters skew across processes (see module
+        docstring)."""
         import time
 
-        out: Dict[int, Optional[Any]] = {}
-        for peer, q in self._rq.items():
-            stash = self._stash[peer]
-            if tick in stash:
-                out[peer] = stash.pop(tick)
-                continue
-            got = None
-            while True:
-                budget = deadline - time.monotonic()
-                if budget <= 0:
-                    break
-                try:
-                    t, payload = q.get(timeout=budget)
-                except queue.Empty:
-                    break
-                if t == tick:
-                    got = payload
-                    break
-                if t > tick:
-                    stash[t] = payload
-                    break
-                # t < tick: stale, drop
-            out[peer] = got
-        return out
+        out: Dict[int, Optional[list]] = {p: None for p in self._rq}
+
+        def drain() -> None:
+            for p, q in self._rq.items():
+                while True:
+                    try:
+                        _t, payload = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if out[p] is None:
+                        out[p] = []
+                    out[p].append(payload)
+
+        while True:
+            drain()
+            waiting = [
+                p for p in self._rq
+                if out[p] is None and p in self._conns
+            ]
+            budget = deadline - time.monotonic()
+            if not waiting or budget <= 0:
+                return out
+            # block on one lagging peer's queue, then re-drain all
+            try:
+                _t, payload = self._rq[waiting[0]].get(timeout=budget)
+                if out[waiting[0]] is None:
+                    out[waiting[0]] = []
+                out[waiting[0]].append(payload)
+            except queue.Empty:
+                pass
 
     def close(self) -> None:
         self._listener.close()

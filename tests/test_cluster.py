@@ -137,3 +137,15 @@ class TestClusterMultiPaxos:
             "non_leader_reset",
         ])
         assert all(v == "PASS" for v in results.values()), results
+
+    def test_tester_suite_resets(self, cluster):
+        """The hard crash-restart cases: they pass only because acceptor
+        state (ballots, vote runs, window content + payloads) is WAL-logged
+        before acks leave and rebuilt into the kernel row on restart."""
+        t = ClientTester(cluster.manager_addr, settle=2.5)
+        results = t.run_tests([
+            "leader_node_reset",
+            "two_nodes_reset",
+            "all_nodes_reset",
+        ])
+        assert all(v == "PASS" for v in results.values()), results
