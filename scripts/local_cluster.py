@@ -31,16 +31,25 @@ def protocol_defaults(protocol: str, n: int) -> str:
     return ""
 
 
-def wait_for_line(proc: subprocess.Popen, needle: str, timeout: float) -> bool:
+def wait_for_line(log_path: str, needle: str, timeout: float) -> bool:
+    """Tail a child's log file for a readiness line.  Children log to
+    files, never PIPEs: an undrained pipe wedges the child once its 64KB
+    buffer fills (first observed as replicas freezing after resets)."""
     deadline = time.monotonic() + timeout
+    pos = 0
     while time.monotonic() < deadline:
-        line = proc.stderr.readline()
-        if not line:
-            time.sleep(0.05)
-            continue
-        sys.stderr.write(line)
-        if needle in line:
-            return True
+        try:
+            with open(log_path, "r") as f:
+                f.seek(pos)
+                chunk = f.read()
+                pos = f.tell()
+        except OSError:
+            chunk = ""
+        if chunk:
+            sys.stderr.write(chunk)
+            if needle in chunk:
+                return True
+        time.sleep(0.05)
     return False
 
 
@@ -68,31 +77,35 @@ def main() -> int:
 
     bp = args.base_port
     procs = []
+    logs = {}
 
-    def spawn(mod, *argv):
+    def spawn(name, mod, *argv):
+        log_path = os.path.join(args.backer_dir, f"{name}.log")
         proc = subprocess.Popen(
             [sys.executable, "-m", mod, *argv],
             env=env,
-            stderr=subprocess.PIPE,
-            text=True,
+            stderr=open(log_path, "w", buffering=1),
         )
         procs.append(proc)
-        return proc
+        logs[name] = log_path
+        return log_path
 
-    man = spawn(
+    man_log = spawn(
+        "manager",
         "summerset_tpu.cli.manager",
         "-p", args.protocol,
         "--srv-port", str(bp), "--cli-port", str(bp + 1),
         "-n", str(args.num_replicas),
     )
-    if not wait_for_line(man, "manager up", 15):
+    if not wait_for_line(man_log, "manager up", 15):
         print("manager failed to start", file=sys.stderr)
         return 1
 
     cfg = args.config or protocol_defaults(args.protocol, args.num_replicas)
-    servers = []
+    server_logs = []
     for r in range(args.num_replicas):
-        srv = spawn(
+        server_logs.append(spawn(
+            f"server{r}",
             "summerset_tpu.cli.server",
             "-p", args.protocol,
             "-a", str(bp + 10 + r),
@@ -100,10 +113,9 @@ def main() -> int:
             "-m", f"127.0.0.1:{bp}",
             "--backer-dir", args.backer_dir,
             *(["-c", cfg] if cfg else []),
-        )
-        servers.append(srv)
-    for r, srv in enumerate(servers):
-        if not wait_for_line(srv, "accepting clients", 90):
+        ))
+    for r, slog in enumerate(server_logs):
+        if not wait_for_line(slog, "accepting clients", 90):
             print(f"server {r} failed to start", file=sys.stderr)
             return 1
     print(f"cluster ready: manager @ 127.0.0.1:{bp + 1} "

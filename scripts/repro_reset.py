@@ -1,0 +1,40 @@
+"""Standalone repro for the reset tester cases with full logging."""
+import logging
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+logging.basicConfig(
+    level=logging.INFO,
+    format="%(asctime)s %(name)s %(message)s",
+    stream=sys.stderr,
+)
+
+sys.path.insert(0, "/root/repo/tests")
+import tempfile
+from test_cluster import Cluster
+from summerset_tpu.client.tester import ClientTester
+
+tmp = tempfile.mkdtemp(prefix="repro_reset_")
+t0 = time.time()
+c = Cluster("MultiPaxos", 3, tmp)
+print(f"cluster up in {time.time()-t0:.1f}s", flush=True)
+
+t = ClientTester(c.manager_addr, settle=2.5)
+names = sys.argv[1:] or [
+    "non_leader_reset", "leader_node_reset",
+    "two_nodes_reset", "all_nodes_reset",
+]
+for name in names:
+    t0 = time.time()
+    results = t.run_tests([name])
+    print(f"{name}: {results[name]} ({time.time()-t0:.1f}s)", flush=True)
+    if results[name] != "PASS":
+        for me, rep in sorted(c.replicas.items()):
+            print(f"  replica {me}: {rep.debug_state()}", flush=True)
+c.stop()
+print("done", flush=True)

@@ -71,7 +71,31 @@ class ExternalApi:
     def stop(self) -> None:
         loop = self._loop
         if loop is not None:
-            loop.call_soon_threadsafe(loop.stop)
+            def _teardown() -> None:
+                # close the listener + client conns inside the loop so the
+                # api port is actually released (an in-process restart
+                # rebinds it immediately); servant sockets get an abortive
+                # close — a graceful FIN would park them in FIN_WAIT_2
+                # holding the api port while the client end stays open
+                if self._server is not None:
+                    self._server.close()
+                for w in list(self._writers.values()):
+                    try:
+                        sock = w.get_extra_info("socket")
+                        if sock is not None:
+                            from .transport import hard_close
+
+                            hard_close(sock)
+                        else:
+                            w.close()
+                    except Exception:
+                        pass
+                loop.stop()
+
+            try:
+                loop.call_soon_threadsafe(_teardown)
+            except RuntimeError:
+                pass
         self._thread.join(timeout=5)
 
     # -- event loop side -----------------------------------------------------

@@ -131,9 +131,10 @@ class ServerReplica:
                 msg = None
             if msg is not None and msg.kind == "connect_to_peers":
                 for peer, addr in msg.payload["to_peers"].items():
-                    if int(peer) not in connected:
-                        self.transport.connect_to_peer(int(peer), addr)
-                        connected.add(int(peer))
+                    p = int(peer)
+                    if p not in connected and p not in self.transport._conns:
+                        self.transport.connect_to_peer(p, addr)
+                        connected.add(p)
             try:
                 self.transport.wait_for_group(timeout=2)
                 break
@@ -511,6 +512,25 @@ class ServerReplica:
         elif msg.kind == "leave":
             return False
         return None
+
+    def debug_state(self) -> dict:
+        """One-line snapshot for wedge diagnosis (VERDICT r2 #1)."""
+        st = self.state
+        me = self.me
+        out = {
+            "me": me,
+            "tick": self.tick,
+            "applied": list(self.applied),
+            "kv_need": self.kv_need,
+            "missing": sorted(self.missing),
+            "paused": self.paused,
+            "peers": sorted(self.transport._conns),
+            "was_leader": self.was_leader,
+        }
+        for k in ("leader", "commit_bar", "exec_bar", "vote_bar", "bal_max"):
+            if k in st:
+                out[k] = np.asarray(st[k])[:, me].tolist()
+        return out
 
     def shutdown(self) -> None:
         self.external.stop()

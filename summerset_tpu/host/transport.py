@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import queue
 import socket
+import struct
 import threading
 from typing import Any, Dict, Optional, Tuple
 
@@ -36,6 +37,47 @@ from ..utils.errors import SummersetError
 from ..utils.logging import pf_info, pf_logger, pf_warn
 
 logger = pf_logger("transport")
+
+
+_TCP_STATES = {
+    1: "ESTABLISHED", 4: "FIN_WAIT1", 5: "FIN_WAIT2", 6: "TIME_WAIT",
+    7: "CLOSE", 8: "CLOSE_WAIT", 9: "LAST_ACK", 10: "LISTEN", 11: "CLOSING",
+}
+
+
+def _port_holders(port: int) -> list:
+    """Diagnostic: enumerate /proc/net/tcp entries touching ``port``."""
+    out = []
+    try:
+        for line in open("/proc/net/tcp").readlines()[1:]:
+            f = line.split()
+            lport = int(f[1].split(":")[1], 16)
+            rport = int(f[2].split(":")[1], 16)
+            if port in (lport, rport):
+                st = int(f[3], 16)
+                out.append((lport, rport, _TCP_STATES.get(st, st)))
+    except OSError:
+        pass
+    return out
+
+
+def hard_close(sock: socket.socket) -> None:
+    """Abortive close (SO_LINGER 0 -> RST): releases the local port
+    immediately instead of parking in FIN_WAIT/TIME_WAIT.  Correct for
+    the tick mesh — frames are idempotent cumulative snapshots with drop
+    semantics, so losing in-flight bytes at teardown is indistinguishable
+    from a drop — and required for crash-restart rebinds: a graceful
+    close would hold the p2p/api port until the far end also closes."""
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 class TransportHub:
@@ -49,9 +91,29 @@ class TransportHub:
         self._rq: Dict[int, queue.Queue] = {
             p: queue.Queue() for p in range(population) if p != me
         }
-        self._listener = socket.create_server(
-            p2p_addr, reuse_port=False, backlog=population
-        )
+        self._listener = None
+        deadline = None
+        while True:
+            try:
+                self._listener = socket.create_server(
+                    p2p_addr, reuse_port=False, backlog=population
+                )
+                break
+            except OSError:
+                # transient rebind race after a crash-restart: a peer may
+                # not yet have reaped its half of an old accepted conn
+                import time
+
+                if deadline is None:
+                    deadline = time.monotonic() + 10.0
+                elif time.monotonic() > deadline:
+                    pf_warn(
+                        logger,
+                        f"bind {p2p_addr} failed; holders: "
+                        f"{_port_holders(p2p_addr[1])}",
+                    )
+                    raise
+                time.sleep(0.1)
         self._accept_thread = threading.Thread(
             target=self._acceptor, daemon=True
         )
@@ -90,6 +152,12 @@ class TransportHub:
         pf_info(logger, f"p2p mesh complete ({self.population} replicas)")
 
     def _register(self, peer: int, sock: socket.socket) -> None:
+        # close a replaced connection: an accepted socket shares the
+        # listener's local port, so leaking it would hold the port past
+        # shutdown and wedge an in-process crash-restart on rebind
+        old = self._conns.get(peer)
+        if old is not None and old is not sock:
+            hard_close(old)
         self._conns[peer] = sock
         self._wlocks[peer] = threading.Lock()
         t = threading.Thread(
@@ -119,6 +187,7 @@ class TransportHub:
             pf_warn(logger, f"peer {peer} connection lost")
             if self._conns.get(peer) is sock:
                 del self._conns[peer]
+            hard_close(sock)
 
     # ------------------------------------------------------------ tick I/O
     def send_tick(self, tick: int, per_peer: Dict[int, Any]) -> None:
@@ -131,7 +200,9 @@ class TransportHub:
                 with self._wlocks[peer]:
                     safetcp.send_msg_sync(sock, (tick, payload))
             except OSError:
-                self._conns.pop(peer, None)
+                if self._conns.get(peer) is sock:
+                    self._conns.pop(peer, None)
+                hard_close(sock)
 
     def recv_tick(
         self, tick: int, deadline: float
@@ -176,9 +247,14 @@ class TransportHub:
                 pass
 
     def close(self) -> None:
+        # shutdown() first: close() alone does not free the kernel socket
+        # while the acceptor thread sits in accept() (the in-flight syscall
+        # pins it in LISTEN, blocking a crash-restart rebind); shutdown
+        # forces the blocked accept() to return
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         self._listener.close()
         for sock in list(self._conns.values()):
-            try:
-                sock.close()
-            except OSError:
-                pass
+            hard_close(sock)

@@ -50,6 +50,7 @@ class ClusterManager:
         self._next_sid = 0
         self._next_cid = 1000
         self._pending_replies: Dict[str, asyncio.Queue] = {}
+        self._join_event = asyncio.Event()
 
     # ------------------------------------------------------- server plane
     async def _serve_server(self, reader, writer) -> None:
@@ -80,6 +81,10 @@ class ClusterManager:
             pf_warn(logger, f"server {sid} connection lost")
         finally:
             writer.close()
+            # free the id once this connection is truly gone so a
+            # restarted server can reclaim it (clusman.rs assigned_ids)
+            if self.servers.get(sid) is conn:
+                del self.servers[sid]
 
     async def _handle_ctrl(self, conn: _ServerConn, msg: CtrlMsg) -> None:
         p = msg.payload
@@ -87,10 +92,15 @@ class ClusterManager:
             conn.api_addr = p["api_addr"]
             conn.p2p_addr = p["p2p_addr"]
             conn.joined = True
+            self._join_event.set()
+            # the joiner proactively connects to ALL existing known peers
+            # (clusman.rs:209-233) — a restarted low-id server must rebuild
+            # its links itself, since live higher-id peers never re-dial
             to_peers = {
                 s.sid: s.p2p_addr
                 for s in self.servers.values()
-                if s.joined and s.sid < conn.sid
+                if s.joined and s.sid != conn.sid
+                and not s.writer.is_closing()
             }
             await safetcp.send_msg(
                 conn.writer,
@@ -173,6 +183,63 @@ class ClusterManager:
             self._pending_replies.pop(reply_kind, None)
         return CtrlReply(kind, done=done)
 
+    async def _reset_servers(self, req: CtrlRequest) -> CtrlReply:
+        """Reset targets ONE AT A TIME, each step waiting for the old
+        connection's reply, freeing its id, and waiting for the restarted
+        server to re-join before touching the next — concurrent restarts
+        would otherwise race id reclamation and mesh rebuild (parity:
+        clusman.rs:382-438 pops targets one by one with an id re-assign
+        wait + settle sleep in between)."""
+        targets = sorted(s.sid for s in self._targets(req))
+        done = []
+        for sid in targets:
+            conn = self.servers.get(sid)
+            if conn is None or conn.writer.is_closing():
+                continue
+            q: asyncio.Queue = asyncio.Queue()
+            self._pending_replies["reset_reply"] = q
+            try:
+                await safetcp.send_msg(
+                    conn.writer,
+                    CtrlMsg("reset_state", {"durable": req.durable}),
+                )
+                while True:  # drain until THIS sid acks
+                    got = await asyncio.wait_for(q.get(), timeout=30.0)
+                    if got == sid:
+                        break
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                pf_warn(logger, f"reset: no ack from server {sid}")
+                continue
+            finally:
+                self._pending_replies.pop("reset_reply", None)
+            # free the id; the restarting server reclaims it (it is the
+            # only one connecting right now), then wait for its re-join
+            if self.servers.get(sid) is conn:
+                del self.servers[sid]
+            rejoin_deadline = (
+                asyncio.get_event_loop().time() + 120.0
+            )
+            while True:
+                c = self.servers.get(sid)
+                if c is not None and c.joined and c is not conn:
+                    break
+                self._join_event.clear()
+                budget = rejoin_deadline - asyncio.get_event_loop().time()
+                if budget <= 0:
+                    pf_warn(logger, f"reset: server {sid} never rejoined")
+                    break
+                try:
+                    await asyncio.wait_for(
+                        self._join_event.wait(), timeout=budget
+                    )
+                except asyncio.TimeoutError:
+                    pass
+            # settle so the rejoined server's transport mesh completes
+            # before the next victim goes down (clusman.rs 500ms sleep)
+            await asyncio.sleep(0.5)
+            done.append(sid)
+        return CtrlReply("reset_state", done=done)
+
     async def _handle_request(self, req: CtrlRequest) -> CtrlReply:
         if req.kind == "query_info":
             return CtrlReply(
@@ -192,10 +259,7 @@ class ClusterManager:
         if req.kind == "resume_servers":
             return await self._fanout_wait("resume", "resume_reply", req)
         if req.kind == "reset_servers":
-            return await self._fanout_wait(
-                "reset_state", "reset_reply", req,
-                {"durable": req.durable},
-            )
+            return await self._reset_servers(req)
         if req.kind == "take_snapshot":
             return await self._fanout_wait(
                 "take_snapshot", "snapshot_reply", req
