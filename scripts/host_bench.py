@@ -1,0 +1,106 @@
+"""Measured host-path throughput: a real manager + N replica event loops
+over localhost TCP sockets, G consensus groups served end-to-end, driven
+by open-loop ClientBench clients (VERDICT r3 #5: publish a real-socket
+ops/sec number; parity: summerset_client/src/clients/bench.rs:44-130).
+
+Writes HOSTBENCH.json at the repo root:
+  {"protocol", "groups", "clients", "tput", "lat_p50_ms", "lat_p99_ms"}
+
+Usage: python scripts/host_bench.py [--protocol MultiPaxos] [--groups 16]
+       [--clients 4] [--secs 10] [--tick 0.002]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--protocol", default="MultiPaxos")
+    ap.add_argument("--groups", type=int, default=16)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--secs", type=float, default=10.0)
+    ap.add_argument("--tick", type=float, default=0.002)
+    ap.add_argument("--num-keys", type=int, default=64)
+    ap.add_argument("--value-size", default="64")
+    ap.add_argument("--put-ratio", type=float, default=0.5)
+    ap.add_argument("--out", default=os.path.join(REPO, "HOSTBENCH.json"))
+    args = ap.parse_args()
+
+    from test_cluster import Cluster  # reuses the in-process harness
+    from summerset_tpu.client.bench import ClientBench
+    from summerset_tpu.client.endpoint import GenericEndpoint
+
+    tmp = tempfile.mkdtemp(prefix="host_bench_")
+    t0 = time.time()
+    cluster = Cluster(
+        args.protocol, args.replicas, tmp,
+        tick=args.tick, num_groups=args.groups,
+    )
+    print(f"cluster up in {time.time() - t0:.1f}s "
+          f"({args.replicas} replicas x {args.groups} groups)", flush=True)
+
+    results = [None] * args.clients
+
+    def one_client(i: int) -> None:
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        bench = ClientBench(
+            ep,
+            secs=args.secs,
+            put_ratio=args.put_ratio,
+            value_size=args.value_size,
+            num_keys=args.num_keys,
+            interval=1e9,  # suppress per-interval prints
+            seed=i,
+        )
+        results[i] = bench.run()
+        ep.leave()
+
+    threads = [
+        threading.Thread(target=one_client, args=(i,), daemon=True)
+        for i in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.secs + 60)
+
+    done = [r for r in results if r]
+    tput = sum(r["tput"] for r in done)
+    p50 = max(r["lat_p50_ms"] for r in done) if done else 0.0
+    p99 = max(r["lat_p99_ms"] for r in done) if done else 0.0
+    out = {
+        "protocol": args.protocol,
+        "groups": args.groups,
+        "replicas": args.replicas,
+        "clients": len(done),
+        "secs": args.secs,
+        "tput": round(tput, 2),
+        "lat_p50_ms": round(p50, 3),
+        "lat_p99_ms": round(p99, 3),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    cluster.stop()
+
+
+if __name__ == "__main__":
+    main()

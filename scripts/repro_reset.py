@@ -1,9 +1,11 @@
 """Standalone repro for the reset tester cases with full logging."""
 import logging
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
@@ -14,7 +16,7 @@ logging.basicConfig(
     stream=sys.stderr,
 )
 
-sys.path.insert(0, "/root/repo/tests")
+sys.path.insert(0, os.path.join(REPO, "tests"))
 import tempfile
 from test_cluster import Cluster
 from summerset_tpu.client.tester import ClientTester

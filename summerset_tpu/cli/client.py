@@ -30,6 +30,8 @@ def main(argv=None) -> None:
     ap.add_argument("--pause", default=None)
     ap.add_argument("--resume", default=None)
     ap.add_argument("--write", default=None)  # key=value
+    ap.add_argument("--responders", default=None)  # comma ids (conf)
+    ap.add_argument("--leader", type=int, default=None)  # conf leader
     args = ap.parse_args(argv)
 
     logger_init()
@@ -71,6 +73,8 @@ def main(argv=None) -> None:
             pause=parse_ids(args.pause),
             resume=parse_ids(args.resume),
             write=write,
+            responders=parse_ids(args.responders),
+            leader=args.leader,
         )
 
 

@@ -17,18 +17,22 @@ from typing import List, Optional, Tuple
 
 from ..host.statemach import Command
 from ..utils.logging import pf_info, pf_logger
-from .drivers import DriverOpenLoop
+from .drivers import DriverClosedLoop, DriverOpenLoop
 from .endpoint import GenericEndpoint
 
 logger = pf_logger("bench")
 
 
 def parse_value_schedule(spec: str) -> List[Tuple[float, int]]:
-    """"t1:v1/t2:v2" -> [(t_from, size)] (bench.rs value-size schedule)."""
+    """"t1:v1/t2:v2" -> [(t_from, size)]; a bare "128" means a constant
+    size from t=0 (bench.rs value-size schedule)."""
     out = []
-    for seg in spec.split("/"):
-        t, v = seg.split(":")
-        out.append((float(t), int(v)))
+    for seg in str(spec).split("/"):
+        if ":" in seg:
+            t, v = seg.split(":")
+            out.append((float(t), int(v)))
+        else:
+            out.append((0.0, int(seg)))
     return sorted(out)
 
 
@@ -81,12 +85,14 @@ class ClientBench:
         return Command("get", key)
 
     def run(self) -> dict:
-        drv = DriverOpenLoop(self.ep)
-        # preload every key once (bench.rs preloading)
+        # preload every key once (bench.rs preloading) with the
+        # closed-loop driver: it follows redirects/timeouts, where an
+        # open-loop pipeline would strand its inflight window on the
+        # first redirect reconnect
+        pre = DriverClosedLoop(self.ep)
         for k in self.keys:
-            drv.issue(Command("put", k, self._value(0.0)))
-        for _ in self.keys:
-            drv.wait_reply(timeout=10)
+            pre.checked_put(k, self._value(0.0))
+        drv = DriverOpenLoop(self.ep)
 
         t_start = time.monotonic()
         issued = acked = 0

@@ -23,6 +23,7 @@ class DriverReply:
     latency: float = 0.0          # seconds (success)
     result: Optional[CommandResult] = None
     redirect: Optional[int] = None
+    local: bool = False           # served as a leased local read
 
 
 class DriverClosedLoop:
@@ -35,7 +36,10 @@ class DriverClosedLoop:
         rid = self.next_req
         self.next_req += 1
         t0 = time.monotonic()
-        self.ep.send_req(rid, cmd)
+        try:
+            self.ep.send_req(rid, cmd)
+        except Exception:
+            return DriverReply("failure")
         deadline = t0 + self.timeout
         while True:
             budget = deadline - time.monotonic()
@@ -48,22 +52,100 @@ class DriverClosedLoop:
             if rep.req_id != rid:
                 continue  # stale reply from a previous timeout
             if rep.kind == "redirect":
-                if rep.redirect is not None and rep.redirect >= 0:
-                    self.ep.reconnect(rep.redirect)
-                else:
-                    self.ep.reconnect()
+                hint = rep.redirect
+                try:
+                    if (
+                        hint is not None and hint >= 0
+                        and hint != self.ep.current
+                    ):
+                        self.ep.reconnect(hint)
+                    else:
+                        # no hint, or the server pointed at itself
+                        # (leadership unsettled): walk the membership
+                        self.ep.rotate()
+                except Exception:
+                    pass  # hinted server down: the next retry rotates
                 return DriverReply("redirect", redirect=rep.redirect)
-            return DriverReply(
-                "success",
-                latency=time.monotonic() - t0,
-                result=rep.result,
-            )
+            if rep.kind in ("reply", "conf") and rep.success:
+                return DriverReply(
+                    "success",
+                    latency=time.monotonic() - t0,
+                    result=rep.result,
+                    local=rep.local,
+                )
+            return DriverReply("failure")
 
     def get(self, key: str) -> DriverReply:
         return self._issue(Command("get", key))
 
     def put(self, key: str, value: str) -> DriverReply:
         return self._issue(Command("put", key, value))
+
+    def conf_change(self, conf_delta: dict, retries: int = 20
+                    ) -> DriverReply:
+        """Drive a ConfChange to completion through redirects/timeouts
+        (parity: the reference mess/tester clients' conf flow,
+        clients/mess.rs:16-45)."""
+        for _ in range(retries):
+            rid = self.next_req
+            self.next_req += 1
+            t0 = time.monotonic()
+            try:
+                self.ep.send_conf(rid, conf_delta)
+            except Exception:
+                self._failover(DriverReply("failure"))
+                time.sleep(0.1)
+                continue
+            deadline = t0 + max(self.timeout, 15.0)  # conf rides the log
+            rep = None
+            while True:
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    rep = DriverReply("timeout")
+                    break
+                try:
+                    raw = self.ep.recv_reply(timeout=budget)
+                except Exception:
+                    rep = DriverReply("failure")
+                    break
+                if raw.req_id != rid:
+                    continue
+                if raw.kind == "redirect":
+                    hint = raw.redirect
+                    try:
+                        if (
+                            hint is not None and hint >= 0
+                            and hint != self.ep.current
+                        ):
+                            self.ep.reconnect(hint)
+                        else:
+                            self.ep.rotate()
+                    except Exception:
+                        pass
+                    rep = DriverReply("redirect", redirect=hint)
+                    break
+                rep = (
+                    DriverReply("success",
+                                latency=time.monotonic() - t0)
+                    if raw.success else DriverReply("failure")
+                )
+                break
+            if rep.kind == "success":
+                return rep
+            self._failover(rep)
+            time.sleep(0.1)
+        raise AssertionError("conf_change failed after retries")
+
+    def _failover(self, rep: DriverReply) -> None:
+        """Stop retrying against a dead/paused server: a timeout or a
+        connection failure rotates the endpoint to a different server
+        (parity: tester.rs:429-433 leave+reconnect around faults; the
+        redirect case already reconnected inside ``_issue``)."""
+        if rep.kind in ("timeout", "failure"):
+            try:
+                self.ep.rotate()
+            except Exception:
+                pass
 
     def checked_put(self, key: str, value: str, retries: int = 20):
         """Retry through redirects/timeouts until acked (tester helper,
@@ -72,6 +154,7 @@ class DriverClosedLoop:
             rep = self.put(key, value)
             if rep.kind == "success":
                 return rep
+            self._failover(rep)
             time.sleep(0.1)
         raise AssertionError(f"checked_put({key}) failed after retries")
 
@@ -83,6 +166,7 @@ class DriverClosedLoop:
                 got = rep.result.value if rep.result else None
                 assert got == expect, f"get({key}) = {got} != {expect}"
                 return rep
+            self._failover(rep)
             time.sleep(0.1)
         raise AssertionError(f"checked_get({key}) failed after retries")
 

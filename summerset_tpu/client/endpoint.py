@@ -90,6 +90,7 @@ class GenericEndpoint:
     def _connect_to(self, sid: int) -> None:
         if self.api is not None:
             self.api.close()
+            self.api = None
         api_addr, _ = self.servers[sid]
         self.api = ClientApiStub(self.id, api_addr)
         self.current = sid
@@ -100,9 +101,56 @@ class GenericEndpoint:
         else:
             self.connect()
 
+    def rotate(self, avoid: Optional[int] = None) -> None:
+        """Fail over to a different server after a timeout.
+
+        Parity: the reference tester leaves + reconnects around faults
+        (tester.rs:429-433) and the endpoint re-queries the manager
+        (endpoint.rs:17-54).  Prefers the manager's current leader unless
+        that is the server being avoided (e.g. it just got paused and the
+        manager has not seen the new leader yet), else round-robins to the
+        next id so repeated timeouts walk the whole membership."""
+        leader = None
+        try:
+            info = self.ctrl.request(CtrlRequest("query_info"), timeout=5)
+            if info.servers:
+                self.servers = info.servers
+            leader = info.leader
+        except Exception:
+            pass
+        if not self.servers:
+            return
+        if avoid is None:
+            avoid = self.current
+        cands = sorted(self.servers)
+        order = []
+        if leader is not None and leader in self.servers and leader != avoid:
+            order.append(leader)
+        start = cands.index(avoid) if avoid in cands else -1
+        for off in range(1, len(cands) + 1):
+            cand = cands[(start + off) % len(cands)]
+            if cand != avoid and cand not in order:
+                order.append(cand)
+        if avoid in cands:
+            order.append(avoid)  # last resort: everything else unreachable
+        for cand in order:
+            try:
+                self._connect_to(cand)
+                return
+            except OSError:
+                continue
+
     def send_req(self, req_id: int, cmd: Command) -> None:
         assert self.api is not None, "connect() first"
         self.api.send_req(ApiRequest("req", req_id=req_id, cmd=cmd))
+
+    def send_conf(self, req_id: int, conf_delta: dict) -> None:
+        """Issue a ConfChange (parity: ApiRequest::Conf,
+        external.rs:106-121)."""
+        assert self.api is not None, "connect() first"
+        self.api.send_req(
+            ApiRequest("conf", req_id=req_id, conf_delta=conf_delta)
+        )
 
     def recv_reply(self, timeout: Optional[float] = None) -> ApiReply:
         assert self.api is not None

@@ -66,6 +66,8 @@ class ClientMess:
         pause: Optional[List[int]] = None,
         resume: Optional[List[int]] = None,
         write: Optional[Tuple[str, str]] = None,
+        responders: Optional[List[int]] = None,
+        leader: Optional[int] = None,
     ) -> None:
         ep = GenericEndpoint(self.manager_addr)
         if pause is not None:
@@ -76,7 +78,18 @@ class ClientMess:
             ep.ctrl.request(
                 CtrlRequest("resume_servers", servers=resume or None)
             )
-        if write is not None:
+        if responders is not None or leader is not None:
+            # responders-conf change through the data plane (mess.rs
+            # conf perturbations -> ConfChange)
             ep.connect()
+            delta = {}
+            if responders is not None:
+                delta["responders"] = responders
+            if leader is not None:
+                delta["leader"] = leader
+            DriverClosedLoop(ep).conf_change(delta)
+        if write is not None:
+            if ep.api is None:
+                ep.connect()
             DriverClosedLoop(ep).checked_put(write[0], write[1])
         ep.leave()

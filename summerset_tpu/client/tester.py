@@ -155,10 +155,18 @@ class ClientTester:
         ep.leave()
 
     def node_pause_resume(self):
+        """Pause the *current leader* (whoever inherited leadership from
+        earlier churn), write through the survivors, resume, write again.
+        The victim is the queried leader — not a fixed id — and the client
+        rotates off it on timeout (tester.rs:429-433 reconnects around
+        every fault for the same reason)."""
         ep, drv = self._fresh()
         drv.checked_put("job", "kv_store")
-        victim = sorted(ep.servers)[-1]
+        victim = self._leader(ep)
+        if victim is None:
+            victim = sorted(ep.servers)[-1]
         self._pause(ep, [victim])
+        ep.rotate(avoid=victim)
         drv.checked_put("job", "kv_store_2")
         self._resume(ep, [victim])
         drv.checked_put("job", "kv_store_3")

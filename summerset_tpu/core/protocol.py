@@ -60,6 +60,27 @@ class ProtocolKernel:
     # outbox leaves that are [G, R_src, W] broadcast lanes (not per-pair)
     broadcast_lanes: FrozenSet[str] = frozenset()
 
+    # -- durable acceptor contract ------------------------------------------
+    # State arrays forming this kernel's per-replica durable acceptor
+    # record: the host WAL-logs row [g, me] of each named array before the
+    # acks referencing it leave the process, and feeds the last logged
+    # record per group back through ``restore_durable`` on crash-restart.
+    # ``None`` (the default) means the kernel declares NO durable contract
+    # — the host REFUSES to serve it rather than silently running without
+    # durability (the reference persists acceptor state for every served
+    # protocol: multipaxos durability.rs:85-216, raft/mod.rs:144-176).
+    DURABLE_SCALARS = None   # tuple[str] of [G, R] arrays
+    DURABLE_WINDOWS = None   # tuple[str] of [G, R, W] arrays
+    VALUE_WINDOW = "win_val"  # the window lane holding payload value ids
+
+    def restore_durable(self, st, g: int, me: int, rec: dict, floor: int):
+        """Reinstate acceptor row ``(g, me)`` from the last logged durable
+        record ``rec`` ({field: int | list}), given the host applier's
+        recovered exec floor.  Mutates ``st`` in place."""
+        raise NotImplementedError(
+            f"{type(self).__name__} declares no durable-restore contract"
+        )
+
     def __init__(self, num_groups: int, population: int, window: int):
         if population < 1 or population > 32:
             raise ValueError("population must be in [1, 32] (uint32 bitmap lanes)")

@@ -24,9 +24,32 @@ logger = pf_logger("control")
 
 class ControlHub:
     def __init__(self, manager_addr: Tuple[str, int], timeout: float = 15.0):
-        self.sock = socket.create_connection(manager_addr, timeout=timeout)
-        self.sock.settimeout(None)
-        me_id, population = safetcp.recv_msg_sync(self.sock)
+        # handshake with retry: during a crash-restart the manager may not
+        # have reaped our old connection yet, in which case it finds no
+        # free id and closes the fresh connection — retry until it does
+        # (reference servers retry manager connects too, control.rs:43)
+        import time
+
+        deadline = time.monotonic() + 60.0
+        self.sock = None
+        while True:
+            try:
+                self.sock = socket.create_connection(
+                    manager_addr, timeout=timeout
+                )
+                self.sock.settimeout(timeout)
+                me_id, population = safetcp.recv_msg_sync(self.sock)
+                self.sock.settimeout(None)
+                break
+            except (OSError, EOFError, SummersetError):
+                if self.sock is not None:
+                    try:
+                        self.sock.close()
+                    except OSError:
+                        pass
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
         self.me: int = int(me_id)
         self.population: int = int(population)
         set_me(str(self.me))

@@ -101,7 +101,8 @@ class ExternalApi:
     # -- event loop side -----------------------------------------------------
     async def _send(self, client: int, reply: ApiReply) -> None:
         w = self._writers.get(client)
-        if w is None:
+        if w is None or w.is_closing():
+            self._writers.pop(client, None)
             return
         try:
             await safetcp.send_msg(w, reply)

@@ -30,12 +30,13 @@ class ApiRequest:
 class ApiReply:
     """Server -> client (parity: ``ApiReply``, external.rs:155-183)."""
 
-    kind: str                      # "reply" | "conf" | "redirect" | "leave"
+    kind: str   # "reply" | "conf" | "redirect" | "error" | "leave"
     req_id: int = 0
     result: Optional[CommandResult] = None
     redirect: Optional[int] = None  # hinted leader id
     success: bool = True
     rq_retry: bool = False          # read-query retry hint
+    local: bool = False             # served as a leased local read
 
 
 # ------------------------------------------------------------ control plane

@@ -7,31 +7,48 @@ StorageHub -> TransportHub -> ExternalApi, joins via the manager, then
 (``summerset_server/src/main.rs:127-160``).
 
 TPU-native split: this process owns replica index ``me`` of every group.
-Each tick it (1) drains the client batch, (2) steps the vectorized kernel
-with the inbox assembled from peers' TCP frames, (3) sends its outbox
-slice + payload piggybacks, (4) WAL-logs newly committed slots, applies
-them to the KV store, and replies to clients it originated.  Consensus
-messages ride the device outbox; request payloads ride host frames keyed
-by value id (the device log stores int32 references only — SURVEY.md §7
-hard part (b)).
+Each tick it (1) drains the client batch — requests are routed to groups
+by key hash (the multi-group axis is the design's headline: thousands of
+consensus groups step in one kernel launch), (2) steps the vectorized
+kernel with the inbox assembled from peers' TCP frames, (3) sends its
+outbox slice + payload piggybacks, (4) WAL-logs dirty acceptor rows
+*before* the acks referencing them leave, applies newly committed slots,
+and replies to clients it originated.  Consensus messages ride the device
+outbox; request payloads ride host frames keyed by (group, value id) —
+the device log stores int32 references only (SURVEY.md §7 hard part (b)).
 
 Leadership, failover, leases, and commit tallies all happen inside the
-kernel; this loop only reflects ``is_leader`` edges to the manager and
-redirects clients when not serving.
+kernel; this loop reflects ``is_leader`` edges to the manager, redirects
+clients when not serving, serves **leased local reads** when the kernel
+says the replica may (quorum_leases/quorumlease.rs:10-17 is_local_reader,
+bodega/localread.rs:8-26), and drives client ``ConfChange`` requests
+through the kernel's conf plane (external.rs:106-121 -> quorumconf.rs).
+
+Durability contract: each kernel declares ``DURABLE_SCALARS`` /
+``DURABLE_WINDOWS`` (core/protocol.py); kernels without a declared
+contract are refused loudly — never served without durability.
+Snapshots: ``take_snapshot`` writes the full KV + applied floors and
+compacts the WAL down to one acceptor record per group (parity:
+multipaxos/snapshot.rs:121-303 take_new_snapshot + snapshot_discard_log);
+startup loads the snapshot before WAL replay (snapshot.rs:189).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
+import pickle
 import time
-from typing import Any, Dict, Optional, Tuple
+import zlib
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
 import jax.numpy as jnp
 
 from ..protocols import make_protocol
+from ..utils.errors import SummersetError
 from ..utils.logging import pf_info, pf_logger, pf_warn
 from .control import ControlHub
 from .external import ExternalApi
@@ -40,8 +57,18 @@ from .payload import PayloadStore
 from .statemach import StateMachine, apply_command
 from .storage import LogAction, StorageHub
 from .transport import TransportHub
+from ..utils.stopwatch import Stopwatch
 
 logger = pf_logger("server")
+
+
+@functools.lru_cache(maxsize=64)
+def _shared_step(kernel):
+    """One jitted step per (kernel class, geometry, config): kernels are
+    hashable by static key, so a crash-restarted replica reuses the
+    already-compiled executable instead of re-tracing — restarts come
+    back in milliseconds, which the reset/election tests depend on."""
+    return jax.jit(kernel.step)
 
 
 class ServerReplica:
@@ -64,15 +91,18 @@ class ServerReplica:
         self.tick_interval = tick_interval
         self.G = num_groups
         self.window = window
+        # host-side knobs (not kernel config fields)
+        self.snapshot_interval = int(cfg.pop("snapshot_interval", 0))
+        self.record_breakdown = bool(cfg.pop("record_breakdown", False))
+        self._stopwatch = Stopwatch() if self.record_breakdown else None
+        self._bd_last_print = time.monotonic()
 
         # control plane first: the manager assigns our id (control.rs:43)
         self.ctrl = ControlHub(manager_addr)
         self.me = self.ctrl.me
         self.population = self.ctrl.population
 
-        # protocol kernel over [G, R]; host applier drives the exec bar.
-        # Supported here: the MultiPaxos-family kernels sharing the
-        # (n_proposals, value_base, exec_floor) input contract.
+        # protocol kernel over [G, R]; host applier drives the exec bar
         kercfg_cls = type(
             make_protocol(protocol, 1, self.population, 64).config
         )
@@ -81,34 +111,62 @@ class ServerReplica:
         if hasattr(kcfg, "exec_follows_commit"):
             kcfg.exec_follows_commit = False
         if hasattr(kcfg, "max_proposals_per_tick"):
-            kcfg.max_proposals_per_tick = 1  # one ReqBatch per tick
+            kcfg.max_proposals_per_tick = 1  # one ReqBatch per group/tick
         self.kernel = make_protocol(
             protocol, self.G, self.population, window, kcfg
         )
+        if self.kernel.DURABLE_SCALARS is None:
+            raise SummersetError(
+                f"protocol {protocol} declares no durable acceptor "
+                "contract; refusing to serve it without durability "
+                "(see ProtocolKernel.DURABLE_SCALARS)"
+            )
         self.state = self.kernel.init_state(seed=0)
-        self._step = jax.jit(self.kernel.step)
+        self._step = _shared_step(self.kernel)
 
         os.makedirs(backer_dir, exist_ok=True)
         self.wal_path = os.path.join(backer_dir, f"r{self.me}.wal")
+        self.snap_path = os.path.join(backer_dir, f"r{self.me}.snap")
         self.wal = StorageHub(self.wal_path)
-        self.snapdir = os.path.join(backer_dir, f"r{self.me}.snap")
         self.statemach = StateMachine()
         self.payloads = PayloadStore(self.G)
         self.applied = [0] * self.G        # exec floor per group (own row)
-        self._voted_logged: Dict[int, tuple] = {}   # g -> last logged vote
+        self._sig: Optional[np.ndarray] = None  # durable-row dirty cache
         self._logged_vids: Dict[int, set] = {
             g: set() for g in range(self.G)
         }
-        self.origin: set = set()           # vids proposed by this server
-        self.missing: set = set()           # committed vids lacking payloads
-        self.kv_need = False
+        self.origin: Set[Tuple[int, int]] = set()   # (g, vid) we proposed
+        self.missing: Set[Tuple[int, int]] = set()  # committed, no payload
+        self.kv_need: Set[int] = set()     # groups that jumped past window
         self.paused = False
         self.stopping = False  # cooperative stop for embedded harnesses
         self.was_leader = False
+        self._is_leader = np.zeros(self.G, bool)
+        self._leader_hint = np.full(self.G, -1, np.int64)
+        self._last_extra: Dict[str, np.ndarray] = {}
         self.tick = 0
-        self._pending_serve: Dict[int, Any] = {}  # peers' payload requests
+        self._snap_last = 0           # sum(applied) at last auto-snapshot
+        self._pending_serve: Dict[Tuple[int, int], Any] = {}
         self._pending_kv_serve = False
+        # client ConfChange plane (external.rs:106-121): one in flight
+        self._conf_kind = (
+            "ql" if "ql_out" in self.state
+            else "bodega" if "conf_resp" in self.state
+            else None
+        )
+        self._conf_active: Optional[dict] = None
+        self._conf_queue: List[Tuple[int, ApiRequest]] = []
+        # Crossword: host predictive shard-assignment (linreg + qdisc)
+        self._adaptive = None
+        if "cur_spr" in self.state:
+            from .adaptive import CrosswordAdaptive
 
+            self._adaptive = CrosswordAdaptive(
+                self.population, self.kernel.data_shards, self.me,
+            )
+            self._batch_bytes = 0.0  # EWMA of proposed batch sizes
+
+        self._recover_from_snapshot()
         self._recover_from_wal()
 
         # p2p mesh join (multipaxos/mod.rs:717-737): proactively connect to
@@ -145,12 +203,41 @@ class ServerReplica:
         self.external = ExternalApi(api_addr)
         pf_info(logger, f"replica {self.me} ready")
 
-    # -------------------------------------------------------- WAL recovery
+    # ------------------------------------------------------------- routing
+    def group_of(self, key: str) -> int:
+        """Key -> consensus group (the multi-group serving axis; parity
+        role: the reference runs one cluster per keyspace, SURVEY §2.8
+        'group batching')."""
+        if self.G == 1:
+            return 0
+        return zlib.crc32(key.encode()) % self.G
+
+    # ------------------------------------------------------------ recovery
+    def _recover_from_snapshot(self) -> None:
+        """Load the snapshot (full KV + applied floors) before WAL replay
+        (parity: snapshot.rs:189 recover_from_snapshot)."""
+        if not os.path.exists(self.snap_path):
+            return
+        try:
+            with open(self.snap_path, "rb") as f:
+                kind, kv, floors = pickle.load(f)
+        except Exception as e:
+            pf_warn(logger, f"snapshot unreadable, ignoring: {e}")
+            return
+        assert kind == "kv"
+        self.statemach._kv.update(kv)
+        for g, fl in enumerate(floors[: self.G]):
+            self.applied[g] = max(self.applied[g], int(fl))
+        pf_info(
+            logger,
+            f"recovered snapshot: {len(kv)} keys, floors {floors[:4]}...",
+        )
+
     def _recover_from_wal(self) -> None:
         """Replay the WAL: apply records rebuild payloads + KV + exec
-        floors; the last vote record per group rebuilds the kernel row's
-        acceptor state (parity: recovery.rs replay loop SURVEY.md §3.4 +
-        raft durable curr_term/voted_for, raft/mod.rs:144-176)."""
+        floors; the last durable record per group rebuilds the kernel
+        row's acceptor state (parity: recovery.rs replay loop SURVEY.md
+        §3.4 + raft durable curr_term/voted_for, raft/mod.rs:144-176)."""
         off = 0
         n = 0
         votes: Dict[int, dict] = {}
@@ -172,7 +259,7 @@ class ServerReplica:
                 g, slot, vid, batch = rec
                 self.payloads._data[g][vid] = batch
                 self.payloads._next[g] = max(self.payloads._next[g], vid + 1)
-                if batch is not None:
+                if batch is not None and slot >= self.applied[g]:
                     for client, req in batch:
                         if req.cmd is not None:
                             apply_command(self.statemach._kv, req.cmd)
@@ -180,93 +267,125 @@ class ServerReplica:
             off = res.end_offset
             n += 1
         for g, v in votes.items():
-            self._restore_vote_row(g, v)
+            self.kernel.restore_durable(
+                self.state, g, self.me, v, self.applied[g]
+            )
         if n:
             pf_info(
                 logger,
-                f"recovered {n} WAL records ({len(votes)} vote rows)",
+                f"recovered {n} WAL records ({len(votes)} acceptor rows)",
             )
 
-    def _restore_vote_row(self, g: int, v: dict) -> None:
-        """Reinstate our acceptor row in the kernel state from a logged
-        vote record — a crash-restarted replica must not forget its
-        promises/votes (double-vote) nor its voted window content."""
-        st = self.state
-        if "vote_bal" not in st:
-            return  # kernel family without the vote-run contract
-        me = self.me
-        i32 = jnp.int32
-        floor = i32(self.applied[g])
-        st["bal_max"] = st["bal_max"].at[g, me].max(i32(v["bal_max"]))
-        st["vote_bal"] = st["vote_bal"].at[g, me].set(i32(v["vote_bal"]))
-        st["vote_from"] = st["vote_from"].at[g, me].set(i32(v["vote_from"]))
-        st["vote_bar"] = st["vote_bar"].at[g, me].max(floor)
-        st["vote_bar"] = st["vote_bar"].at[g, me].max(i32(v["vote_bar"]))
-        st["dur_bar"] = st["dur_bar"].at[g, me].set(
-            jnp.maximum(i32(v["vote_bar"]), floor)
-        )
-        st["commit_bar"] = st["commit_bar"].at[g, me].max(floor)
-        st["exec_bar"] = st["exec_bar"].at[g, me].max(floor)
-        st["win_abs"] = st["win_abs"].at[g, me].set(
-            jnp.asarray(v["win_abs"], i32)
-        )
-        st["win_bal"] = st["win_bal"].at[g, me].set(
-            jnp.asarray(v["win_bal"], i32)
-        )
-        st["win_val"] = st["win_val"].at[g, me].set(
-            jnp.asarray(v["win_val"], i32)
-        )
-
+    # ----------------------------------------------------------- durability
     def _log_votes(self) -> None:
-        """Durably log acceptor-state changes BEFORE the outbox carrying
-        the corresponding acks is released (next tick's send).
+        """Durably log dirty acceptor rows BEFORE the outbox carrying the
+        corresponding acks is released (next tick's send).
 
         Parity: the reference appends PrepareBal/AcceptData and fsyncs
         before a follower sends AcceptReply (durability.rs:85-216) and
         Raft persists curr_term/voted_for (raft/mod.rs:144-176).  Payload
         batches for newly voted value ids ride the same record so a
         crashed-and-recovered quorum can re-serve committed values even if
-        every replica restarts."""
-        st = self.state
-        if "vote_bal" not in st:
-            return
+        every replica restarts.  Dirty-group detection is one vectorized
+        signature compare — O(G) python work only for groups that changed.
+        """
+        ker = self.kernel
         me = self.me
-        bal_max = np.asarray(st["bal_max"])[:, me]
-        vote_bal = np.asarray(st["vote_bal"])[:, me]
-        vote_from = np.asarray(st["vote_from"])[:, me]
-        vote_bar = np.asarray(st["vote_bar"])[:, me]
-        win_abs = np.asarray(st["win_abs"])[:, me]
-        win_bal = np.asarray(st["win_bal"])[:, me]
-        win_val = np.asarray(st["win_val"])[:, me]
-        for g in range(self.G):
-            key = (
-                int(bal_max[g]), int(vote_bal[g]), int(vote_from[g]),
-                int(vote_bar[g]), win_abs[g].tobytes(),
-                win_bal[g].tobytes(), win_val[g].tobytes(),
-            )
-            if self._voted_logged.get(g) == key:
-                continue
-            self._voted_logged[g] = key
+        scal = {
+            k: np.asarray(self.state[k])[:, me] for k in ker.DURABLE_SCALARS
+        }
+        wins = {
+            k: np.asarray(self.state[k])[:, me] for k in ker.DURABLE_WINDOWS
+        }
+        parts = [
+            a.reshape(self.G, -1).astype(np.int64)
+            for a in list(scal.values()) + list(wins.values())
+        ]
+        sig = np.concatenate(parts, axis=1)
+        if self._sig is not None and sig.shape == self._sig.shape:
+            dirty = np.nonzero((sig != self._sig).any(axis=1))[0]
+        else:
+            dirty = np.arange(self.G)
+        self._sig = sig
+        if len(dirty) == 0:
+            return
+        val_win = wins[ker.VALUE_WINDOW]
+        for g in dirty:
+            g = int(g)
             new_pp = {}
-            for vid in set(int(x) for x in win_val[g]):
-                if vid and vid not in self._logged_vids[g]:
+            for vid in set(int(x) for x in val_win[g]):
+                if vid > 0 and vid not in self._logged_vids[g]:
                     b = self.payloads.get(g, vid)
                     if b is not None:
                         new_pp[vid] = b
                         self._logged_vids[g].add(vid)
-            rec = ("vote", g, {
-                "bal_max": int(bal_max[g]),
-                "vote_bal": int(vote_bal[g]),
-                "vote_from": int(vote_from[g]),
-                "vote_bar": int(vote_bar[g]),
-                "win_abs": win_abs[g].tolist(),
-                "win_bal": win_bal[g].tolist(),
-                "win_val": win_val[g].tolist(),
-                "pp": new_pp,
-            })
+            rec: Dict[str, Any] = {k: int(v[g]) for k, v in scal.items()}
+            rec.update({k: wins[k][g].tolist() for k in wins})
+            rec["pp"] = new_pp
             self.wal.do_sync_action(
-                LogAction("append", entry=rec, sync=True)
+                LogAction("append", entry=("vote", g, rec), sync=True)
             )
+
+    # ------------------------------------------------------------ snapshots
+    def _take_snapshot(self) -> int:
+        """Write the full KV + applied floors, then compact the WAL down
+        to the current acceptor record per group (+ payloads still in the
+        window) — apply records below the floors are covered by the
+        snapshot.  Parity: snapshot.rs:121-303 (take_new_snapshot +
+        snapshot_discard_log); deviation: the flat-file snapshot is
+        replaced atomically instead of appended (same recovery semantics,
+        'production would use an LSM-tree' note mod.rs:278-280)."""
+        kv = self.statemach.snapshot_items()
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(("kv", kv, list(self.applied)), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+
+        # compact: rewrite the WAL with only the latest durable row per
+        # group; window payloads ride along for the unexecuted tail
+        ker = self.kernel
+        me = self.me
+        scal = {
+            k: np.asarray(self.state[k])[:, me] for k in ker.DURABLE_SCALARS
+        }
+        wins = {
+            k: np.asarray(self.state[k])[:, me] for k in ker.DURABLE_WINDOWS
+        }
+        val_win = wins[ker.VALUE_WINDOW]
+        wtmp = self.wal_path + ".tmp"
+        if os.path.exists(wtmp):
+            os.remove(wtmp)
+        compact = StorageHub(wtmp)
+        new_logged: Dict[int, set] = {}
+        for g in range(self.G):
+            pp = {}
+            for vid in set(int(x) for x in val_win[g]):
+                b = self.payloads.get(g, vid) if vid > 0 else None
+                if b is not None:
+                    pp[vid] = b
+            rec: Dict[str, Any] = {k: int(v[g]) for k, v in scal.items()}
+            rec.update({k: wins[k][g].tolist() for k in wins})
+            rec["pp"] = pp
+            compact.do_sync_action(
+                LogAction("append", entry=("vote", g, rec), sync=False)
+            )
+            new_logged[g] = set(pp)
+        compact.do_sync_action(LogAction("truncate", offset=compact.size,
+                                         sync=True))
+        compact.stop()
+        self.wal.stop()
+        os.replace(wtmp, self.wal_path)
+        self.wal = StorageHub(self.wal_path)
+        self._logged_vids = new_logged
+        self._sig = None  # conservative: next tick re-logs any drift
+        size = self.wal.size
+        pf_info(
+            logger,
+            f"snapshot taken ({len(kv)} keys); WAL compacted to {size}B",
+        )
+        return size
 
     # ----------------------------------------------------------- tick I/O
     def _slice_outbox(self, out) -> Dict[int, Dict[str, Any]]:
@@ -312,6 +431,179 @@ class ServerReplica:
             inbox[k] = jnp.asarray(arr)
         return inbox
 
+    # -------------------------------------------------------- client intake
+    def _reply(self, client: int, reply: ApiReply) -> None:
+        self.external.send_reply(reply, client)
+
+    def _can_local_read(self, g: int) -> bool:
+        """May this replica serve a linearizable read locally right now?
+        Conservative host form of the per-key-bucket kernel rule: all
+        buckets quiescent + the lease condition holds (quorumlease.rs
+        is_local_reader / bodega localread.rs:8-26)."""
+        ex = self._last_extra
+        if not ex:
+            return False
+        K = getattr(self.kernel.config, "num_key_buckets", 0)
+        if "lease_held" in ex:      # QuorumLeases
+            return bool(ex["lease_held"][g, self.me]) and int(
+                ex["n_local_buckets"][g, self.me]
+            ) == K
+        if "local_read_buckets" in ex:  # Bodega
+            return int(ex["n_local_buckets"][g, self.me]) == K
+        return False
+
+    def _handle_conf_req(self, client: int, req: ApiRequest) -> None:
+        """Queue a client ConfChange (never silently dropped — reply with
+        failure if this kernel has no conf plane; parity:
+        external.rs:106-121)."""
+        if self._conf_kind is None:
+            self._reply(client, ApiReply(
+                "conf", req_id=req.req_id, success=False,
+            ))
+            return
+        if self._conf_kind == "ql":
+            # QL conf entries ride the log: only a leader proposes them,
+            # and installation must reach EVERY group — with split
+            # per-group leadership that is structurally impossible from
+            # one server, so fail loudly instead of timing out (the
+            # reference has one group; multi-group conf would need a
+            # manager-mediated conf plane)
+            if not self._is_leader.any():
+                hint = int(self._leader_hint[0])
+                self._reply(client, ApiReply(
+                    "redirect", req_id=req.req_id, redirect=hint,
+                    success=False,
+                ))
+                return
+            if not self._is_leader.all():
+                self._reply(client, ApiReply(
+                    "conf", req_id=req.req_id, success=False,
+                ))
+                return
+        self._conf_queue.append((client, req))
+
+    def _intake(self) -> Tuple[np.ndarray, np.ndarray, Dict]:
+        """Drain the client plane: route requests to groups, serve leased
+        local reads, redirect what we don't lead, answer every request
+        kind (request.rs:16-216 treat_read_only_reqs + redirects)."""
+        n_prop = np.zeros((self.G,), np.int32)
+        vbase = np.zeros((self.G,), np.int32)
+        piggy: Dict[Tuple[int, int], Any] = {}
+        batch = self.external.get_req_batch(timeout=0)
+        if not batch:
+            return n_prop, vbase, piggy
+        by_group: Dict[int, list] = {}
+        for client, req in batch:
+            if req.kind == "conf":
+                self._handle_conf_req(client, req)
+            elif req.kind != "req" or req.cmd is None:
+                self._reply(client, ApiReply(
+                    "error", req_id=req.req_id, success=False,
+                ))
+            else:
+                by_group.setdefault(
+                    self.group_of(req.cmd.key), []
+                ).append((client, req))
+        for g, reqs in by_group.items():
+            if not self._is_leader[g]:
+                pending = []
+                local_ok = self._can_local_read(g)
+                for client, req in reqs:
+                    if local_ok and req.cmd.kind == "get":
+                        res = apply_command(self.statemach._kv, req.cmd)
+                        self._reply(client, ApiReply(
+                            "reply", req_id=req.req_id, result=res,
+                            local=True,
+                        ))
+                    else:
+                        pending.append((client, req))
+                hint = int(self._leader_hint[g])
+                for client, req in pending:
+                    self._reply(client, ApiReply(
+                        "redirect", req_id=req.req_id, redirect=hint,
+                        success=False,
+                    ))
+                continue
+            vid = self.payloads.put(g, reqs)
+            self.origin.add((g, vid))
+            n_prop[g] = 1
+            vbase[g] = vid
+            piggy[(g, vid)] = reqs
+            if self._adaptive is not None:
+                nb = float(len(pickle.dumps(reqs)))
+                self._batch_bytes = 0.9 * self._batch_bytes + 0.1 * nb
+        return n_prop, vbase, piggy
+
+    # ------------------------------------------------------------ conf plane
+    def _conf_inputs(self, inputs: Dict[str, Any]) -> None:
+        """Feed the active ConfChange into the kernel's conf inputs."""
+        i32 = jnp.int32
+        if self._conf_kind is None:
+            return
+        if self._conf_active is None and self._conf_queue:
+            client, req = self._conf_queue.pop(0)
+            d = dict(req.conf_delta or {})
+            resp = 0
+            for r in d.get("responders", []):
+                resp |= 1 << int(r)
+            self._conf_active = {
+                "client": client,
+                "req_id": req.req_id,
+                "resp": resp,
+                "leader": int(d.get("leader", self.me)),
+                "deadline": self.tick + 2000,
+            }
+        a = self._conf_active
+        if self._conf_kind == "ql":
+            tgt = a["resp"] if a is not None else -1
+            inputs["conf_target"] = jnp.full((self.G,), tgt, i32)
+        else:  # bodega
+            init = self.me if a is not None else -1
+            inputs["conf_init"] = jnp.full((self.G,), init, i32)
+            inputs["conf_leader_target"] = jnp.full(
+                (self.G,), a["leader"] if a else -1, i32
+            )
+            inputs["conf_resp_target"] = jnp.full(
+                (self.G,), a["resp"] if a else 0, i32
+            )
+            inputs["conf_bucket"] = jnp.full((self.G,), -1, i32)
+
+    def _conf_progress(self) -> None:
+        """Detect conf installation, reply to the requesting client, and
+        reflect the new conf to the manager (reigner.rs RespondersConf)."""
+        a = self._conf_active
+        if a is None:
+            return
+        me = self.me
+        if self._conf_kind == "ql":
+            cur = np.asarray(self.state["conf_cur"])[:, me]
+            done = bool((cur == a["resp"]).all())
+        else:
+            resp = np.asarray(self.state["conf_resp"])[:, me, :]
+            lead = np.asarray(self.state["conf_leader"])[:, me]
+            done = bool(
+                (resp == a["resp"]).all() and (lead == a["leader"]).all()
+            )
+        if done:
+            self._reply(a["client"], ApiReply(
+                "conf", req_id=a["req_id"], success=True,
+            ))
+            self.ctrl.send_ctrl(CtrlMsg("responders_conf", {
+                "new_conf": {
+                    "responders": [
+                        r for r in range(self.population)
+                        if a["resp"] >> r & 1
+                    ],
+                    "leader": a["leader"],
+                },
+            }))
+            self._conf_active = None
+        elif self.tick > a["deadline"]:
+            self._reply(a["client"], ApiReply(
+                "conf", req_id=a["req_id"], success=False,
+            ))
+            self._conf_active = None
+
     # --------------------------------------------------------- main loop
     def run(self) -> bool:
         """Event loop; returns True to request a crash-restart."""
@@ -329,32 +621,14 @@ class ServerReplica:
                 time.sleep(self.tick_interval)
                 continue
 
-            # 1. client intake -> payload ids (one ReqBatch per group/tick);
-            # non-leaders redirect with the hinted leader id
-            # (request.rs:128-154)
-            batch = self.external.get_req_batch(timeout=0)
-            n_prop = np.zeros((self.G,), np.int32)
-            vbase = np.zeros((self.G,), np.int32)
-            piggy: Dict[int, Any] = {}
-            if batch:
-                reqs = [(c, r) for c, r in batch if r.kind == "req"]
-                if reqs and not self.was_leader:
-                    hint = int(np.asarray(self.state["leader"])[0, self.me]
-                               ) if "leader" in self.state else -1
-                    for c, r in reqs:
-                        self.external.send_reply(
-                            ApiReply("redirect", req_id=r.req_id,
-                                     redirect=hint, success=False),
-                            c,
-                        )
-                    reqs = []
-                if reqs:
-                    g = 0  # client plane addresses group 0
-                    vid = self.payloads.put(g, reqs)
-                    self.origin.add(vid)
-                    n_prop[g] = 1
-                    vbase[g] = vid
-                    piggy[vid] = reqs
+            sw = self._stopwatch
+            if sw is not None:
+                sw.record_now(self.tick, 0, t0)
+
+            # 1. client intake -> payload ids (one ReqBatch per group/tick)
+            n_prop, vbase, piggy = self._intake()
+            if sw is not None:
+                sw.record_now(self.tick, 1)
 
             # 2. exchange tick frames and step the kernel
             frames = self._slice_outbox(last_out)
@@ -364,11 +638,12 @@ class ServerReplica:
             payload_msg: Dict[str, Any] = {
                 "pp": piggy,
                 "need": sorted(self.missing)[:64],
-                "kv_need": self.kv_need,
+                "kv_need": bool(self.kv_need),
+                "ts": time.monotonic(),  # adaptive delivery sampling
             }
             if self._pending_kv_serve:
                 payload_msg["kv"] = self.statemach.snapshot_items()
-                payload_msg["kv_floor"] = self.applied[0]
+                payload_msg["kv_floor"] = list(self.applied)
                 self._pending_kv_serve = False
             self.transport.send_tick(
                 self.tick,
@@ -388,16 +663,60 @@ class ServerReplica:
                     )
                 ),
             }
+            self._conf_inputs(inputs)
+            if self._adaptive is not None:
+                while self.transport.samples:
+                    try:
+                        p, nb, dly = self.transport.samples.popleft()
+                    except IndexError:
+                        break
+                    self._adaptive.observe(p, nb, dly)
+                inputs["spr_override"] = jnp.asarray(
+                    self._adaptive.overrides(self.G, self._batch_bytes),
+                    jnp.int32,
+                )
+            if sw is not None:
+                sw.record_now(self.tick, 2)  # frame exchange + inbox
             self.state, last_out, fx = self._step(
                 self.state, inbox, inputs
             )
+            if sw is not None:
+                sw.record_now(self.tick, 3)  # kernel step
 
             # 3. durability before the acks in last_out leave (top of next
             # iteration); then apply newly committed slots + leadership
             self._log_votes()
+            if sw is not None:
+                sw.record_now(self.tick, 4)  # durable log
             self._apply_committed(fx)
+            self._conf_progress()
             self._leader_edges(fx)
+            if sw is not None:
+                sw.record_now(self.tick, 5)  # apply + reply
+                now = time.monotonic()
+                if now - self._bd_last_print >= 5.0:
+                    # intake / exchange / step / log / apply stage
+                    # means+stdevs in us (parity: the reference leader
+                    # prints bd stats every 5s, multipaxos/mod.rs:932-943)
+                    stats = sw.summarize(5)
+                    names = ("intake", "exchange", "step", "log", "apply")
+                    pf_info(logger, "breakdown " + " ".join(
+                        f"{n}={m:.0f}±{s:.0f}us"
+                        for n, (m, s) in zip(names, stats)
+                    ))
+                    sw.remove_all()
+                    self._bd_last_print = now
             self.tick += 1
+            if (
+                self.snapshot_interval
+                and self.tick % self.snapshot_interval == 0
+                and sum(self.applied) > self._snap_last
+            ):
+                self._snap_last = sum(self.applied)
+                self._take_snapshot()
+                self.ctrl.send_ctrl(CtrlMsg(
+                    "snapshot_up_to", {"new_start": list(self.applied)}
+                ))
 
             rem = deadline - time.monotonic()
             if rem > 0:
@@ -410,45 +729,78 @@ class ServerReplica:
         # cumulative — skipping one could drop a served payload)
         for src, fl in got.items():
             for f in fl or ():
-                for vid, batch in f.get("pp", {}).items():
-                    if self.payloads.get(0, vid) is None:
-                        self.payloads._data[0][vid] = batch
-                    self.missing.discard(vid)
+                for (g, vid), batch in f.get("pp", {}).items():
+                    if self.payloads.get(g, vid) is None:
+                        self.payloads._data[g][vid] = batch
+                        self.payloads._next[g] = max(
+                            self.payloads._next[g], vid + 1
+                        )
+                    self.missing.discard((g, vid))
                 # serve peers' missing payloads / kv requests next tick by
                 # folding them into our own piggyback
-                for vid in f.get("need", []):
-                    b = self.payloads.get(0, vid)
+                for g, vid in f.get("need", []):
+                    b = self.payloads.get(g, vid)
                     if b is not None:
-                        self._pending_serve[vid] = b
+                        self._pending_serve[(g, vid)] = b
                 if f.get("kv_need") and not self.kv_need:
                     self._pending_kv_serve = True
                 if "kv" in f and self.kv_need:
-                    self.statemach._kv.update(f["kv"])
-                    self.applied[0] = max(self.applied[0], f["kv_floor"])
-                    self.kv_need = False
+                    self._merge_kv(f["kv"], f["kv_floor"])
+
+    def _merge_kv(self, kv: dict, floors: list) -> None:
+        """Install-snapshot KV merge, guarded per group: only groups that
+        jumped take the provider's state, and only when the provider's
+        floor covers our claimed floor — a stale provider must never
+        overwrite newer local execution (this was possible before r4)."""
+        ok_groups = {
+            g for g in self.kv_need
+            if g < len(floors) and floors[g] >= self.applied[g]
+        }
+        if not ok_groups:
+            return
+        upd = {
+            k: v for k, v in kv.items() if self.group_of(k) in ok_groups
+        }
+        self.statemach._kv.update(upd)
+        for g in ok_groups:
+            self.applied[g] = max(self.applied[g], int(floors[g]))
+            self.kv_need.discard(g)
 
     # ------------------------------------------------------- application
     def _apply_committed(self, fx) -> None:
-        cb = int(np.asarray(fx.commit_bar)[0, self.me])
-        g = 0
-        if cb <= self.applied[g]:
-            return
+        self._last_extra = {
+            k: np.asarray(v) for k, v in fx.extra.items()
+        }
+        cbs = np.asarray(fx.commit_bar)[:, self.me]
+        applied = np.asarray(self.applied)
+        for g in np.nonzero(cbs > applied)[0]:
+            self._apply_group(int(g), int(cbs[g]))
+
+    def _apply_group(self, g: int, cb: int) -> None:
         win_abs = np.asarray(self.state["win_abs"])[g, self.me]
-        win_val = np.asarray(self.state["win_val"])[g, self.me]
-        W = self.kernel.W
+        win_val = np.asarray(self.state[self.kernel.VALUE_WINDOW])[
+            g, self.me
+        ]
+        # marker lanes whose slots carry non-payload values: conf entries
+        # (win_cfg stores the grantee bitmap in win_val) and no-op fills
+        marker = np.zeros_like(win_abs, bool)
+        for lane in ("win_cfg", "win_noop"):
+            if lane in self.state:
+                marker |= np.asarray(self.state[lane])[g, self.me] != 0
         while self.applied[g] < cb:
             slot = self.applied[g]
             pos = np.where(win_abs == slot)[0]
             if len(pos) == 0:
                 # below the window: an install-snapshot jumped us forward;
                 # fetch the KV state from peers host-side
-                self.kv_need = True
+                self.kv_need.add(g)
                 self.applied[g] = cb
                 return
-            vid = int(win_val[pos[0]])
+            is_marker = bool(marker[pos[0]])
+            vid = 0 if is_marker else int(win_val[pos[0]])
             batch = self.payloads.get(g, vid)
             if vid != 0 and batch is None:
-                self.missing.add(vid)
+                self.missing.add((g, vid))
                 return  # stall the exec floor until the payload arrives
             # durability before client-visible effects (storage.rs intent):
             # the apply record is fsynced before the reply below, so an
@@ -457,26 +809,41 @@ class ServerReplica:
                 "append", entry=(g, slot, vid, batch), sync=True
             ))
             if batch is not None:
-                mine = vid in self.origin
+                mine = (g, vid) in self.origin
                 for client, req in batch:
                     res = apply_command(self.statemach._kv, req.cmd)
                     if mine:
-                        self.external.send_reply(
-                            ApiReply("reply", req_id=req.req_id,
-                                     result=res),
-                            client,
-                        )
+                        self._reply(client, ApiReply(
+                            "reply", req_id=req.req_id, result=res,
+                        ))
             self.applied[g] = slot + 1
 
     def _leader_edges(self, fx) -> None:
-        is_l = bool(np.asarray(
-            fx.extra.get("is_leader", np.zeros((self.G, self.population)))
-        )[0, self.me])
-        if is_l != self.was_leader:
-            self.ctrl.send_ctrl(
-                CtrlMsg("leader_status", {"step_up": is_l})
+        ex = self._last_extra
+        is_l = ex.get("is_leader")
+        if is_l is None:
+            return
+        self._is_leader = is_l[:, self.me].astype(bool)
+        if "leader" in self.state:
+            lead = np.asarray(self.state["leader"])[:, self.me]
+            self._leader_hint = np.where(
+                (lead == self.me) & ~self._is_leader, -1, lead
             )
-            self.was_leader = is_l
+        # manager tracking follows group 0 (the reference has one group).
+        # Level-based with periodic re-announce, not edge-only: an edge
+        # can be lost when leadership bounces through a third replica
+        # while our own flag never flips (verified wedge: kernel-healthy
+        # leader + manager stuck at leader=None, steering clients wrong)
+        g0 = bool(self._is_leader[0])
+        if g0 != self.was_leader:
+            self.ctrl.send_ctrl(
+                CtrlMsg("leader_status", {"step_up": g0})
+            )
+            self.was_leader = g0
+            self._lead_announced = self.tick
+        elif g0 and self.tick - getattr(self, "_lead_announced", 0) >= 200:
+            self.ctrl.send_ctrl(CtrlMsg("leader_status", {"step_up": True}))
+            self._lead_announced = self.tick
 
     # ----------------------------------------------------------- control
     def _handle_ctrl(self) -> Optional[bool]:
@@ -492,22 +859,18 @@ class ServerReplica:
         elif msg.kind == "reset_state":
             if not msg.payload.get("durable", True):
                 self.wal.stop()
-                try:
-                    os.remove(self.wal_path)
-                except OSError:
-                    pass
+                for path in (self.wal_path, self.snap_path):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
             self.ctrl.send_ctrl(CtrlMsg("reset_reply"))
             return True
         elif msg.kind == "take_snapshot":
-            kv = self.statemach.snapshot_items()
-            snap = StorageHub(self.snapdir)
-            snap.do_sync_action(LogAction(
-                "append", entry=("kv", kv, self.applied[0]), sync=True
-            ))
-            snap.stop()
+            self._take_snapshot()
             self.ctrl.send_ctrl(CtrlMsg("snapshot_reply"))
             self.ctrl.send_ctrl(CtrlMsg(
-                "snapshot_up_to", {"new_start": self.applied[0]}
+                "snapshot_up_to", {"new_start": list(self.applied)}
             ))
         elif msg.kind == "leave":
             return False
@@ -521,13 +884,17 @@ class ServerReplica:
             "me": me,
             "tick": self.tick,
             "applied": list(self.applied),
-            "kv_need": self.kv_need,
+            "kv_need": sorted(self.kv_need),
             "missing": sorted(self.missing),
             "paused": self.paused,
             "peers": sorted(self.transport._conns),
             "was_leader": self.was_leader,
+            "wal_size": self.wal.size,
         }
-        for k in ("leader", "commit_bar", "exec_bar", "vote_bar", "bal_max"):
+        for k in (
+            "leader", "commit_bar", "exec_bar", "vote_bar", "bal_max",
+            "term", "voted_for", "conf_cur",
+        ):
             if k in st:
                 out[k] = np.asarray(st[k])[:, me].tolist()
         return out

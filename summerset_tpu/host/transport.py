@@ -75,6 +75,13 @@ def hard_close(sock: socket.socket) -> None:
     except OSError:
         pass
     try:
+        # wake any thread blocked in recv() on this socket — close() alone
+        # defers the real close (and the RST/port release) until that
+        # thread's in-flight syscall returns
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
         sock.close()
     except OSError:
         pass
@@ -87,6 +94,11 @@ class TransportHub:
         self.p2p_addr = p2p_addr
         self._conns: Dict[int, socket.socket] = {}
         self._wlocks: Dict[int, threading.Lock] = {}
+        # (peer, frame bytes, delay ms) delivery samples; deque appends
+        # are thread-safe, the replica loop drains them opportunistically
+        from collections import deque
+
+        self.samples: Any = deque(maxlen=4096)
         # per-peer receive queues of (tick, payload)
         self._rq: Dict[int, queue.Queue] = {
             p: queue.Queue() for p in range(population) if p != me
@@ -179,10 +191,20 @@ class TransportHub:
             self._register(peer, sock)
 
     def _messenger_recv(self, peer: int, sock: socket.socket) -> None:
+        import time
+
         try:
             while True:
-                tick, payload = safetcp.recv_msg_sync(sock)
+                (tick, payload), nbytes = safetcp.recv_msg_sync_len(sock)
                 self._rq[peer].put((tick, payload))
+                # per-peer delivery sample for the adaptive perf model
+                # (send-stamped frames; monotonic is machine-wide, so the
+                # delta is a real one-way delay for same-host deployments)
+                ts = payload.get("ts") if isinstance(payload, dict) else None
+                if ts is not None:
+                    self.samples.append(
+                        (peer, nbytes, (time.monotonic() - ts) * 1e3)
+                    )
         except Exception:
             pf_warn(logger, f"peer {peer} connection lost")
             if self._conns.get(peer) is sock:

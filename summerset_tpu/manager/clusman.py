@@ -18,6 +18,7 @@ from typing import Dict, Optional, Tuple
 from ..host.messages import CtrlMsg, CtrlReply, CtrlRequest
 from ..utils import safetcp
 from ..utils.logging import pf_info, pf_logger, pf_warn, set_me
+from ..utils.timer import Timer
 
 logger = pf_logger("clusman")
 
@@ -44,13 +45,35 @@ class ClusterManager:
         self.srv_addr = srv_addr
         self.cli_addr = cli_addr
         self.population = population
+        # reset orchestration budgets (shrunk by unit tests)
+        self.ack_timeout = 30.0
+        self.rejoin_timeout = 120.0
+        self.settle_delay = 0.5
         self.servers: Dict[int, _ServerConn] = {}
         self.leader: Optional[int] = None
         self.conf: Optional[dict] = None
         self._next_sid = 0
         self._next_cid = 1000
-        self._pending_replies: Dict[str, asyncio.Queue] = {}
+        # kind -> list of waiter queues: every waiter sees every reply of
+        # that kind (and filters by sid), so concurrent ctrl clients can't
+        # steal each other's acks
+        self._pending_replies: Dict[str, list] = {}
         self._join_event = asyncio.Event()
+        # leader staleness: when the tracked leader's control connection
+        # drops and nobody steps up within the grace window, stop steering
+        # clients at a ghost (utils.Timer — the reference Timer's role as
+        # liveness backbone, timer.rs:39-143)
+        self._leader_timer = Timer(explode_callback=self._leader_expired)
+        self._leader_lost: Optional[int] = None
+
+    def _leader_expired(self) -> None:
+        if self._leader_lost is not None and self.leader == self._leader_lost:
+            pf_warn(
+                logger,
+                f"leader {self.leader} gone with no successor; clearing",
+            )
+            self.leader = None
+        self._leader_lost = None
 
     # ------------------------------------------------------- server plane
     async def _serve_server(self, reader, writer) -> None:
@@ -85,6 +108,9 @@ class ClusterManager:
             # restarted server can reclaim it (clusman.rs assigned_ids)
             if self.servers.get(sid) is conn:
                 del self.servers[sid]
+            if self.leader == sid:
+                self._leader_lost = sid
+                self._leader_timer.kickoff(5.0)
 
     async def _handle_ctrl(self, conn: _ServerConn, msg: CtrlMsg) -> None:
         p = msg.payload
@@ -113,6 +139,8 @@ class ClusterManager:
         elif msg.kind == "leader_status":
             if p.get("step_up"):
                 self.leader = conn.sid
+                self._leader_timer.cancel()
+                self._leader_lost = None
             elif self.leader == conn.sid:
                 self.leader = None
             pf_info(logger, f"leader status: {self.leader}")
@@ -126,8 +154,7 @@ class ClusterManager:
         elif msg.kind in (
             "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
         ):
-            q = self._pending_replies.get(msg.kind)
-            if q is not None:
+            for q in self._pending_replies.get(msg.kind, ()):
                 q.put_nowait(conn.sid)
         elif msg.kind == "leave":
             await safetcp.send_msg(conn.writer, CtrlMsg("leave_reply"))
@@ -167,20 +194,27 @@ class ClusterManager:
         (parity: clusman.rs:382-606 orchestration handlers)."""
         targets = self._targets(req)
         q: asyncio.Queue = asyncio.Queue()
-        self._pending_replies[reply_kind] = q
+        self._pending_replies.setdefault(reply_kind, []).append(q)
         payload = dict(extra or {})
-        for s in targets:
-            await safetcp.send_msg(s.writer, CtrlMsg(kind, payload))
         done = []
         try:
-            for _ in targets:
-                done.append(
-                    await asyncio.wait_for(q.get(), timeout=15.0)
-                )
+            want = set()
+            for s in targets:
+                try:
+                    await safetcp.send_msg(s.writer, CtrlMsg(kind, payload))
+                    want.add(s.sid)
+                except (ConnectionError, OSError):
+                    # this target died mid-fan-out; the rest still count
+                    pf_warn(logger, f"{kind}: send to {s.sid} failed")
+            while want:
+                sid = await asyncio.wait_for(q.get(), timeout=15.0)
+                if sid in want:
+                    want.discard(sid)
+                    done.append(sid)
         except asyncio.TimeoutError:
             pf_warn(logger, f"{kind}: timed out waiting for replies")
         finally:
-            self._pending_replies.pop(reply_kind, None)
+            self._pending_replies[reply_kind].remove(q)
         return CtrlReply(kind, done=done)
 
     async def _reset_servers(self, req: CtrlRequest) -> CtrlReply:
@@ -197,31 +231,42 @@ class ClusterManager:
             if conn is None or conn.writer.is_closing():
                 continue
             q: asyncio.Queue = asyncio.Queue()
-            self._pending_replies["reset_reply"] = q
+            self._pending_replies.setdefault("reset_reply", []).append(q)
+            acked = True
             try:
                 await safetcp.send_msg(
                     conn.writer,
                     CtrlMsg("reset_state", {"durable": req.durable}),
                 )
                 while True:  # drain until THIS sid acks
-                    got = await asyncio.wait_for(q.get(), timeout=30.0)
+                    got = await asyncio.wait_for(
+                        q.get(), timeout=self.ack_timeout
+                    )
                     if got == sid:
                         break
             except (asyncio.TimeoutError, ConnectionError, OSError):
+                # the server may still have received reset_state and be
+                # restarting — free the id anyway so its reconnect is not
+                # refused at the handshake (the old conn is dead either way)
                 pf_warn(logger, f"reset: no ack from server {sid}")
-                continue
+                acked = False
             finally:
-                self._pending_replies.pop("reset_reply", None)
+                self._pending_replies["reset_reply"].remove(q)
             # free the id; the restarting server reclaims it (it is the
             # only one connecting right now), then wait for its re-join
             if self.servers.get(sid) is conn:
                 del self.servers[sid]
-            rejoin_deadline = (
-                asyncio.get_event_loop().time() + 120.0
+            # an un-acked server may still restart (its conn died after
+            # receiving reset_state) — give it a short rejoin window, vs
+            # the long one for a confirmed restart
+            rejoin_deadline = asyncio.get_event_loop().time() + (
+                self.rejoin_timeout if acked else self.rejoin_timeout / 8
             )
+            rejoined = False
             while True:
                 c = self.servers.get(sid)
                 if c is not None and c.joined and c is not conn:
+                    rejoined = True
                     break
                 self._join_event.clear()
                 budget = rejoin_deadline - asyncio.get_event_loop().time()
@@ -236,8 +281,9 @@ class ClusterManager:
                     pass
             # settle so the rejoined server's transport mesh completes
             # before the next victim goes down (clusman.rs 500ms sleep)
-            await asyncio.sleep(0.5)
-            done.append(sid)
+            await asyncio.sleep(self.settle_delay)
+            if acked and rejoined:
+                done.append(sid)
         return CtrlReply("reset_state", done=done)
 
     async def _handle_request(self, req: CtrlRequest) -> CtrlReply:

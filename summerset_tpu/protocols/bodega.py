@@ -102,6 +102,11 @@ class BodegaKernel(MultiPaxosKernel):
         {"bw_abs", "bw_bal", "bw_val", "bw_noop", "cf_resp"}
     )
 
+    # the no-op marker lane is part of the voted window content (the conf
+    # itself is lease-installed, not logged: a restarted replica re-learns
+    # it from heartbeats, conflease.rs heard_new_conf)
+    DURABLE_WINDOWS = MultiPaxosKernel.DURABLE_WINDOWS + ("win_noop",)
+
     def __init__(
         self,
         num_groups: int,

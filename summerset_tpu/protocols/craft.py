@@ -67,6 +67,10 @@ class ReplicaConfigCRaft(ReplicaConfigRaft):
 class CRaftKernel(RaftKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_term", "bw_val", "bw_full"})
 
+    # the per-slot full-copy/coded mode marker is voted content (the
+    # commit tally depends on it, cf. craft full-copy fallback)
+    DURABLE_WINDOWS = RaftKernel.DURABLE_WINDOWS + ("win_full",)
+
     def __init__(
         self,
         num_groups: int,

@@ -76,6 +76,11 @@ class ReplicaConfigCrossword(ReplicaConfigRSPaxos):
 class CrosswordKernel(RSPaxosKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val", "bw_spr"})
 
+    # per-slot assignment width is voted content: an acceptor's restart
+    # must not forget how wide the value it voted for was (the commit
+    # coverage tally counts it, crossword/mod.rs:324-396)
+    DURABLE_WINDOWS = RSPaxosKernel.DURABLE_WINDOWS + ("win_spr",)
+
     def __init__(
         self,
         num_groups: int,

@@ -110,6 +110,30 @@ class ReplicaConfigMultiPaxos:
 class MultiPaxosKernel(ProtocolKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val"})
 
+    # durable acceptor record (host WAL contract; parity: the reference
+    # fsyncs PrepareBal/AcceptData before AcceptReply, durability.rs:85-216)
+    DURABLE_SCALARS = ("bal_max", "vote_bal", "vote_from", "vote_bar")
+    DURABLE_WINDOWS = ("win_abs", "win_bal", "win_val")
+
+    def restore_durable(self, st, g, me, rec, floor):
+        """Reinstate our acceptor row from the last logged record — a
+        crash-restarted replica must not forget its promises/votes
+        (double-vote) nor its voted window content."""
+        i32 = jnp.int32
+        fl = i32(floor)
+        vbar = jnp.maximum(i32(rec["vote_bar"]), fl)
+        st["bal_max"] = st["bal_max"].at[g, me].max(i32(rec["bal_max"]))
+        st["vote_bal"] = st["vote_bal"].at[g, me].set(i32(rec["vote_bal"]))
+        st["vote_from"] = st["vote_from"].at[g, me].set(
+            i32(rec["vote_from"])
+        )
+        st["vote_bar"] = st["vote_bar"].at[g, me].max(vbar)
+        st["dur_bar"] = st["dur_bar"].at[g, me].set(vbar)
+        st["commit_bar"] = st["commit_bar"].at[g, me].max(fl)
+        st["exec_bar"] = st["exec_bar"].at[g, me].max(fl)
+        for k in self.DURABLE_WINDOWS:
+            st[k] = st[k].at[g, me].set(jnp.asarray(rec[k], st[k].dtype))
+
     def __init__(
         self,
         num_groups: int,

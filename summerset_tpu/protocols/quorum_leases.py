@@ -89,6 +89,22 @@ class ReplicaConfigQuorumLeases(ReplicaConfigMultiPaxos):
 class QuorumLeasesKernel(MultiPaxosKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val", "bw_cfg"})
 
+    # the grantee conf rides the log: its window lane and the applied conf
+    # are part of the durable acceptor record (parity: quorumconf.rs conf
+    # entries are WAL-logged like any instance)
+    DURABLE_SCALARS = MultiPaxosKernel.DURABLE_SCALARS + (
+        "conf_cur", "conf_slot",
+    )
+    DURABLE_WINDOWS = MultiPaxosKernel.DURABLE_WINDOWS + ("win_cfg",)
+
+    def restore_durable(self, st, g, me, rec, floor):
+        super().restore_durable(st, g, me, rec, floor)
+        i32 = jnp.int32
+        st["conf_cur"] = st["conf_cur"].at[g, me].set(i32(rec["conf_cur"]))
+        st["conf_slot"] = st["conf_slot"].at[g, me].set(
+            i32(rec["conf_slot"])
+        )
+
     def __init__(
         self,
         num_groups: int,

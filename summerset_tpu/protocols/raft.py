@@ -114,6 +114,38 @@ def _gather_slot(win_abs, win_field, slot):
 class RaftKernel(ProtocolKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_term", "bw_val"})
 
+    # durable acceptor record: Raft persists curr_term/voted_for metadata
+    # plus the appended log tail (parity: raft/mod.rs:144-176 pack_meta +
+    # DurEntry log entries) — a restarted replica must not double-vote in
+    # a term it already voted in, nor forget entries it acked
+    DURABLE_SCALARS = ("term", "voted_for", "log_end", "last_term")
+    DURABLE_WINDOWS = ("win_abs", "win_term", "win_val")
+
+    def restore_durable(self, st, g, me, rec, floor):
+        i32 = jnp.int32
+        fl = i32(floor)
+        st["term"] = st["term"].at[g, me].max(i32(rec["term"]))
+        st["voted_for"] = st["voted_for"].at[g, me].set(
+            i32(rec["voted_for"])
+        )
+        st["log_end"] = st["log_end"].at[g, me].set(
+            jnp.maximum(i32(rec["log_end"]), fl)
+        )
+        st["last_term"] = st["last_term"].at[g, me].set(
+            i32(rec["last_term"])
+        )
+        # everything in the record is on disk again after replay; bars
+        # resume from the applier's floor (commit_bar is re-learned from
+        # the leader, Leader Completeness makes floor a safe base)
+        st["match_bar"] = st["match_bar"].at[g, me].set(fl)
+        st["dur_bar"] = st["dur_bar"].at[g, me].set(
+            jnp.maximum(i32(rec["log_end"]), fl)
+        )
+        st["commit_bar"] = st["commit_bar"].at[g, me].max(fl)
+        st["exec_bar"] = st["exec_bar"].at[g, me].max(fl)
+        for k in self.DURABLE_WINDOWS:
+            st[k] = st[k].at[g, me].set(jnp.asarray(rec[k], st[k].dtype))
+
     def __init__(
         self,
         num_groups: int,

@@ -54,6 +54,13 @@ def send_msg_sync(sock, obj: Any) -> None:
 
 
 def recv_msg_sync(sock) -> Any:
+    return recv_msg_sync_len(sock)[0]
+
+
+def recv_msg_sync_len(sock) -> Tuple[Any, int]:
+    """Like :func:`recv_msg_sync` but also returns the frame body length
+    (consumed by the Crossword adaptive perf model's delivery samples)."""
+
     def read_exact(n: int) -> bytes:
         buf = b""
         while len(buf) < n:
@@ -66,7 +73,7 @@ def recv_msg_sync(sock) -> Any:
     (length,) = _LEN.unpack(read_exact(_LEN.size))
     if length > MAX_FRAME:
         raise SummersetError(f"frame length {length} exceeds cap {MAX_FRAME}")
-    return pickle.loads(read_exact(length))
+    return pickle.loads(read_exact(length)), length
 
 
 async def tcp_bind_with_retry(

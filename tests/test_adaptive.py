@@ -1,0 +1,46 @@
+"""Crossword host adaptive policy: linreg perf models + qdisc folding
+drive the shards-per-replica override (parity: crossword/adaptive.rs:274+
+fed by utils/linreg.rs and utils/qdisc.rs)."""
+
+from summerset_tpu.host.adaptive import CrosswordAdaptive
+
+
+def feed(ad, peer, alpha, beta, n=50):
+    for i in range(n):
+        x = 1000.0 * (i % 10 + 1)
+        ad.observe(peer, x, alpha + beta * x)
+
+
+class TestCrosswordAdaptive:
+    def test_uniform_fast_peers_prefer_narrow_shards(self):
+        """With all peers equally bandwidth-bound, narrower shards ship
+        less data on the critical path -> choose spr < d."""
+        ad = CrosswordAdaptive(5, 3, me=0, refit_interval=0.0)
+        for p in range(1, 5):
+            feed(ad, p, alpha=0.1, beta=0.01)  # strongly size-dependent
+        assert ad.choose_spr(30000.0) == 1
+
+    def test_slow_tail_peers_prefer_full_copies(self):
+        """When the peers a larger quorum must include are very slow,
+        wide assignments (smaller quorum) win -> spr = d."""
+        ad = CrosswordAdaptive(5, 3, me=0, refit_interval=0.0)
+        for p in (1, 2):
+            feed(ad, p, alpha=0.1, beta=0.0001)   # fast, size-insensitive
+        for p in (3, 4):
+            feed(ad, p, alpha=1000.0, beta=0.0001)  # straggler tail
+        assert ad.choose_spr(30000.0) == 3
+
+    def test_no_samples_defaults_to_full_copy(self):
+        ad = CrosswordAdaptive(5, 3, me=0)
+        assert ad.choose_spr(30000.0) == 3
+        assert ad.overrides(4, 0.0) == [3, 3, 3, 3]
+
+    def test_qdisc_rate_folds_into_prediction(self):
+        ad = CrosswordAdaptive(3, 2, me=0, refit_interval=0.0)
+        feed(ad, 1, alpha=1.0, beta=0.0)
+        base = ad.predict_ms(1, 8000.0)
+        ad._qdisc.delay_ms = 5.0
+        ad._qdisc.rate_gbps = 0.001  # 1 Mbit/s emulated link
+        slow = ad.predict_ms(1, 8000.0)
+        # 8000 B at 1 Mbit/s = 64 ms serialization + 5 ms delay
+        assert slow - base > 60.0

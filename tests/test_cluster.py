@@ -31,12 +31,14 @@ def free_ports(n):
 
 
 class Cluster:
-    def __init__(self, protocol, n, tmpdir, config=None, tick=0.005):
+    def __init__(self, protocol, n, tmpdir, config=None, tick=0.005,
+                 num_groups=1):
         self.protocol = protocol
         self.n = n
         self.tmpdir = str(tmpdir)
         self.config = config or {}
         self.tick = tick
+        self.num_groups = num_groups
         ports = free_ports(2 + 2 * n)
         self.srv_port, self.cli_port = ports[0], ports[1]
         self.api_ports = ports[2:2 + n]
@@ -59,6 +61,22 @@ class Cluster:
                 loop.run_until_complete(man.run())
             except Exception:
                 pass
+            finally:
+                # drain pending tasks before closing so teardown does not
+                # spray "Event loop is closed" from orphaned callbacks
+                try:
+                    pending = asyncio.all_tasks(loop)
+                    for task in pending:
+                        task.cancel()
+                    if pending:
+                        loop.run_until_complete(
+                            asyncio.gather(
+                                *pending, return_exceptions=True
+                            )
+                        )
+                except Exception:
+                    pass
+                loop.close()
 
         t = threading.Thread(target=run_man, daemon=True)
         t.start()
@@ -89,6 +107,7 @@ class Cluster:
                 config=self.config,
                 tick_interval=self.tick,
                 window=32,
+                num_groups=self.num_groups,
                 backer_dir=self.tmpdir,
             )
             self.replicas[rep.me] = rep
@@ -112,13 +131,17 @@ class Cluster:
             self._man_loop.call_soon_threadsafe(self._man_loop.stop)
 
 
-@pytest.fixture(scope="module")
-def cluster(tmp_path_factory):
-    """One shared cluster for the whole tester suite — the reference CI
-    shape (workflow_test.py runs the full tester against one live
-    3-replica cluster) and the only way the suite fits the time budget
+@pytest.fixture(scope="module", params=["MultiPaxos", "Raft"])
+def cluster(request, tmp_path_factory):
+    """One shared cluster per protocol for the whole tester suite — the
+    reference CI shape (workflow_test.py runs the full tester against one
+    live 3-replica cluster, for MultiPaxos AND Raft per
+    tests_proc.yml:28-33) and the only way the suite fits the time budget
     (bring-up with jit compile dominates)."""
-    c = Cluster("MultiPaxos", 3, tmp_path_factory.mktemp("mp_cluster"))
+    c = Cluster(
+        request.param, 3,
+        tmp_path_factory.mktemp(f"{request.param.lower()}_cluster"),
+    )
     yield c
     c.stop()
 
@@ -132,7 +155,7 @@ def _check(cluster, results):
         raise AssertionError(f"{results}\nreplica states: {dumps}")
 
 
-class TestClusterMultiPaxos:
+class TestClusterTesterSuite:
     def test_tester_suite_basic(self, cluster):
         t = ClientTester(cluster.manager_addr, settle=1.5)
         results = t.run_tests([
@@ -164,3 +187,269 @@ class TestClusterMultiPaxos:
             "all_nodes_reset",
         ])
         _check(cluster, results)
+
+    def test_linearizable_history_under_faults(self, cluster):
+        """VERDICT r3 #6: record real client-observed histories while a
+        random fault schedule (pause/resume through the manager) runs,
+        then check linearizability per key (utils/linearize.py — the
+        executable TLA+ stand-in).  Runs for MultiPaxos AND Raft via the
+        cluster param."""
+        import random as _random
+
+        import threading as _threading
+
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.host.messages import CtrlRequest
+        from summerset_tpu.utils.linearize import (
+            check_history, record_get, record_put,
+        )
+
+        ops = []
+        stop = _threading.Event()
+
+        def worker(ci):
+            rng = _random.Random(100 + ci)
+            ep = GenericEndpoint(cluster.manager_addr)
+            ep.connect()
+            drv = DriverClosedLoop(ep, timeout=3.0)
+            seq = 0
+            while not stop.is_set():
+                key = f"lin{seq % 3}"
+                t0 = time.monotonic()
+                if rng.random() < 0.5:
+                    val = f"c{ci}-{seq}"
+                    rep = drv.put(key, val)
+                    t1 = time.monotonic()
+                    if rep.kind == "success":
+                        ops.append(record_put(ci, key, val, t0, t1, True))
+                    elif rep.kind in ("timeout", "failure"):
+                        # may or may not have executed
+                        ops.append(record_put(ci, key, val, t0, None,
+                                              False))
+                        drv._failover(rep)
+                    # redirect: server refused without proposing — no op
+                else:
+                    rep = drv.get(key)
+                    t1 = time.monotonic()
+                    if rep.kind == "success":
+                        val = rep.result.value if rep.result else None
+                        ops.append(record_get(ci, key, val, t0, t1))
+                    elif rep.kind in ("timeout", "failure"):
+                        drv._failover(rep)
+                seq += 1
+            try:
+                ep.leave()
+            except Exception:
+                pass
+
+        threads = [
+            _threading.Thread(target=worker, args=(ci,), daemon=True)
+            for ci in range(3)
+        ]
+        for t in threads:
+            t.start()
+        # fault schedule: pause a random victim mid-run, resume, repeat
+        ctl = GenericEndpoint(cluster.manager_addr)
+        ctl.connect()
+        rng = _random.Random(7)
+        try:
+            for _ in range(2):
+                time.sleep(1.5)
+                victim = rng.choice(sorted(ctl.servers))
+                ctl.ctrl.request(CtrlRequest(
+                    "pause_servers", servers=[victim]), timeout=30)
+                time.sleep(1.5)
+                ctl.ctrl.request(CtrlRequest(
+                    "resume_servers", servers=[victim]), timeout=30)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            ctl.leave()
+        assert len(ops) > 20, f"history too small: {len(ops)}"
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+    def test_snapshot_gc_and_recovery(self, cluster):
+        """Snapshot + WAL GC + crash recovery (VERDICT r3 #3; parity:
+        multipaxos/snapshot.rs:121-303): write enough to grow the WAL,
+        take a snapshot through the manager (WAL must measurably shrink),
+        crash-restart every node, and verify recovery from snapshot+tail
+        serves the correct values."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.host.messages import CtrlRequest
+
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        for i in range(12):
+            drv.checked_put(f"snapk{i}", f"v{i}")
+        time.sleep(1.0)  # let followers execute + log the tail
+        before = {
+            me: rep.wal.size for me, rep in cluster.replicas.items()
+        }
+        rep = ep.ctrl.request(
+            CtrlRequest("take_snapshot"), timeout=60
+        )
+        assert sorted(rep.done) == sorted(before), rep
+        shrunk = {
+            me: r.wal.size for me, r in cluster.replicas.items()
+        }
+        assert any(shrunk[me] < before[me] for me in shrunk), (
+            f"WAL did not shrink: {before} -> {shrunk}"
+        )
+        # crash-restart everyone: recovery = snapshot + WAL tail
+        ep.ctrl.request(
+            CtrlRequest("reset_servers", servers=None, durable=True),
+            timeout=180,
+        )
+        time.sleep(2.0)
+        ep2 = GenericEndpoint(cluster.manager_addr)
+        ep2.connect()
+        drv2 = DriverClosedLoop(ep2)
+        for i in range(12):
+            drv2.checked_get(f"snapk{i}", expect=f"v{i}")
+        ep2.leave()
+        ep.leave()
+
+
+@pytest.fixture(scope="module")
+def ql_cluster(tmp_path_factory):
+    c = Cluster(
+        "QuorumLeases", 3, tmp_path_factory.mktemp("ql_cluster"),
+    )
+    yield c
+    c.stop()
+
+
+class TestClusterQuorumLeases:
+    def test_conf_change_and_local_read(self, ql_cluster):
+        """A client installs a grantee conf through the data plane and a
+        non-leader then serves a leased LOCAL read (VERDICT r3 #2;
+        parity: quorumconf.rs conf flow + quorumlease.rs:10-17
+        is_local_reader)."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.host.messages import CtrlRequest
+
+        ep = GenericEndpoint(ql_cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        drv.checked_put("lease_key", "v1")
+        rep = drv.conf_change({"responders": [0, 1, 2]})
+        assert rep.kind == "success"
+        # the manager learned the new conf (reigner RespondersConf); the
+        # server->manager ctrl frame races the client's query, so poll
+        conf = None
+        for _ in range(50):
+            conf = ep.ctrl.request(CtrlRequest("query_conf"), timeout=10)
+            if conf.conf:
+                break
+            time.sleep(0.1)
+        assert conf.conf and sorted(conf.conf["responders"]) == [0, 1, 2]
+        leader = ep.ctrl.request(CtrlRequest("query_info")).leader or 0
+        follower = next(s for s in sorted(ep.servers) if s != leader)
+        ep2 = GenericEndpoint(ql_cluster.manager_addr, server_id=follower)
+        ep2.connect()
+        drv2 = DriverClosedLoop(ep2)
+        # leases need a few grant rounds to establish; a redirect means
+        # the follower can't serve locally yet
+        deadline = time.monotonic() + 30
+        got = None
+        while time.monotonic() < deadline:
+            r = drv2.get("lease_key")
+            if r.kind == "success" and r.local:
+                got = r
+                break
+            ep2.reconnect(follower)  # redirects bounce us off; come back
+            time.sleep(0.3)
+        assert got is not None, "follower never served a local read"
+        assert got.result.value == "v1"
+        ep2.leave()
+        ep.leave()
+
+    def test_linearizable_local_reads(self, ql_cluster):
+        """Lease local reads are the point of the linearizability harness
+        (VERDICT r3 #6): a writer streams unique values while readers
+        pinned to followers issue gets (served locally once leases are
+        quiescent); the combined observed history must check out."""
+        import threading as _threading
+
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.utils.linearize import (
+            check_history, record_get, record_put,
+        )
+
+        ops = []
+        stop = _threading.Event()
+
+        ep = GenericEndpoint(ql_cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        drv.conf_change({"responders": [0, 1, 2]})
+
+        def reader(ci, sid):
+            ep2 = GenericEndpoint(ql_cluster.manager_addr, server_id=sid)
+            ep2.connect()
+            drv2 = DriverClosedLoop(ep2, timeout=2.0)
+            while not stop.is_set():
+                t0 = time.monotonic()
+                rep = drv2.get("lr_key")
+                t1 = time.monotonic()
+                if rep.kind == "success":
+                    val = rep.result.value if rep.result else None
+                    ops.append(record_get(ci, "lr_key", val, t0, t1))
+                else:
+                    # bounced (not quiescent / not leased): come back
+                    ep2.reconnect(sid)
+                    time.sleep(0.05)
+            try:
+                ep2.leave()
+            except Exception:
+                pass
+
+        followers = sorted(ep.servers)[-2:]
+        threads = [
+            _threading.Thread(target=reader, args=(10 + i, sid),
+                              daemon=True)
+            for i, sid in enumerate(followers)
+        ]
+        for t in threads:
+            t.start()
+        for seq in range(10):
+            val = f"w-{seq}"
+            t0 = time.monotonic()
+            rep = drv.put("lr_key", val)
+            t1 = time.monotonic()
+            if rep.kind == "success":
+                ops.append(record_put(0, "lr_key", val, t0, t1, True))
+            elif rep.kind in ("timeout", "failure"):
+                ops.append(record_put(0, "lr_key", val, t0, None, False))
+                drv._failover(rep)
+            time.sleep(0.4)  # leases need quiescence to serve locally
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        ep.leave()
+        reads = [o for o in ops if o.kind == "get"]
+        assert len(reads) > 5, f"too few reads observed: {len(reads)}"
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+    def test_conf_rejected_without_conf_plane(self, cluster):
+        """No request kind is ever silently dropped: a conf request to a
+        conf-less protocol gets an explicit failure reply."""
+        from summerset_tpu.client.endpoint import GenericEndpoint
+
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        ep.send_conf(0, {"responders": [0]})
+        rep = ep.recv_reply(timeout=10)
+        while rep.req_id != 0 or rep.kind == "redirect":
+            rep = ep.recv_reply(timeout=10)
+        assert rep.kind == "conf" and not rep.success
+        ep.leave()
