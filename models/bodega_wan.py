@@ -1,0 +1,116 @@
+"""Bodega WAN analytical model: read/write latencies per client site
+under different read-serving strategies, on a ring-world geography.
+
+Parity role: reference ``models/bodega/calc_wan_delays.py`` (ring world
+of sites; per-strategy delay calculator) and the spirit of
+``plot_wan_quorums.py`` — re-derived, not translated: sites live on a
+ring of ``ticks`` positions, one-way delay between sites is proportional
+to ring distance, and each serving strategy maps a client site to the
+round trips its reads/writes take.
+
+Strategies compared (the design space Bodega sits in):
+- ``leader_reads``:   all ops to the leader (MultiPaxos baseline).
+- ``quorum_reads``:   reads contact a majority quorum nearest the client.
+- ``lease_local``:    reads served by the nearest roster responder
+                      (Bodega); writes pay leader + responder coverage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class RingWorld:
+    """Sites on a ring; distance = min ring hops (reference RingWorld)."""
+
+    ticks: int = 24
+    servers: List[int] = dataclasses.field(
+        default_factory=lambda: [3, 0, 18, 14, 12]
+    )
+    clients: List[int] = dataclasses.field(
+        default_factory=lambda: list(range(4)) + list(range(11, 20))
+    )
+    leader_idx: int = 4
+    ms_per_tick: float = 10.0
+
+    @property
+    def leader(self) -> int:
+        return self.servers[self.leader_idx]
+
+    def distance(self, a: int, b: int) -> int:
+        d = abs(a - b) % self.ticks
+        return min(d, self.ticks - d)
+
+    def delay_ms(self, a: int, b: int) -> float:
+        return self.distance(a, b) * self.ms_per_tick
+
+    def nearest_server(self, origin: int) -> int:
+        return min(self.servers, key=lambda s: self.distance(origin, s))
+
+    def quorum_rtt_ms(self, origin: int, size: int) -> float:
+        """RTT to the ``size``-th nearest server (parallel fan-out)."""
+        ds = sorted(self.distance(origin, s) for s in self.servers)
+        return 2 * ds[size - 1] * self.ms_per_tick
+
+    def quorum_incl_rtt_ms(self, origin: int, size: int,
+                           includes: List[int]) -> float:
+        """RTT of a quorum that must include ``includes`` (write barrier
+        covering every lease holder)."""
+        base = self.quorum_rtt_ms(origin, size)
+        incl = max(
+            (2 * self.delay_ms(origin, s) for s in includes), default=0.0
+        )
+        return max(base, incl)
+
+
+def site_latencies(world: RingWorld, strategy: str,
+                   responders: List[int] | None = None
+                   ) -> Dict[int, Dict[str, float]]:
+    """Per client site: read and write latency in ms for a strategy."""
+    n = len(world.servers)
+    maj = n // 2 + 1
+    resp = responders if responders is not None else list(world.servers)
+    out: Dict[int, Dict[str, float]] = {}
+    for c in world.clients:
+        to_leader = 2 * world.delay_ms(c, world.leader)
+        if strategy == "leader_reads":
+            r = to_leader
+            w = to_leader + world.quorum_rtt_ms(world.leader, maj)
+        elif strategy == "quorum_reads":
+            r = world.quorum_rtt_ms(c, maj)
+            w = to_leader + world.quorum_rtt_ms(world.leader, maj)
+        elif strategy == "lease_local":
+            near = min(resp, key=lambda s: world.distance(c, s))
+            r = 2 * world.delay_ms(c, near)
+            # writes must reach the leader, then cover a quorum AND every
+            # responder of the key (bodega localread.rs:32-56)
+            w = to_leader + world.quorum_incl_rtt_ms(
+                world.leader, maj, resp
+            )
+        else:
+            raise ValueError(strategy)
+        out[c] = {"read_ms": r, "write_ms": w}
+    return out
+
+
+def mean_latency_ms(world: RingWorld, strategy: str,
+                    put_ratio: float = 0.1,
+                    responders: List[int] | None = None) -> float:
+    per = site_latencies(world, strategy, responders)
+    acc = [
+        put_ratio * v["write_ms"] + (1 - put_ratio) * v["read_ms"]
+        for v in per.values()
+    ]
+    return sum(acc) / len(acc)
+
+
+if __name__ == "__main__":
+    w = RingWorld()
+    for strat in ("leader_reads", "quorum_reads", "lease_local"):
+        print(
+            f"{strat:13s}: mean op latency "
+            f"{mean_latency_ms(w, strat):7.1f} ms "
+            f"(put_ratio 0.1)"
+        )

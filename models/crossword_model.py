@@ -1,0 +1,120 @@
+"""Crossword analytical model: the (quorum size, shards-per-replica)
+constraint frontier and critical-path response-time distribution.
+
+Parity role: reference ``models/crossword/{plot_cstr_bounds,
+prob_calculation}.py`` — an analytical companion to the protocol, used to
+reason about which assignments are valid and which minimize expected
+commit latency under heavy-tailed per-link delay.  Re-derived here (not
+translated): same constraint algebra, same Pareto-jitter delay model,
+matplotlib plotting optional (the environment is headless; the numbers
+are the product).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Tuple
+
+
+def valid_assignments(n: int, d: int, fault_tolerance: int = 0,
+                      shards_per_disjoint: int = 1
+                      ) -> List[Tuple[int, int]]:
+    """(commit-ack count q, shards-per-replica spr) pairs per Crossword's
+    commit condition: q = max(majority, f + 1 + ceil((d - spr) / dj)) —
+    quorum AND worst-case f+1-survivor coverage of all d shards (the
+    kernel's ``_commit_need``, crossword.py; ref messages.rs:15-62).
+    Defaults: f = (n - majority) // 2 when 0 is passed and n > 3."""
+    maj = n // 2 + 1
+    f = fault_tolerance
+    dj = shards_per_disjoint
+    out = []
+    for spr in range(1, d + 1):
+        cov = f + 1 + max(0, -((-(d - spr)) // dj))
+        out.append((max(maj, cov), spr))
+    return out
+
+
+def shard_loss_tolerance(n: int, d: int, spr: int) -> int:
+    """How many replica losses keep d distinct shards available
+    (round-robin assignment): f such that any n-f replicas still cover
+    all d shards."""
+    for f in range(n, -1, -1):
+        # worst case: the f lost replicas are consecutive in the ring —
+        # the survivors still cover every shard iff n - f >= d - spr + 1
+        if n - f >= d - spr + 1 and n - f >= n // 2 + 1:
+            return f
+    return 0
+
+
+def rand_link_time_ms(
+    size_kb: float, spr: int, d: int,
+    delay_ms: float, bw_gbps: float, jitter_pct: float,
+    rng: random.Random, pareto_alpha: float = 1.16,
+) -> float:
+    """One peer's delivery time: min delay + Pareto-tail jitter +
+    serialization of its spr/d slice of the instance."""
+    pareto = rng.paretovariate(pareto_alpha)
+    while pareto > 10:
+        pareto = rng.paretovariate(pareto_alpha)
+    t = delay_ms + delay_ms * (jitter_pct / 100.0) * (pareto - 1)
+    t += (size_kb * spr / d) / (bw_gbps * 1024 / 8)  # KB over Gbps -> ms
+    return t
+
+
+def response_time_sample(
+    n: int, q: int, spr: int, d: int, size_kb: float,
+    delay_ms: float, bw_gbps: float, jitter_pct: float,
+    rng: random.Random,
+) -> float:
+    """Leader-side commit time: the (q-1)-th fastest of n-1 peer
+    deliveries (the leader acks itself)."""
+    times = sorted(
+        rand_link_time_ms(size_kb, spr, d, delay_ms, bw_gbps,
+                          jitter_pct, rng)
+        for _ in range(n - 1)
+    )
+    return times[q - 2] if q >= 2 else 0.0
+
+
+def expected_commit_ms(
+    n: int, d: int, size_kb: float, delay_ms: float, bw_gbps: float,
+    jitter_pct: float = 25.0, trials: int = 2000, seed: int = 7,
+) -> Dict[Tuple[int, int], float]:
+    """Mean commit latency per valid (q, spr) assignment — the table the
+    adaptive policy optimizes over."""
+    rng = random.Random(seed)
+    out = {}
+    f = (n // 2) // 2  # the orchestration scripts' default FT for n >= 5
+    for q, spr in valid_assignments(n, d, fault_tolerance=f):
+        acc = 0.0
+        for _ in range(trials):
+            acc += response_time_sample(
+                n, q, spr, d, size_kb, delay_ms, bw_gbps, jitter_pct, rng
+            )
+        out[(q, spr)] = acc / trials
+    return out
+
+
+def best_assignment(
+    n: int, d: int, size_kb: float, delay_ms: float, bw_gbps: float,
+    **kw,
+) -> Tuple[int, int]:
+    table = expected_commit_ms(n, d, size_kb, delay_ms, bw_gbps, **kw)
+    return min(table, key=table.get)
+
+
+if __name__ == "__main__":
+    n, d = 5, 3
+    print("valid (q, spr):", valid_assignments(n, d))
+    for size in (8, 256, 4096):
+        for delay, bw in ((10, 100), (50, 10), (120, 1)):
+            tbl = expected_commit_ms(n, d, size, delay, bw)
+            best = min(tbl, key=tbl.get)
+            print(
+                f"size {size:5}KB delay {delay:3}ms bw {bw:3}Gbps -> "
+                f"best (q, spr) = {best}, "
+                + " ".join(
+                    f"{k}:{v:.1f}ms" for k, v in sorted(tbl.items())
+                )
+            )

@@ -1,0 +1,96 @@
+"""RS-coding size-sweep performance bench.
+
+Parity: reference ``benches/rse_bench.rs:17-26`` — criterion benchmark of
+Reed-Solomon encode (compute_parity) and decode (reconstruct_data)
+across value sizes 4KB..4MB at scheme (3, 2).  Here the kernel is the
+bit-sliced GF(2^8) matmul (ops/rscoding.py), run on whatever platform
+JAX selects (TPU under axon; set JAX_PLATFORMS=cpu to force CPU).
+
+Prints one line per (op, size) with time/op and goodput, then a JSON
+summary line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-shards", type=int, default=3)
+    ap.add_argument("--parity-shards", type=int, default=2)
+    ap.add_argument("--sizes", default="4096,65536,1048576,4194304")
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from summerset_tpu.ops.rscoding import RSCode, pack_bytes
+
+    d, p = args.data_shards, args.parity_shards
+    code = RSCode(d, p)
+    results = []
+    for size in (int(s) for s in args.sizes.split(",")):
+        buf = np.random.default_rng(7).integers(
+            0, 256, size, dtype=np.uint8
+        ).tobytes()
+        data = jnp.asarray(pack_bytes(buf, d))
+
+        def encode():
+            return code.compute_parity(data)
+
+        parity = jax.block_until_ready(encode())
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            jax.block_until_ready(encode())
+        enc_us = (time.perf_counter() - t0) / args.reps * 1e6
+
+        # decode: drop data shard 0, reconstruct from d survivors
+        present = tuple(range(1, d)) + (d,)
+        avail = jnp.concatenate([data[1:], parity[:1]], axis=0)
+
+        def decode():
+            return code.reconstruct_data(avail, present)
+
+        jax.block_until_ready(decode())
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            jax.block_until_ready(decode())
+        dec_us = (time.perf_counter() - t0) / args.reps * 1e6
+
+        enc_gbps = size / (enc_us / 1e6) / 1e9
+        dec_gbps = size / (dec_us / 1e6) / 1e9
+        print(
+            f"size {size:>8}B  encode {enc_us:9.1f}us ({enc_gbps:6.2f} GB/s)"
+            f"  decode {dec_us:9.1f}us ({dec_gbps:6.2f} GB/s)",
+            flush=True,
+        )
+        results.append({
+            "size": size,
+            "encode_us": round(enc_us, 1),
+            "decode_us": round(dec_us, 1),
+            "encode_gbps": round(enc_gbps, 3),
+            "decode_gbps": round(dec_gbps, 3),
+        })
+    print(json.dumps({
+        "scheme": [d, p],
+        "platform": jax.devices()[0].platform,
+        "sweep": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ def main(argv=None) -> None:
     ap.add_argument("--put-ratio", type=float, default=0.5)
     ap.add_argument("--value-size", default="128")
     ap.add_argument("--num-keys", type=int, default=5)
+    ap.add_argument("--trace-file", default=None)  # YCSB run log replay
     # tester knobs
     ap.add_argument("--tests", default="")
     # mess knobs
@@ -41,6 +42,8 @@ def main(argv=None) -> None:
     if args.utility == "repl":
         ClientRepl(addr).run()
     elif args.utility == "bench":
+        from ..client.bench import load_ycsb_trace
+
         ep = GenericEndpoint(addr)
         ep.connect()
         summary = ClientBench(
@@ -50,6 +53,10 @@ def main(argv=None) -> None:
             put_ratio=args.put_ratio,
             value_size=args.value_size,
             num_keys=args.num_keys,
+            trace=(
+                load_ycsb_trace(args.trace_file)
+                if args.trace_file else None
+            ),
         ).run()
         ep.leave()
         print(json.dumps(summary))

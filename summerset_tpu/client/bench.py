@@ -23,6 +23,31 @@ from .endpoint import GenericEndpoint
 logger = pf_logger("bench")
 
 
+def load_ycsb_trace(path: str) -> List[Tuple[str, str, Optional[str]]]:
+    """Load a YCSB run log into a ClientBench trace.
+
+    Parity: the reference bench replays YCSB trace files
+    (``clients/bench.rs`` ycsb trace support; lines shaped
+    ``READ usertable <key> ...`` / ``UPDATE usertable <key> [field=...]``
+    / ``INSERT ...``).  SCANs degrade to point reads (the KV surface has
+    no range scan, matching the reference's mapping)."""
+    trace: List[Tuple[str, str, Optional[str]]] = []
+    with open(path) as f:
+        for line in f:
+            toks = line.split()
+            if len(toks) < 3:
+                continue
+            op = toks[0].upper()
+            if op in ("READ", "SCAN"):
+                trace.append(("get", toks[2], None))
+            elif op in ("UPDATE", "INSERT"):
+                val: Optional[str] = None
+                if "[" in line:
+                    val = line.split("[", 1)[1].rsplit("]", 1)[0].strip()
+                trace.append(("put", toks[2], val))
+    return trace
+
+
 def parse_value_schedule(spec: str) -> List[Tuple[float, int]]:
     """"t1:v1/t2:v2" -> [(t_from, size)]; a bare "128" means a constant
     size from t=0 (bench.rs value-size schedule)."""

@@ -23,10 +23,21 @@ class PayloadStore:
         self._next = [1] * num_groups
         self._data: list[Dict[int, Any]] = [dict() for _ in range(num_groups)]
 
-    def put(self, group: int, batch: Any) -> int:
-        """Store a request batch, returning its value id (>= 1)."""
+    def put(self, group: int, batch: Any, stride: int = 1,
+            residue: int = 0) -> int:
+        """Store a request batch, returning its value id (>= 1).
+
+        ``stride``/``residue`` partition the id space between concurrent
+        proposers (one residue class per replica): without this, two
+        servers proposing in the same tick mint the same id for
+        different batches and the payload exchange silently cross-wires
+        them (first-writer-wins at every peer)."""
         with self._lock:
             vid = self._next[group]
+            if stride > 1:
+                vid += (residue - vid) % stride
+            if vid < 1:
+                vid += stride
             self._next[group] = vid + 1
             self._data[group][vid] = batch
         return vid

@@ -104,7 +104,7 @@ class ServerReplica:
 
         # protocol kernel over [G, R]; host applier drives the exec bar
         kercfg_cls = type(
-            make_protocol(protocol, 1, self.population, 64).config
+            make_protocol(protocol, 1, self.population, 32).config
         )
         known = {f.name for f in dataclasses.fields(kercfg_cls)}
         kcfg = kercfg_cls(**{k: v for k, v in cfg.items() if k in known})
@@ -112,6 +112,12 @@ class ServerReplica:
             kcfg.exec_follows_commit = False
         if hasattr(kcfg, "max_proposals_per_tick"):
             kcfg.max_proposals_per_tick = 1  # one ReqBatch per group/tick
+        if protocol == "EPaxos":
+            # host vids are sequential per group, so key->bucket conflict
+            # detection cannot ride vid % K: collapse to one bucket —
+            # every command interferes (safe total order; the per-key
+            # concurrency axis needs key-residue vid allocation, future)
+            kcfg.num_key_buckets = 1
         self.kernel = make_protocol(
             protocol, self.G, self.population, window, kcfg
         )
@@ -156,6 +162,17 @@ class ServerReplica:
         )
         self._conf_active: Optional[dict] = None
         self._conf_queue: List[Tuple[int, ApiRequest]] = []
+        # EPaxos: leaderless — every replica proposes into its own row;
+        # execution runs through the exact host Tarjan applier
+        self._epaxos = "st2" in self.state
+        self._ep_exec: Dict[int, Any] = {}
+        if self._epaxos:
+            from .epaxos_exec import EPaxosExecutor
+
+            for g in range(self.G):
+                self._ep_exec[g] = EPaxosExecutor(
+                    self.population, window, self._make_ep_apply(g)
+                )
         # Crossword: host predictive shard-assignment (linreg + qdisc)
         self._adaptive = None
         if "cur_spr" in self.state:
@@ -220,14 +237,21 @@ class ServerReplica:
             return
         try:
             with open(self.snap_path, "rb") as f:
-                kind, kv, floors = pickle.load(f)
+                kind, kv, meta = pickle.load(f)
         except Exception as e:
             pf_warn(logger, f"snapshot unreadable, ignoring: {e}")
             return
         assert kind == "kv"
         self.statemach._kv.update(kv)
+        floors = meta["applied"]
         for g, fl in enumerate(floors[: self.G]):
             self.applied[g] = max(self.applied[g], int(fl))
+        for g, rows in enumerate(meta.get("ep_rows", [])[: self.G]):
+            ex = self._ep_exec.get(g)
+            if ex is not None:
+                ex.floor = [
+                    max(a, int(b)) for a, b in zip(ex.floor, rows)
+                ]
         pf_info(
             logger,
             f"recovered snapshot: {len(kv)} keys, floors {floors[:4]}...",
@@ -255,6 +279,24 @@ class ServerReplica:
                         self.payloads._next[g], vid + 1
                     )
                     self._logged_vids[g].add(vid)
+            elif isinstance(rec, tuple) and rec and rec[0] == "eapply":
+                # EPaxos exec record: replay in logged (= execution)
+                # order; per-row floors advance contiguously
+                _, g, row, col, vid, batch = rec
+                if batch is not None:
+                    self.payloads._data[g][vid] = batch
+                    self.payloads._next[g] = max(
+                        self.payloads._next[g], vid + 1
+                    )
+                    for client, req in batch:
+                        if req.cmd is not None:
+                            apply_command(self.statemach._kv, req.cmd)
+                ex = self._ep_exec.get(g)
+                if ex is not None and col >= ex.floor[row]:
+                    ex.floor[row] = col + 1
+                self.applied[g] = sum(
+                    self._ep_exec[g].floor
+                ) if g in self._ep_exec else self.applied[g]
             else:
                 g, slot, vid, batch = rec
                 self.payloads._data[g][vid] = batch
@@ -313,7 +355,7 @@ class ServerReplica:
         for g in dirty:
             g = int(g)
             new_pp = {}
-            for vid in set(int(x) for x in val_win[g]):
+            for vid in set(int(x) for x in val_win[g].ravel()):
                 if vid > 0 and vid not in self._logged_vids[g]:
                     b = self.payloads.get(g, vid)
                     if b is not None:
@@ -336,9 +378,14 @@ class ServerReplica:
         replaced atomically instead of appended (same recovery semantics,
         'production would use an LSM-tree' note mod.rs:278-280)."""
         kv = self.statemach.snapshot_items()
+        meta: Dict[str, Any] = {"applied": list(self.applied)}
+        if self._epaxos:
+            meta["ep_rows"] = [
+                list(self._ep_exec[g].floor) for g in range(self.G)
+            ]
         tmp = self.snap_path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(("kv", kv, list(self.applied)), f)
+            pickle.dump(("kv", kv, meta), f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.snap_path)
@@ -361,7 +408,7 @@ class ServerReplica:
         new_logged: Dict[int, set] = {}
         for g in range(self.G):
             pp = {}
-            for vid in set(int(x) for x in val_win[g]):
+            for vid in set(int(x) for x in val_win[g].ravel()):
                 b = self.payloads.get(g, vid) if vid > 0 else None
                 if b is not None:
                     pp[vid] = b
@@ -505,7 +552,7 @@ class ServerReplica:
                     self.group_of(req.cmd.key), []
                 ).append((client, req))
         for g, reqs in by_group.items():
-            if not self._is_leader[g]:
+            if not self._epaxos and not self._is_leader[g]:
                 pending = []
                 local_ok = self._can_local_read(g)
                 for client, req in reqs:
@@ -524,7 +571,9 @@ class ServerReplica:
                         success=False,
                     ))
                 continue
-            vid = self.payloads.put(g, reqs)
+            vid = self.payloads.put(
+                g, reqs, stride=self.population, residue=self.me
+            )
             self.origin.add((g, vid))
             n_prop[g] = 1
             vbase[g] = vid
@@ -546,11 +595,23 @@ class ServerReplica:
             resp = 0
             for r in d.get("responders", []):
                 resp |= 1 << int(r)
+            lead = d.get("leader")
+            if lead is None:
+                # a responders-only change must NOT move the leader: the
+                # target defaults to the current conf leader (Bodega), or
+                # stays unused (QL, whose conf plane carries no leader)
+                if "conf_leader" in self.state:
+                    cur = int(
+                        np.asarray(self.state["conf_leader"])[0, self.me]
+                    )
+                    lead = cur if cur >= 0 else self.me
+                else:
+                    lead = -1
             self._conf_active = {
                 "client": client,
                 "req_id": req.req_id,
                 "resp": resp,
-                "leader": int(d.get("leader", self.me)),
+                "leader": int(lead),
                 "deadline": self.tick + 2000,
             }
         a = self._conf_active
@@ -588,15 +649,17 @@ class ServerReplica:
             self._reply(a["client"], ApiReply(
                 "conf", req_id=a["req_id"], success=True,
             ))
-            self.ctrl.send_ctrl(CtrlMsg("responders_conf", {
-                "new_conf": {
-                    "responders": [
-                        r for r in range(self.population)
-                        if a["resp"] >> r & 1
-                    ],
-                    "leader": a["leader"],
-                },
-            }))
+            new_conf = {
+                "responders": [
+                    r for r in range(self.population)
+                    if a["resp"] >> r & 1
+                ],
+            }
+            if a["leader"] >= 0:  # QL's conf plane carries no leader
+                new_conf["leader"] = a["leader"]
+            self.ctrl.send_ctrl(CtrlMsg(
+                "responders_conf", {"new_conf": new_conf}
+            ))
             self._conf_active = None
         elif self.tick > a["deadline"]:
             self._reply(a["client"], ApiReply(
@@ -664,6 +727,16 @@ class ServerReplica:
                 ),
             }
             self._conf_inputs(inputs)
+            if self._epaxos:
+                floors = np.zeros(
+                    (self.G, self.population, self.population), np.int32
+                )
+                for g in range(self.G):
+                    floors[g, self.me, :] = self._ep_exec[g].floor
+                inputs["exec_floor_rows"] = jnp.asarray(floors)
+                inputs["prop_replica"] = jnp.full(
+                    (self.G,), self.me, jnp.int32
+                )
             if self._adaptive is not None:
                 while self.transport.samples:
                     try:
@@ -754,7 +827,7 @@ class ServerReplica:
         overwrite newer local execution (this was possible before r4)."""
         ok_groups = {
             g for g in self.kv_need
-            if g < len(floors) and floors[g] >= self.applied[g]
+            if g < len(floors) and floors[g] > self.applied[g]
         }
         if not ok_groups:
             return
@@ -767,16 +840,77 @@ class ServerReplica:
             self.kv_need.discard(g)
 
     # ------------------------------------------------------- application
+    def _make_ep_apply(self, g: int):
+        """Build the EPaxos executor's apply callback for group ``g``:
+        WAL-log the exec record, apply to the KV, reply to originated
+        clients (parity: epaxos/execution.rs commit_execute path)."""
+        def apply_fn(row: int, col: int, vid: int, noop: bool) -> None:
+            batch = (
+                None if (noop or vid == 0) else self.payloads.get(g, vid)
+            )
+            self.wal.do_sync_action(LogAction(
+                "append", entry=("eapply", g, row, col, vid, batch),
+                sync=True,
+            ))
+            if batch is not None:
+                mine = (g, vid) in self.origin
+                for client, req in batch:
+                    res = apply_command(self.statemach._kv, req.cmd)
+                    if mine:
+                        self._reply(client, ApiReply(
+                            "reply", req_id=req.req_id, result=res,
+                        ))
+        return apply_fn
+
+    def _apply_committed_epaxos(self) -> None:
+        me = self.me
+        st = self.state
+        cmt = np.asarray(st["cmt_row"])[:, me]
+        arrs = None
+        for g in range(self.G):
+            ex = self._ep_exec[g]
+            if int(cmt[g].sum()) <= sum(ex.floor):
+                continue
+            if arrs is None:
+                arrs = {
+                    k: np.asarray(st[k])[:, me]
+                    for k in ("abs2", "st2", "seq2", "val2", "noop2",
+                              "deps2")
+                }
+
+            def payload_ok(vid: int, noop: bool, g=g) -> bool:
+                if noop or vid == 0:
+                    return True
+                if self.payloads.get(g, vid) is None:
+                    self.missing.add((g, vid))
+                    return False
+                return True
+
+            ex.advance(
+                arrs["abs2"][g], arrs["st2"][g], arrs["seq2"][g],
+                arrs["val2"][g], arrs["noop2"][g], arrs["deps2"][g],
+                cmt[g], payload_ok,
+            )
+            self.applied[g] = sum(ex.floor)
+
     def _apply_committed(self, fx) -> None:
         self._last_extra = {
             k: np.asarray(v) for k, v in fx.extra.items()
         }
+        if self._epaxos:
+            self._apply_committed_epaxos()
+            return
         cbs = np.asarray(fx.commit_bar)[:, self.me]
         applied = np.asarray(self.applied)
         for g in np.nonzero(cbs > applied)[0]:
             self._apply_group(int(g), int(cbs[g]))
 
     def _apply_group(self, g: int, cb: int) -> None:
+        if g in self.kv_need:
+            # a window jump is pending its KV transfer: applying further
+            # slots against a KV missing the jumped range would serve
+            # stale values — hold the exec floor until the merge lands
+            return
         win_abs = np.asarray(self.state["win_abs"])[g, self.me]
         win_val = np.asarray(self.state[self.kernel.VALUE_WINDOW])[
             g, self.me
@@ -792,9 +926,11 @@ class ServerReplica:
             pos = np.where(win_abs == slot)[0]
             if len(pos) == 0:
                 # below the window: an install-snapshot jumped us forward;
-                # fetch the KV state from peers host-side
+                # fetch the KV state from peers host-side.  applied[g] is
+                # NOT advanced — the provider's floor covers the jump, so
+                # the merge both fills the KV and moves the floor (moving
+                # it here would let later slots execute over a hole)
                 self.kv_need.add(g)
-                self.applied[g] = cb
                 return
             is_marker = bool(marker[pos[0]])
             vid = 0 if is_marker else int(win_val[pos[0]])

@@ -105,6 +105,31 @@ class EPaxosKernel(ProtocolKernel):
         "rv_val", "rv_noop", "rv_deps",
     })
 
+    # durable acceptor record: the whole 2-D stored-copy space plus the
+    # interference tables and own-row cursor (parity: the reference WAL-
+    # logs every instance status transition, epaxos/durability.rs; the
+    # tables must survive restart or new proposals could under-detect
+    # interference and break execution order)
+    DURABLE_SCALARS = ("own_next",)
+    DURABLE_WINDOWS = (
+        "abs2", "st2", "bal2", "seq2", "val2", "noop2", "deps2",
+        "it_col", "it_seq",
+    )
+    VALUE_WINDOW = "val2"
+
+    def restore_durable(self, st, g, me, rec, floor):
+        i32 = jnp.int32
+        st["own_next"] = st["own_next"].at[g, me].set(
+            i32(rec["own_next"])
+        )
+        for k in self.DURABLE_WINDOWS:
+            st[k] = st[k].at[g, me].set(jnp.asarray(rec[k], st[k].dtype))
+        # own seen frontier covers the restored own row; cmt_row/exec_row
+        # re-derive from the window content and the host exec floors
+        st["seen_bar"] = st["seen_bar"].at[g, me, me].set(
+            i32(rec["own_next"])
+        )
+
     def __init__(
         self,
         num_groups: int,
@@ -634,7 +659,21 @@ class EPaxosKernel(ProtocolKernel):
         n_prop = jnp.broadcast_to(
             c.inputs["n_proposals"][:, None].astype(i32), (G, R)
         )
-        share = n_prop // R + (rid < (n_prop % R)).astype(i32)
+        # host-serving mode: ``prop_replica`` [G] names the ONE replica
+        # proposing this tick (its host owns the payload vids), and value
+        # ids are used verbatim; without it (device bench mode) the count
+        # splits across all command leaders with rid-interleaved ids
+        pr = c.inputs.get("prop_replica")
+        if pr is None:
+            pr2 = jnp.full((G, R), -1, i32)
+        else:
+            pr2 = jnp.broadcast_to(pr[:, None].astype(i32), (G, R))
+        host_mode = pr2 >= 0
+        share = jnp.where(
+            host_mode,
+            jnp.where(rid == pr2, n_prop, 0),
+            n_prop // R + (rid < (n_prop % R)).astype(i32),
+        )
         own_exec = jnp.take_along_axis(
             s["exec_row"], rid[..., None], axis=2
         )[..., 0]
@@ -646,7 +685,11 @@ class EPaxosKernel(ProtocolKernel):
         m_new, abs_new = range_cover(s["own_next"], s["own_next"] + n_new, W)
         off = abs_new - s["own_next"][..., None]
         # distinct value ids across replicas: interleave by rid
-        new_vals = vbase[..., None] * R + rid[..., None] + off * R
+        new_vals = jnp.where(
+            host_mode[..., None],
+            vbase[..., None] + off,
+            vbase[..., None] * R + rid[..., None] + off * R,
+        )
         bucket = new_vals % K
 
         # seq0/deps0 from my tables

@@ -1,0 +1,64 @@
+"""Bench toolkit units: YCSB trace loading, value-size schedules, and
+the external-system adapters' pure mapping + gating."""
+
+import pytest
+
+from summerset_tpu.client.bench import load_ycsb_trace, parse_value_schedule
+from summerset_tpu.client.external_systems import (
+    decode_value,
+    encode_value,
+    zk_path,
+)
+from summerset_tpu.utils.errors import SummersetError
+
+
+class TestValueSchedule:
+    def test_bare_int(self):
+        assert parse_value_schedule("128") == [(0.0, 128)]
+
+    def test_schedule(self):
+        assert parse_value_schedule("0:64/5:1024") == [
+            (0.0, 64), (5.0, 1024),
+        ]
+
+
+class TestYcsbTrace:
+    def test_load(self, tmp_path):
+        p = tmp_path / "run.log"
+        p.write_text(
+            "READ usertable user1 [ field0 ]\n"
+            "UPDATE usertable user2 [ field0=hello ]\n"
+            "INSERT usertable user3 [ field0=init ]\n"
+            "SCAN usertable user4 17 [ field0 ]\n"
+            "OVERALL, RunTime(ms), 123\n"
+            "short\n"
+        )
+        trace = load_ycsb_trace(str(p))
+        assert trace == [
+            ("get", "user1", None),
+            ("put", "user2", "field0=hello"),
+            ("put", "user3", "field0=init"),
+            ("get", "user4", None),
+        ]
+
+
+class TestExternalAdapters:
+    def test_zk_path_mapping(self):
+        assert zk_path("/summerset", "a/b") == "/summerset/a_b"
+        assert zk_path("/summerset/", "k") == "/summerset/k"
+
+    def test_value_roundtrip(self):
+        assert decode_value(encode_value("héllo")) == "héllo"
+        assert decode_value(None) is None
+
+    def test_zookeeper_gated_without_kazoo(self):
+        from summerset_tpu.client.external_systems import ZooKeeperSession
+
+        with pytest.raises((SummersetError, Exception)):
+            ZooKeeperSession("127.0.0.1:2181", timeout=0.1)
+
+    def test_etcd_gated_without_etcd3(self):
+        from summerset_tpu.client.external_systems import EtcdKvClient
+
+        with pytest.raises((SummersetError, Exception)):
+            EtcdKvClient(("127.0.0.1", 2379), timeout=0.1)

@@ -227,6 +227,56 @@ class TestAdjacentFailures:
         check_agreement(post, G, R)
 
 
+class TestConcurrentRecoverers:
+    def test_recoverer_dies_midway_successor_uses_higher_ballot(self):
+        """Regression for the r2 recovery fix (VERDICT r3 #8): two
+        recoverers touch the same dead row at DIFFERENT ERP ballots — the
+        first successor starts the campaign and then dies itself; the
+        next-in-ring successor must re-campaign at a strictly higher
+        ballot and finish, with every survivor agreeing on the outcome
+        (reference ladder: dependency.rs:249-330)."""
+        G, R, W, P = 1, 5, 32, 5
+        eng = Engine(make_kernel(G, R, W, P, alive_timeout=10))
+        state, ns = eng.init()
+        state, ns, _ = run(eng, state, ns, 20, n_prop=P)
+        pre = np_state(state)
+
+        # kill row 0's owner; run just past the alive timeout so the
+        # first successor (r1) has STARTED recovering row 0
+        alive1 = jnp.ones((G, R), jnp.bool_).at[:, 0].set(False)
+        state, ns, _ = run(
+            eng, state, ns, 14, n_prop=0, alive=alive1, base_start=1000
+        )
+        mid = np_state(state)
+        bal1 = int(mid["rec_bal"][0, 1]) if mid["rec_row"][0, 1] == 0 else 0
+
+        # now the first recoverer dies mid-flight too: r2 takes over
+        alive2 = alive1.at[:, 1].set(False)
+        state, ns, _ = run(
+            eng, state, ns, 200, n_prop=P, alive=alive2, base_start=2000
+        )
+        post = np_state(state)
+        live = [2, 3, 4]
+        # rows 0 and 1 fully resolved at every survivor
+        for dead_row in (0, 1):
+            ext = post["ext_row"][:, live, dead_row].max(axis=1)
+            for r in live:
+                assert (post["cmt_row"][:, r, dead_row] >= ext).all(), (
+                    dead_row, r, post["cmt_row"][0, :, dead_row], ext
+                )
+        # the second campaign outbid the first (per-row ballot monotone)
+        if bal1 > 0:
+            assert int(post["rbm"][0, 2:, 0].max()) > bal1
+        check_agreement(post, G, R)
+        # nothing committed before the failures was lost or changed
+        before = committed_instances(pre, 0, 1)
+        for r in live:
+            after = committed_instances(post, 0, r)
+            for slot, v in before.items():
+                if slot in after:
+                    assert after[slot][0] == v[0], (r, slot, v, after[slot])
+
+
 class TestLossyNetwork:
     def test_agreement_under_drops(self):
         G, R, W, P = 2, 5, 32, 5

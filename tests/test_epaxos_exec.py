@@ -1,0 +1,127 @@
+"""Host EPaxos Tarjan applier tests (parity: the reference's dependency-
+graph execution, ``epaxos/execution.rs:11-87``: SCC condensation in
+topological order, sequence-number order within an SCC)."""
+
+import numpy as np
+
+from summerset_tpu.host.epaxos_exec import COMMITTED, EPaxosExecutor
+
+
+def make_space(R, W, instances):
+    """instances: {(row, col): (seq, vid, noop, {row: dep_bar})}
+    where dep_bar is EXCLUSIVE (columns < bar are dependencies)."""
+    abs2 = np.full((R, W), -1, np.int64)
+    st2 = np.zeros((R, W), np.int64)
+    seq2 = np.zeros((R, W), np.int64)
+    val2 = np.zeros((R, W), np.int64)
+    noop2 = np.zeros((R, W), bool)
+    deps2 = np.zeros((R, W, R), np.int64)  # 0 = no dep (bars)
+    for (r, c), (seq, vid, noop, deps) in instances.items():
+        p = c % W
+        abs2[r, p] = c
+        st2[r, p] = COMMITTED
+        seq2[r, p] = seq
+        val2[r, p] = vid
+        noop2[r, p] = noop
+        for r2, d in deps.items():
+            deps2[r, p, r2] = d
+    return abs2, st2, seq2, val2, noop2, deps2
+
+
+class TestExecutor:
+    def test_independent_rows_execute_to_frontier(self):
+        R, W = 3, 8
+        order = []
+        ex = EPaxosExecutor(R, W, lambda r, c, v, n: order.append((r, c)))
+        space = make_space(R, W, {
+            (0, 0): (1, 10, False, {}),
+            (0, 1): (2, 11, False, {}),
+            (1, 0): (1, 20, False, {}),
+        })
+        floors = ex.advance(*space, np.array([2, 1, 0]))
+        assert floors == [2, 1, 0]
+        assert set(order) == {(0, 0), (0, 1), (1, 0)}
+        # own-row order is linear
+        assert order.index((0, 0)) < order.index((0, 1))
+
+    def test_dependency_order_across_rows(self):
+        R, W = 3, 8
+        order = []
+        ex = EPaxosExecutor(R, W, lambda r, c, v, n: order.append((r, c)))
+        # (1,0) depends on row 0 below bar 1 -> (0,0) first
+        space = make_space(R, W, {
+            (0, 0): (1, 10, False, {}),
+            (1, 0): (5, 20, False, {0: 1}),
+        })
+        ex.advance(*space, np.array([1, 1, 0]))
+        assert order == [(0, 0), (1, 0)]
+
+    def test_cycle_breaks_by_seq(self):
+        R, W = 2, 8
+        order = []
+        ex = EPaxosExecutor(R, W, lambda r, c, v, n: order.append((r, c)))
+        # mutual deps (the classic interference cycle): both committed,
+        # each deps the other -> one SCC, executed in seq order
+        space = make_space(R, W, {
+            (0, 0): (7, 10, False, {1: 1}),
+            (1, 0): (3, 20, False, {0: 1}),
+        })
+        ex.advance(*space, np.array([1, 1]))
+        assert order == [(1, 0), (0, 0)]  # seq 3 before seq 7
+
+    def test_uncommitted_dependency_blocks(self):
+        R, W = 2, 8
+        order = []
+        ex = EPaxosExecutor(R, W, lambda r, c, v, n: order.append((r, c)))
+        # (0,0) deps row 1 below bar 1, but row 1 committed nothing
+        space = make_space(R, W, {
+            (0, 0): (1, 10, False, {1: 1}),
+        })
+        floors = ex.advance(*space, np.array([1, 0]))
+        assert floors == [0, 0] and order == []
+        # once row 1 commits, both run in dependency order
+        space = make_space(R, W, {
+            (0, 0): (2, 10, False, {1: 1}),
+            (1, 0): (1, 20, False, {}),
+        })
+        floors = ex.advance(*space, np.array([1, 1]))
+        assert floors == [1, 1]
+        assert order == [(1, 0), (0, 0)]
+
+    def test_missing_payload_blocks_transitively(self):
+        R, W = 2, 8
+        order = []
+        ex = EPaxosExecutor(R, W, lambda r, c, v, n: order.append((r, c)))
+        space = make_space(R, W, {
+            (0, 0): (1, 10, False, {}),
+            (0, 1): (2, 11, False, {}),
+            (1, 0): (9, 20, False, {0: 2}),  # deps row 0 below bar 2
+        })
+        # payload for vid 11 not here yet: (0,1) blocks, and (1,0)
+        # blocks transitively; (0,0) still executes
+        floors = ex.advance(*space, np.array([2, 1]),
+                            payload_ok=lambda v, n: v != 11)
+        assert floors == [1, 0] and order == [(0, 0)]
+        floors = ex.advance(*space, np.array([2, 1]),
+                            payload_ok=lambda v, n: True)
+        assert floors == [2, 1]
+        assert order == [(0, 0), (0, 1), (1, 0)]
+
+    def test_noop_executes_without_payload(self):
+        R, W = 2, 4
+        seen = []
+        ex = EPaxosExecutor(R, W, lambda r, c, v, n: seen.append((r, c, n)))
+        space = make_space(R, W, {(0, 0): (1, 0, True, {})})
+        floors = ex.advance(*space, np.array([1, 0]),
+                            payload_ok=lambda v, n: n or v == 99)
+        assert floors == [1, 0] and seen == [(0, 0, True)]
+
+    def test_incremental_advance_is_stable(self):
+        R, W = 2, 8
+        order = []
+        ex = EPaxosExecutor(R, W, lambda r, c, v, n: order.append((r, c)))
+        space = make_space(R, W, {(0, 0): (1, 10, False, {})})
+        ex.advance(*space, np.array([1, 0]))
+        # same call again: nothing re-executes
+        ex.advance(*space, np.array([1, 0]))
+        assert order == [(0, 0)]

@@ -1,0 +1,66 @@
+"""Analytical-model pillar tests (parity role: reference models/)."""
+
+import sys
+import os
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "models")
+)
+
+from crossword_model import (  # noqa: E402
+    best_assignment,
+    shard_loss_tolerance,
+    valid_assignments,
+)
+from bodega_wan import RingWorld, mean_latency_ms, site_latencies  # noqa: E402
+
+
+class TestCrosswordModel:
+    def test_constraint_frontier(self):
+        va = dict(
+            (spr, q)
+            for q, spr in valid_assignments(5, 3, fault_tolerance=1)
+        )
+        # full copies commit at a bare majority; narrower shards need
+        # bigger quorums (coverage under f losses)
+        assert va[3] == 3
+        assert va[1] > va[3]
+
+    def test_loss_tolerance_monotone_in_spr(self):
+        f = [shard_loss_tolerance(5, 3, spr) for spr in (1, 2, 3)]
+        assert f == sorted(f)
+        assert shard_loss_tolerance(5, 3, 3) == 2  # full copy: majority
+
+    def test_bandwidth_bound_prefers_narrow_shards(self):
+        # huge instance on a thin link: shipping 1/d each wins
+        q, spr = best_assignment(5, 3, size_kb=4096, delay_ms=1,
+                                 bw_gbps=0.5, trials=300)
+        assert spr == 1
+        # tiny instance on a fat link: latency-bound — the smaller
+        # quorum (wider shards) wins over the bandwidth saving
+        q2, spr2 = best_assignment(5, 3, size_kb=8, delay_ms=50,
+                                   bw_gbps=100, trials=300)
+        assert spr2 > 1 and q2 == 3
+
+
+class TestBodegaWan:
+    def test_lease_local_reads_beat_leader_reads(self):
+        w = RingWorld()
+        lease = mean_latency_ms(w, "lease_local", put_ratio=0.0)
+        leader = mean_latency_ms(w, "leader_reads", put_ratio=0.0)
+        assert lease < leader
+
+    def test_lease_writes_pay_coverage(self):
+        w = RingWorld()
+        lease = site_latencies(w, "lease_local")
+        leader = site_latencies(w, "leader_reads")
+        for c in w.clients:
+            assert lease[c]["write_ms"] >= leader[c]["write_ms"]
+
+    def test_read_at_responder_site_is_free(self):
+        w = RingWorld()
+        per = site_latencies(w, "lease_local")
+        on_site = [c for c in w.clients if c in w.servers]
+        for c in on_site:
+            assert per[c]["read_ms"] == 0.0
