@@ -14,19 +14,21 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
-def valid_assignments(n: int, d: int, fault_tolerance: int = 0,
+def valid_assignments(n: int, d: int,
+                      fault_tolerance: Optional[int] = None,
                       shards_per_disjoint: int = 1
                       ) -> List[Tuple[int, int]]:
     """(commit-ack count q, shards-per-replica spr) pairs per Crossword's
     commit condition: q = max(majority, f + 1 + ceil((d - spr) / dj)) —
     quorum AND worst-case f+1-survivor coverage of all d shards (the
     kernel's ``_commit_need``, crossword.py; ref messages.rs:15-62).
-    Defaults: f = (n - majority) // 2 when 0 is passed and n > 3."""
+    ``fault_tolerance=None`` uses the orchestration scripts' default
+    f = (n // 2) // 2 (local_cluster.py protocol_defaults)."""
     maj = n // 2 + 1
-    f = fault_tolerance
+    f = (n // 2) // 2 if fault_tolerance is None else fault_tolerance
     dj = shards_per_disjoint
     out = []
     for spr in range(1, d + 1):
@@ -85,8 +87,7 @@ def expected_commit_ms(
     adaptive policy optimizes over."""
     rng = random.Random(seed)
     out = {}
-    f = (n // 2) // 2  # the orchestration scripts' default FT for n >= 5
-    for q, spr in valid_assignments(n, d, fault_tolerance=f):
+    for q, spr in valid_assignments(n, d):
         acc = 0.0
         for _ in range(trials):
             acc += response_time_sample(

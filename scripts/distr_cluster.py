@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Launch a multi-host cluster over SSH.
+
+Parity: reference ``scripts/distr_cluster.py`` + ``remote_hosts.toml`` +
+``scripts/utils/proc.py run_process_over_ssh`` — the manager runs on the
+first host, one server replica per listed host, all started through ssh
+with the repo path and ports templated in.  Requires passwordless ssh to
+every host and the repo checked out at the same path (the reference makes
+the same assumptions).
+
+Hosts file (TOML):
+    repo = "/root/repo"
+    [[hosts]]
+    name = "host0"
+    addr = "10.0.0.1"
+    [[hosts]]
+    name = "host1"
+    addr = "10.0.0.2"
+    ...
+
+Usage:
+    python scripts/distr_cluster.py -p MultiPaxos --hosts remote_hosts.toml
+    python scripts/distr_cluster.py --hosts remote_hosts.toml --kill
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+import tomllib
+
+SSH = ["ssh", "-o", "StrictHostKeyChecking=no",
+       "-o", "BatchMode=yes"]
+
+
+def run_over_ssh(addr: str, cmd: str, background: bool = True):
+    """Start ``cmd`` on ``addr`` (parity: utils/proc.py
+    run_process_over_ssh — nohup + setsid so the process survives the
+    ssh session)."""
+    remote = (
+        f"setsid nohup {cmd} > /tmp/summerset_remote.log 2>&1 "
+        "< /dev/null & echo $!"
+        if background else cmd
+    )
+    return subprocess.run(
+        SSH + [addr, remote], capture_output=True, text=True, timeout=60
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-p", "--protocol", default="MultiPaxos")
+    ap.add_argument("--hosts", required=True)
+    ap.add_argument("--srv-port", type=int, default=52600)
+    ap.add_argument("--cli-port", type=int, default=52601)
+    ap.add_argument("--api-port", type=int, default=52700)
+    ap.add_argument("--p2p-port", type=int, default=52800)
+    ap.add_argument("-g", "--num-groups", type=int, default=1)
+    ap.add_argument("-c", "--config", default="")
+    ap.add_argument("--kill", action="store_true",
+                    help="stop all remote processes instead of launching")
+    args = ap.parse_args()
+
+    with open(args.hosts, "rb") as f:
+        spec = tomllib.load(f)
+    repo = spec.get("repo", "/root/repo")
+    hosts = spec["hosts"]
+    if not hosts:
+        print("no hosts listed", file=sys.stderr)
+        return 1
+
+    if args.kill:
+        for h in hosts:
+            run_over_ssh(
+                h["addr"],
+                "pkill -f summerset_tpu.cli || true",
+                background=False,
+            )
+            print(f"killed on {h['name']}")
+        return 0
+
+    man_host = hosts[0]
+    py = f"cd {shlex.quote(repo)} && PYTHONPATH={shlex.quote(repo)} python"
+    man_cmd = (
+        f"{py} -m summerset_tpu.cli.manager -p {args.protocol} "
+        f"--bind-ip 0.0.0.0 --srv-port {args.srv_port} "
+        f"--cli-port {args.cli_port} -n {len(hosts)}"
+    )
+    r = run_over_ssh(man_host["addr"], man_cmd)
+    print(f"manager on {man_host['name']} ({man_host['addr']}): "
+          f"pid {r.stdout.strip() or '?'}")
+
+    for i, h in enumerate(hosts):
+        cfg = f" -c {shlex.quote(args.config)}" if args.config else ""
+        srv_cmd = (
+            f"{py} -m summerset_tpu.cli.server -p {args.protocol} "
+            f"--bind-ip 0.0.0.0 -a {args.api_port} -i {args.p2p_port} "
+            f"-m {man_host['addr']}:{args.srv_port} "
+            f"-g {args.num_groups}{cfg}"
+        )
+        r = run_over_ssh(h["addr"], srv_cmd)
+        print(f"server {i} on {h['name']} ({h['addr']}): "
+              f"pid {r.stdout.strip() or '?'}")
+    print(
+        f"cluster launching; clients connect to "
+        f"{man_host['addr']}:{args.cli_port}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Launch a fleet of bench/tester client processes against a running
+cluster and aggregate their results.
+
+Parity: reference ``scripts/local_clients.py`` — spawns M client
+processes of a chosen utility, waits for all, merges their output
+(summed throughput, max tail latency for bench; AND of pass/fail for
+tester).
+
+Usage:
+    python scripts/local_clients.py -u bench -m 127.0.0.1:52601 \
+        --num-clients 4 --secs 10 [--put-ratio 0.5] [--value-size 128]
+    python scripts/local_clients.py -u tester -m 127.0.0.1:52601
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-u", "--utility", default="bench",
+                    choices=["bench", "tester"])
+    ap.add_argument("-m", "--manager", default="127.0.0.1:52601")
+    ap.add_argument("--num-clients", type=int, default=4)
+    ap.add_argument("--secs", type=float, default=10.0)
+    ap.add_argument("--freq", type=float, default=0.0)
+    ap.add_argument("--put-ratio", type=float, default=0.5)
+    ap.add_argument("--value-size", default="128")
+    ap.add_argument("--num-keys", type=int, default=64)
+    ap.add_argument("--trace-file", default=None)
+    ap.add_argument("--tests", default="")
+    args = ap.parse_args()
+
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    cmd = [
+        sys.executable, "-m", "summerset_tpu.cli.client",
+        "-u", args.utility, "-m", args.manager,
+    ]
+    if args.utility == "bench":
+        cmd += [
+            "--secs", str(args.secs), "--freq", str(args.freq),
+            "--put-ratio", str(args.put_ratio),
+            "--value-size", str(args.value_size),
+            "--num-keys", str(args.num_keys),
+        ]
+        if args.trace_file:
+            cmd += ["--trace-file", args.trace_file]
+    elif args.tests:
+        cmd += ["--tests", args.tests]
+
+    procs = [
+        subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True)
+        for _ in range(args.num_clients)
+    ]
+    outs = []
+    rc = 0
+    for p in procs:
+        out, _ = p.communicate(timeout=args.secs + 300)
+        rc |= p.returncode
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                outs.append(json.loads(line))
+
+    if args.utility == "bench":
+        agg = {
+            "clients": len(outs),
+            "tput": round(sum(o.get("tput", 0.0) for o in outs), 2),
+            "lat_p50_ms": round(
+                max((o.get("lat_p50_ms", 0.0) for o in outs), default=0), 3
+            ),
+            "lat_p99_ms": round(
+                max((o.get("lat_p99_ms", 0.0) for o in outs), default=0), 3
+            ),
+        }
+        print(json.dumps(agg))
+    else:
+        merged = {}
+        for o in outs:
+            for k, v in o.items():
+                if merged.get(k, "PASS") == "PASS":
+                    merged[k] = v
+        print(json.dumps(merged))
+        if any(v != "PASS" for v in merged.values()):
+            rc |= 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

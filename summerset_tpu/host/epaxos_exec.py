@@ -42,6 +42,7 @@ class EPaxosExecutor:
         self.W = window
         self.apply_fn = apply_fn
         self.floor = [0] * num_rows  # contiguous executed frontier
+        self.lost_rows: List[int] = []  # rows needing install-snapshot
 
     # ------------------------------------------------------------ advance
     def advance(
@@ -61,13 +62,21 @@ class EPaxosExecutor:
             p = c % W
             return p if abs2[r, p] == c else None
 
-        # candidate nodes: committed, unexecuted, inside the window
+        # candidate nodes: committed, unexecuted, inside the window.  A
+        # committed column that our stored copy no longer holds (the
+        # window slid past it while we were paused/partitioned) is a LOST
+        # instance: the row stalls here and the caller must install-
+        # snapshot past it (self.lost_rows signals that need).
+        self.lost_rows: List[int] = []
         nodes: Dict[Tuple[int, int], Tuple[int, int, bool, np.ndarray]] = {}
         for r in range(R):
             for c in range(self.floor[r], int(cmt_row[r])):
                 p = lookup(r, c)
-                if p is None or st2[r, p] != COMMITTED:
-                    break  # window slid past, or gap: stop this row here
+                if p is None:
+                    self.lost_rows.append(r)
+                    break
+                if st2[r, p] != COMMITTED:
+                    break  # gap: not yet committed contiguously
                 nodes[(r, c)] = (
                     int(seq2[r, p]), int(val2[r, p]),
                     bool(noop2[r, p]), deps2[r, p],
@@ -102,7 +111,17 @@ class EPaxosExecutor:
                 hi = min(d, int(cmt_row[r2])) - 1
                 if hi >= self.floor[r2]:
                     out.append((r2, hi))
-            edges[(r, c)] = [e for e in out if e in nodes]
+            kept = []
+            for e in out:
+                if e in nodes:
+                    kept.append(e)
+                elif e[1] >= self.floor[e[0]]:
+                    # the dependency is unexecuted but absent from the
+                    # candidate set (lost to a window slide or an
+                    # uncommitted gap): the dependent must WAIT, never
+                    # execute ahead of it
+                    blocked.add((r, c))
+            edges[(r, c)] = kept
 
         # transitively block nodes that reach a blocked node
         changed = True

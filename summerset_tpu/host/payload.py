@@ -33,11 +33,11 @@ class PayloadStore:
         different batches and the payload exchange silently cross-wires
         them (first-writer-wins at every peer)."""
         with self._lock:
+            # _next starts at 1 and only grows, and the residue bump is
+            # non-negative, so vid >= 1 always holds (0 stays the no-op)
             vid = self._next[group]
             if stride > 1:
                 vid += (residue - vid) % stride
-            if vid < 1:
-                vid += stride
             self._next[group] = vid + 1
             self._data[group][vid] = batch
         return vid

@@ -207,7 +207,7 @@ class ServerReplica:
             if msg is not None and msg.kind == "connect_to_peers":
                 for peer, addr in msg.payload["to_peers"].items():
                     p = int(peer)
-                    if p not in connected and p not in self.transport._conns:
+                    if p not in connected and not self.transport.connected(p):
                         self.transport.connect_to_peer(p, addr)
                         connected.add(p)
             try:
@@ -238,10 +238,12 @@ class ServerReplica:
         try:
             with open(self.snap_path, "rb") as f:
                 kind, kv, meta = pickle.load(f)
+            if not isinstance(meta, dict):  # pre-r4 bare floors list
+                meta = {"applied": list(meta)}
+            assert kind == "kv"
         except Exception as e:
             pf_warn(logger, f"snapshot unreadable, ignoring: {e}")
             return
-        assert kind == "kv"
         self.statemach._kv.update(kv)
         floors = meta["applied"]
         for g, fl in enumerate(floors[: self.G]):
@@ -707,6 +709,11 @@ class ServerReplica:
             if self._pending_kv_serve:
                 payload_msg["kv"] = self.statemach.snapshot_items()
                 payload_msg["kv_floor"] = list(self.applied)
+                if self._epaxos:
+                    payload_msg["kv_ep"] = [
+                        list(self._ep_exec[g].floor)
+                        for g in range(self.G)
+                    ]
                 self._pending_kv_serve = False
             self.transport.send_tick(
                 self.tick,
@@ -818,13 +825,19 @@ class ServerReplica:
                 if f.get("kv_need") and not self.kv_need:
                     self._pending_kv_serve = True
                 if "kv" in f and self.kv_need:
-                    self._merge_kv(f["kv"], f["kv_floor"])
+                    self._merge_kv(
+                        f["kv"], f["kv_floor"], f.get("kv_ep")
+                    )
 
-    def _merge_kv(self, kv: dict, floors: list) -> None:
+    def _merge_kv(self, kv: dict, floors: list,
+                  ep_floors: Optional[list] = None) -> None:
         """Install-snapshot KV merge, guarded per group: only groups that
         jumped take the provider's state, and only when the provider's
         floor covers our claimed floor — a stale provider must never
-        overwrite newer local execution (this was possible before r4)."""
+        overwrite newer local execution (this was possible before r4).
+        For EPaxos the provider's per-row exec floors ride along so the
+        executor can jump past rows whose instances slid out of the
+        stored-copy window."""
         ok_groups = {
             g for g in self.kv_need
             if g < len(floors) and floors[g] > self.applied[g]
@@ -837,6 +850,13 @@ class ServerReplica:
         self.statemach._kv.update(upd)
         for g in ok_groups:
             self.applied[g] = max(self.applied[g], int(floors[g]))
+            if self._epaxos and ep_floors is not None and g < len(ep_floors):
+                ex = self._ep_exec[g]
+                ex.floor = [
+                    max(a, int(b)) for a, b in zip(ex.floor, ep_floors[g])
+                ]
+                ex.lost_rows = []
+                self.applied[g] = max(self.applied[g], sum(ex.floor))
             self.kv_need.discard(g)
 
     # ------------------------------------------------------- application
@@ -891,6 +911,11 @@ class ServerReplica:
                 arrs["val2"][g], arrs["noop2"][g], arrs["deps2"][g],
                 cmt[g], payload_ok,
             )
+            if ex.lost_rows:
+                # committed instances slid out of our stored-copy window
+                # (paused/partitioned too long): catch up via the KV
+                # install-snapshot plane, same as the frontier kernels
+                self.kv_need.add(g)
             self.applied[g] = sum(ex.floor)
 
     def _apply_committed(self, fx) -> None:
@@ -1023,7 +1048,7 @@ class ServerReplica:
             "kv_need": sorted(self.kv_need),
             "missing": sorted(self.missing),
             "paused": self.paused,
-            "peers": sorted(self.transport._conns),
+            "peers": self.transport.peers(),
             "was_leader": self.was_leader,
             "wal_size": self.wal.size,
         }

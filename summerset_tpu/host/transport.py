@@ -132,6 +132,14 @@ class TransportHub:
         self._accept_thread.start()
 
     # ---------------------------------------------------------- mesh setup
+    def peers(self) -> list:
+        """Currently connected peer ids (hub API surface; callers must
+        not reach into the connection map)."""
+        return sorted(self._conns)
+
+    def connected(self, peer: int) -> bool:
+        return peer in self._conns
+
     def connect_to_peer(self, peer: int, addr: Tuple[str, int]) -> None:
         """Proactively connect to a lower-id peer (transport.rs:162)."""
         sock = None

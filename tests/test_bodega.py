@@ -12,6 +12,7 @@ from smr_helpers import check_agreement, committed_values, run_segment
 from summerset_tpu.core import Engine
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.bodega import ReplicaConfigBodega
+import pytest
 
 
 def make_kernel(G, R, W, P, **kw):
@@ -115,6 +116,7 @@ class TestConfLeases:
             assert (last_buckets[:, r] == 0).all(), (r, last_buckets)
         assert fxe["stable_leader"][-1][:, 0].all()
 
+    @pytest.mark.slow
     def test_write_barrier_blocks_on_dead_responder_then_conf_heals(self):
         # responder 4 dies: writes must stop committing (its ack is
         # required); after conf failover drops it from the roster, commits
@@ -169,6 +171,7 @@ class TestConfLeases:
 
 
 class TestConfFailover:
+    @pytest.mark.slow
     def test_leader_death_conf_takeover(self):
         # conf leader dies; a live replica volunteers via a filtered conf
         # at a higher ballot and steps up through the campaign path
@@ -205,6 +208,7 @@ class TestConfFailover:
 
 
 class TestInstallBarrier:
+    @pytest.mark.slow
     def test_conf_install_waits_for_outgoing_leases(self):
         # a replica with outgoing grants must wait out (or actively revoke)
         # them before installing a pending conf: conf_bal stays until then

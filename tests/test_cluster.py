@@ -347,6 +347,59 @@ def ep_cluster(tmp_path_factory):
     c.stop()
 
 
+@pytest.fixture(scope="module")
+def bodega_cluster(tmp_path_factory):
+    c = Cluster("Bodega", 3, tmp_path_factory.mktemp("bodega_cluster"))
+    yield c
+    c.stop()
+
+
+class TestClusterBodega:
+    def test_roster_conf_and_local_read(self, bodega_cluster):
+        """Bodega end-to-end: a client announces a roster conf through
+        the data plane (any replica may announce — conflease.rs
+        heard_new_conf), the config leases install after the
+        revoke-then-adopt barrier, and a responder then serves an
+        always-local read (localread.rs:8-26)."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.host.messages import CtrlRequest
+
+        ep = GenericEndpoint(bodega_cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        drv.checked_put("bod_key", "v1")
+        rep = drv.conf_change({"responders": [0, 1, 2]})
+        assert rep.kind == "success"
+        conf = None
+        for _ in range(50):
+            conf = ep.ctrl.request(CtrlRequest("query_conf"), timeout=10)
+            if conf.conf:
+                break
+            time.sleep(0.1)
+        assert conf.conf and sorted(conf.conf["responders"]) == [0, 1, 2]
+        leader = ep.ctrl.request(CtrlRequest("query_info")).leader or 0
+        follower = next(s for s in sorted(ep.servers) if s != leader)
+        ep2 = GenericEndpoint(
+            bodega_cluster.manager_addr, server_id=follower
+        )
+        ep2.connect()
+        drv2 = DriverClosedLoop(ep2)
+        deadline = time.monotonic() + 30
+        got = None
+        while time.monotonic() < deadline:
+            r = drv2.get("bod_key")
+            if r.kind == "success" and r.local:
+                got = r
+                break
+            ep2.reconnect(follower)
+            time.sleep(0.3)
+        assert got is not None, "responder never served a local read"
+        assert got.result.value == "v1"
+        ep2.leave()
+        ep.leave()
+
+
 
 
 class TestClusterQuorumLeases:

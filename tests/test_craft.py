@@ -11,6 +11,7 @@ from smr_helpers import check_agreement, committed_values, run_segment
 from summerset_tpu.core import Engine, NetConfig
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.craft import ReplicaConfigCRaft
+import pytest
 
 
 def make_kernel(G, R, W, P, **kw):
@@ -52,6 +53,7 @@ class TestSteadyState:
 
 
 class TestFullCopyFallback:
+    @pytest.mark.slow
     def test_fallback_keeps_committing_where_coded_stalls(self):
         # R=5, ft=1: coded commits need 4 acks. Kill 2 replicas: after the
         # liveness countdown expires the leader stamps new entries full-copy

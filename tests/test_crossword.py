@@ -12,6 +12,7 @@ from smr_helpers import check_agreement, committed_values, run_segment
 from summerset_tpu.core import Engine, NetConfig
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.crossword import ReplicaConfigCrossword
+import pytest
 
 
 def make_kernel(G, R, W, P, **kw):
@@ -91,6 +92,7 @@ class TestSteadyState:
 
 
 class TestAdaptive:
+    @pytest.mark.slow
     def test_widens_on_peer_stall_and_recovers(self):
         # adaptive: with all peers live the leader uses the bandwidth-optimal
         # diagonal (spr=1); after 2 peers stall it widens to spr=2 — the
@@ -195,6 +197,7 @@ class TestGossip:
 
 
 class TestFailover:
+    @pytest.mark.slow
     def test_leader_crash_recovers_committed_values(self):
         G, R, W, P = 4, 5, 32, 4
         k = make_kernel(G, R, W, P, fault_tolerance=1)

@@ -11,6 +11,7 @@ import numpy as np
 from summerset_tpu.core import Engine, NetConfig
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.epaxos import COMMITTED, ReplicaConfigEPaxos
+import pytest
 
 
 def make_kernel(G, R, W, P, **kw):
@@ -143,6 +144,7 @@ class TestInterference:
                     assert n > 10, (g, r, b, n)
 
 
+@pytest.mark.slow
 class TestFailover:
     def test_dead_row_recovered_by_successor(self):
         G, R, W, P = 2, 5, 32, 5
@@ -194,6 +196,7 @@ class TestFailover:
         check_agreement(post, G, R)
 
 
+@pytest.mark.slow
 class TestAdjacentFailures:
     def test_two_adjacent_dead_rows_both_recovered(self):
         # regression: replicas 2 and 3 die together (simple_q survivors
@@ -227,6 +230,7 @@ class TestAdjacentFailures:
         check_agreement(post, G, R)
 
 
+@pytest.mark.slow
 class TestConcurrentRecoverers:
     def test_recoverer_dies_midway_successor_uses_higher_ballot(self):
         """Regression for the r2 recovery fix (VERDICT r3 #8): two
@@ -277,6 +281,7 @@ class TestConcurrentRecoverers:
                     assert after[slot][0] == v[0], (r, slot, v, after[slot])
 
 
+@pytest.mark.slow
 class TestLossyNetwork:
     def test_agreement_under_drops(self):
         G, R, W, P = 2, 5, 32, 5

@@ -61,6 +61,7 @@ class TestSteadyState:
         # followers converge close behind the leader
         assert (state["commit_bar"].min(axis=1) >= cb - 3 * P).all()
 
+    @pytest.mark.slow
     def test_population_sizes(self):
         for R in (1, 2, 3, 7):
             G, W, P = 2, 32, 4
@@ -173,6 +174,7 @@ class TestPartitions:
         assert (st["commit_bar"][:, 0] >= (100 - 10) * P).all()
         check_agreement(st, G, R, W)
 
+    @pytest.mark.slow
     def test_majority_partition_takes_over_no_divergence(self):
         G, R, W, P = 2, 5, 32, 4
         k = make_kernel(G, R, W, P)

@@ -12,6 +12,7 @@ from smr_helpers import check_agreement, run_segment
 from summerset_tpu.core import Engine
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.quorum_leases import ReplicaConfigQuorumLeases
+import pytest
 
 
 def make_kernel(G, R, W, P, **kw):
@@ -72,6 +73,7 @@ class TestLocalReads:
         for r in (0, 3, 4):
             assert (nloc[:, r] == 0).all(), (r, nloc)
 
+    @pytest.mark.slow
     def test_pending_writes_block_their_bucket_only(self):
         G, R, W, P = 2, 5, 32, 2
         k = make_kernel(G, R, W, P, num_key_buckets=8)
@@ -91,6 +93,7 @@ class TestLocalReads:
 
 
 class TestWriteBarrier:
+    @pytest.mark.slow
     def test_dead_responder_stalls_writes_until_lease_expiry(self):
         G, R, W, P = 2, 5, 48, 2
         k = make_kernel(G, R, W, P, lease_len=16, lease_margin=4,
@@ -181,6 +184,7 @@ class TestLeaderLease:
         assert ok[:, 0].all(), ok
         assert not ok[:, 1:].any()
 
+    @pytest.mark.slow
     def test_failover_still_happens_after_lease_expiry(self):
         G, R, W, P = 4, 5, 32, 2
         k = make_kernel(G, R, W, P)

@@ -111,6 +111,7 @@ class TestElection:
                     t = int(st["term"][g, r])
                     assert by_term.setdefault(t, r) == r, (g, t)
 
+    @pytest.mark.slow
     def test_failover_preserves_committed(self):
         G, R, W, P = 4, 5, 32, 4
         k = make_kernel(G, R, W, P)

@@ -10,6 +10,7 @@ from smr_helpers import check_agreement, committed_values, run_segment
 from summerset_tpu.core import Engine, NetConfig
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.rspaxos import ReplicaConfigRSPaxos
+import pytest
 
 
 def make_kernel(G, R, W, P, **kw):
@@ -63,6 +64,7 @@ class TestSteadyState:
 
 
 class TestCommitThreshold:
+    @pytest.mark.slow
     def test_majority_alone_does_not_commit(self):
         # R=5, ft=1 -> commit needs 4 acks; with only 3 alive the leader
         # must stall commits (MultiPaxos would keep committing here)
