@@ -27,8 +27,11 @@ from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
 
 GROUPS = 4096
 POPULATION = 5
-WINDOW = 64
-PROPOSALS_PER_TICK = 16
+# W=128/P=32 doubles commit throughput over the r2/r3 shape (W=64/P=16)
+# at the SAME ~2.1 ms/tick: the ring window, not the tick cost, was the
+# binding constraint (see PERF.md round-4 sweep)
+WINDOW = 128
+PROPOSALS_PER_TICK = 32
 TICKS = 2048
 RUNS = 3
 BASELINE = 10_000_000.0

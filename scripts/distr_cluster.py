@@ -35,14 +35,18 @@ SSH = ["ssh", "-o", "StrictHostKeyChecking=no",
        "-o", "BatchMode=yes"]
 
 
-def run_over_ssh(addr: str, cmd: str, background: bool = True):
+def run_over_ssh(addr: str, cmd: str, background: bool = True,
+                 workdir: str = ""):
     """Start ``cmd`` on ``addr`` (parity: utils/proc.py
     run_process_over_ssh — nohup + setsid so the process survives the
-    ssh session)."""
+    ssh session).  ``workdir`` is entered with a plain ``cd`` BEFORE the
+    daemonizing wrapper: setsid/nohup must wrap the actual python
+    process, not a shell builtin."""
+    prefix = f"cd {shlex.quote(workdir)} && " if workdir else ""
     remote = (
-        f"setsid nohup {cmd} > /tmp/summerset_remote.log 2>&1 "
+        f"{prefix}setsid nohup {cmd} > /tmp/summerset_remote.log 2>&1 "
         "< /dev/null & echo $!"
-        if background else cmd
+        if background else f"{prefix}{cmd}"
     )
     return subprocess.run(
         SSH + [addr, remote], capture_output=True, text=True, timeout=60
@@ -82,13 +86,13 @@ def main() -> int:
         return 0
 
     man_host = hosts[0]
-    py = f"cd {shlex.quote(repo)} && PYTHONPATH={shlex.quote(repo)} python"
+    py = f"env PYTHONPATH={shlex.quote(repo)} python"
     man_cmd = (
         f"{py} -m summerset_tpu.cli.manager -p {args.protocol} "
         f"--bind-ip 0.0.0.0 --srv-port {args.srv_port} "
         f"--cli-port {args.cli_port} -n {len(hosts)}"
     )
-    r = run_over_ssh(man_host["addr"], man_cmd)
+    r = run_over_ssh(man_host["addr"], man_cmd, workdir=repo)
     print(f"manager on {man_host['name']} ({man_host['addr']}): "
           f"pid {r.stdout.strip() or '?'}")
 
@@ -100,7 +104,7 @@ def main() -> int:
             f"-m {man_host['addr']}:{args.srv_port} "
             f"-g {args.num_groups}{cfg}"
         )
-        r = run_over_ssh(h["addr"], srv_cmd)
+        r = run_over_ssh(h["addr"], srv_cmd, workdir=repo)
         print(f"server {i} on {h['name']} ({h['addr']}): "
               f"pid {r.stdout.strip() or '?'}")
     print(

@@ -76,10 +76,29 @@ class ProtocolKernel:
     def restore_durable(self, st, g: int, me: int, rec: dict, floor: int):
         """Reinstate acceptor row ``(g, me)`` from the last logged durable
         record ``rec`` ({field: int | list}), given the host applier's
-        recovered exec floor.  Mutates ``st`` in place."""
-        raise NotImplementedError(
-            f"{type(self).__name__} declares no durable-restore contract"
-        )
+        recovered exec floor.  Mutates ``st`` in place.
+
+        Default: every DURABLE_SCALARS entry is restored as
+        ``max(rec, floor)``, the dur/commit/exec bars are raised to the
+        floor, and DURABLE_WINDOWS content is copied verbatim — correct
+        for kernels whose scalars are all monotone frontiers (the basic
+        protocols).  Kernels with paired or non-frontier durable state
+        (ballot/vote pairs, term/voted_for, conf slots) override this."""
+        if self.DURABLE_SCALARS is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} declares no durable contract"
+            )
+        import jax.numpy as jnp
+
+        i32 = jnp.int32
+        fl = i32(floor)
+        for k in self.DURABLE_SCALARS:
+            st[k] = st[k].at[g, me].set(jnp.maximum(i32(rec[k]), fl))
+        for k in ("dur_bar", "commit_bar", "exec_bar"):
+            if k in st and k not in self.DURABLE_SCALARS:
+                st[k] = st[k].at[g, me].max(fl)
+        for k in self.DURABLE_WINDOWS:
+            st[k] = st[k].at[g, me].set(jnp.asarray(rec[k], st[k].dtype))
 
     def __init__(self, num_groups: int, population: int, window: int):
         if population < 1 or population > 32:

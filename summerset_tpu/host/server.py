@@ -838,10 +838,22 @@ class ServerReplica:
         For EPaxos the provider's per-row exec floors ride along so the
         executor can jump past rows whose instances slid out of the
         stored-copy window."""
-        ok_groups = {
-            g for g in self.kv_need
-            if g < len(floors) and floors[g] > self.applied[g]
-        }
+        def dominates(g: int) -> bool:
+            if g >= len(floors) or floors[g] <= self.applied[g]:
+                return False
+            if not self._epaxos:
+                return True
+            # EPaxos: the provider must be ahead or equal on EVERY row —
+            # a sum-ahead provider that lags one row would regress that
+            # row's keys and the floor merge would mark them executed
+            if ep_floors is None or g >= len(ep_floors):
+                return False
+            return all(
+                int(p) >= l
+                for p, l in zip(ep_floors[g], self._ep_exec[g].floor)
+            )
+
+        ok_groups = {g for g in self.kv_need if dominates(g)}
         if not ok_groups:
             return
         upd = {
@@ -850,7 +862,7 @@ class ServerReplica:
         self.statemach._kv.update(upd)
         for g in ok_groups:
             self.applied[g] = max(self.applied[g], int(floors[g]))
-            if self._epaxos and ep_floors is not None and g < len(ep_floors):
+            if self._epaxos:
                 ex = self._ep_exec[g]
                 ex.floor = [
                     max(a, int(b)) for a, b in zip(ex.floor, ep_floors[g])

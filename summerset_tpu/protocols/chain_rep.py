@@ -63,6 +63,12 @@ class ChainRepKernel(ProtocolKernel):
             raise ValueError("max_proposals_per_tick must be <= window/2")
         self._chunk = min(self.config.chunk_size, window)
 
+    # durable record: a chain node's received/appended prefix (the
+    # propagate stream certifies whole prefixes, like the reference's
+    # prop_bar, chain_rep/mod.rs:148-156)
+    DURABLE_SCALARS = ("prop_bar", "dur_bar")
+    DURABLE_WINDOWS = ("win_abs", "win_val")
+
     def init_state(self, seed: int = 0):
         G, R, W = self.G, self.R, self.W
         i32 = jnp.int32

@@ -31,6 +31,11 @@ class ReplicaConfigRepNothing:
 
 @register_protocol("RepNothing")
 class RepNothingKernel(ProtocolKernel):
+    # durable record: the local log IS the only copy (rep_nothing logs
+    # each batch durably before replying — its whole point as a baseline)
+    DURABLE_SCALARS = ("next_slot",)
+    DURABLE_WINDOWS = ("win_abs", "win_val")
+
     def __init__(
         self,
         num_groups: int,

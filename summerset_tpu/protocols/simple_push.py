@@ -68,6 +68,11 @@ class SimplePushKernel(ProtocolKernel):
         deg = self.config.rep_degree
         self._degree = population - 1 if deg < 0 else min(deg, population - 1)
 
+    # durable record: the serving node's appended log and a peer's
+    # contiguous received frontier both certify durably-held batches
+    DURABLE_SCALARS = ("next_slot", "dur_bar")
+    DURABLE_WINDOWS = ("win_abs", "win_val")
+
     def init_state(self, seed: int = 0):
         G, R, W = self.G, self.R, self.W
         i32 = jnp.int32

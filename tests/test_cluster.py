@@ -348,6 +348,74 @@ def ep_cluster(tmp_path_factory):
 
 
 @pytest.fixture(scope="module")
+def sp_cluster(tmp_path_factory):
+    c = Cluster("SimplePush", 3, tmp_path_factory.mktemp("sp_cluster"))
+    yield c
+    c.stop()
+
+
+class TestClusterBasics:
+    def test_simple_push_serving_node_restart(self, sp_cluster):
+        """The basic-protocol family serves over the host runtime too:
+        SimplePush pushes batches to peers and replies; crash-restarting
+        the serving node must recover its appended log from the durable
+        record (the generalized contract on the basics kernels)."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.host.messages import CtrlRequest
+
+        ep = GenericEndpoint(sp_cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        for i in range(5):
+            drv.checked_put(f"spk{i}", f"v{i}")
+        ep.ctrl.request(
+            CtrlRequest("reset_servers", servers=[0], durable=True),
+            timeout=120,
+        )
+        time.sleep(1.5)
+        ep2 = GenericEndpoint(sp_cluster.manager_addr)
+        ep2.connect()
+        drv2 = DriverClosedLoop(ep2)
+        for i in range(5):
+            drv2.checked_get(f"spk{i}", expect=f"v{i}")
+        drv2.checked_put("spk_post", "after")
+        drv2.checked_get("spk_post", expect="after")
+        ep2.leave()
+        ep.leave()
+
+
+@pytest.fixture(
+    scope="module", params=["RSPaxos", "CRaft", "Crossword"]
+)
+def rs_cluster(request, tmp_path_factory):
+    c = Cluster(
+        request.param, 3,
+        tmp_path_factory.mktemp(f"{request.param.lower()}_cluster"),
+        config={"fault_tolerance": 0},
+    )
+    yield c
+    c.stop()
+
+
+@pytest.mark.slow
+class TestClusterRSFamily:
+    def test_serve_and_reset(self, rs_cluster):
+        """The erasure-coded family serves over the host runtime: the
+        kernel runs the coded control plane (shard availability tallies,
+        commit_k = majority + FT) while the host payload plane ships
+        batches; a non-leader crash-restart must recover through the
+        durable contract (win_spr / win_full marker lanes included)."""
+        t = ClientTester(rs_cluster.manager_addr, settle=2.0)
+        results = t.run_tests([
+            "primitive_ops",
+            "client_reconnect",
+            "non_leader_reset",
+        ])
+        _check(rs_cluster, results)
+
+
+@pytest.fixture(scope="module")
 def bodega_cluster(tmp_path_factory):
     c = Cluster("Bodega", 3, tmp_path_factory.mktemp("bodega_cluster"))
     yield c
