@@ -143,6 +143,10 @@ class ServerReplica:
         }
         self.origin: Set[Tuple[int, int]] = set()   # (g, vid) we proposed
         self.missing: Set[Tuple[int, int]] = set()  # committed, no payload
+        # group commit: appends within a tick are sync=False; one fsync
+        # runs before any reply/ack referencing them leaves the process
+        self._wal_dirty = False
+        self._reply_queue: List[Tuple[int, ApiReply]] = []
         self.kv_need: Set[int] = set()     # groups that jumped past window
         self.paused = False
         self.stopping = False  # cooperative stop for embedded harnesses
@@ -310,6 +314,19 @@ class ServerReplica:
                 self.applied[g] = max(self.applied[g], slot + 1)
             off = res.end_offset
             n += 1
+        if off < self.wal.size:
+            # torn tail: a crash mid-group-commit left a partial record
+            # (nothing beyond it was ever acked — acks wait for the
+            # fsync).  Truncate it away, or post-restart appends would
+            # land past garbage that a LATER recovery cannot read through
+            # — silently losing fsynced, acked writes.
+            pf_warn(
+                logger,
+                f"truncating torn WAL tail at {off} (size {self.wal.size})",
+            )
+            self.wal.do_sync_action(
+                LogAction("truncate", offset=off, sync=True)
+            )
         for g, v in votes.items():
             self.kernel.restore_durable(
                 self.state, g, self.me, v, self.applied[g]
@@ -367,8 +384,9 @@ class ServerReplica:
             rec.update({k: wins[k][g].tolist() for k in wins})
             rec["pp"] = new_pp
             self.wal.do_sync_action(
-                LogAction("append", entry=("vote", g, rec), sync=True)
+                LogAction("append", entry=("vote", g, rec), sync=False)
             )
+            self._wal_dirty = True
 
     # ------------------------------------------------------------ snapshots
     def _take_snapshot(self) -> int:
@@ -769,6 +787,7 @@ class ServerReplica:
             if sw is not None:
                 sw.record_now(self.tick, 4)  # durable log
             self._apply_committed(fx)
+            self._flush_durability()
             self._conf_progress()
             self._leader_edges(fx)
             if sw is not None:
@@ -882,16 +901,17 @@ class ServerReplica:
             )
             self.wal.do_sync_action(LogAction(
                 "append", entry=("eapply", g, row, col, vid, batch),
-                sync=True,
+                sync=False,
             ))
+            self._wal_dirty = True
             if batch is not None:
                 mine = (g, vid) in self.origin
                 for client, req in batch:
                     res = apply_command(self.statemach._kv, req.cmd)
                     if mine:
-                        self._reply(client, ApiReply(
+                        self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,
-                        ))
+                        )))
         return apply_fn
 
     def _apply_committed_epaxos(self) -> None:
@@ -976,20 +996,42 @@ class ServerReplica:
                 self.missing.add((g, vid))
                 return  # stall the exec floor until the payload arrives
             # durability before client-visible effects (storage.rs intent):
-            # the apply record is fsynced before the reply below, so an
-            # acked write survives machine crash, not just process restart
+            # the apply record lands now, the group-commit fsync runs
+            # before the queued reply leaves — an acked write survives
+            # machine crash, not just process restart
             self.wal.do_sync_action(LogAction(
-                "append", entry=(g, slot, vid, batch), sync=True
+                "append", entry=(g, slot, vid, batch), sync=False
             ))
+            self._wal_dirty = True
             if batch is not None:
                 mine = (g, vid) in self.origin
                 for client, req in batch:
                     res = apply_command(self.statemach._kv, req.cmd)
                     if mine:
-                        self._reply(client, ApiReply(
+                        self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,
-                        ))
+                        )))
             self.applied[g] = slot + 1
+
+    def _flush_durability(self) -> None:
+        """Group commit: one fsync covers every record appended this
+        tick, then the replies gated on them go out.  The kernel acks in
+        the outbox leave at the top of the NEXT tick, strictly after
+        this point — the durability-before-ack invariant holds with one
+        fsync per tick instead of one per record."""
+        if self._wal_dirty:
+            res = self.wal.do_sync_action(LogAction("sync"))
+            if not res.offset_ok:
+                # a failed fsync (EIO/ENOSPC) must NEVER release the
+                # replies gated on it — crash instead; the restart loop
+                # recovers from whatever actually reached the disk
+                raise SummersetError(
+                    f"WAL group-commit fsync failed: {res.entry}"
+                )
+            self._wal_dirty = False
+        for client, reply in self._reply_queue:
+            self._reply(client, reply)
+        self._reply_queue.clear()
 
     def _leader_edges(self, fx) -> None:
         ex = self._last_extra

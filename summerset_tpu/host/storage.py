@@ -30,7 +30,7 @@ _LEN = struct.Struct("<Q")
 class LogAction:
     """One logger action (parity: ``LogAction``, storage.rs:25-45)."""
 
-    kind: str                 # read | write | append | truncate | discard
+    kind: str        # read | write | append | truncate | discard | sync
     entry: Any = None         # write/append payload (any picklable object)
     offset: int = 0           # read/write/truncate/discard target offset
     keep: int = 0             # discard: bytes of header to keep
@@ -97,6 +97,10 @@ class _PyWal:
             os.fdatasync(self.f.fileno())
         return True
 
+    def sync(self) -> None:
+        self.f.flush()
+        os.fdatasync(self.f.fileno())
+
     def discard(self, off: int, keep: int, sync: bool) -> bool:
         if off < keep or off > self.size:
             return False
@@ -154,6 +158,12 @@ class _NativeWal:
 
     def truncate(self, off: int, sync: bool) -> bool:
         return self.lib.wal_truncate(self.h, off, int(sync)) == 0
+
+    def sync(self) -> None:
+        # truncate-to-current-size with sync=1 is a pure fsync (the
+        # native surface has no separate sync entry point)
+        if self.lib.wal_truncate(self.h, self.size, 1) != 0:
+            raise SummersetError("wal fsync failed")
 
     def discard(self, off: int, keep: int, sync: bool) -> bool:
         return self.lib.wal_discard(self.h, off, keep, int(sync)) == 0
@@ -230,6 +240,12 @@ class StorageHub:
         if a.kind == "discard":
             ok = b.discard(a.offset, a.keep, a.sync)
             return LogResult("discard", offset_ok=ok, now_size=b.size)
+        if a.kind == "sync":
+            # group commit: fsync once after a batch of sync=False
+            # appends (the reference batches WAL writes per batch too —
+            # one durability point per ReqBatch, not per entry)
+            b.sync()
+            return LogResult("sync", now_size=b.size)
         raise SummersetError(f"unknown log action kind {a.kind}")
 
     def _logger(self) -> None:

@@ -93,6 +93,32 @@ class TestStorage:
         ).entry == {"x": 1}
         h2.stop()
 
+    def test_torn_tail_detected_and_truncatable(self, tmp_path):
+        """Crash mid-group-commit leaves a partial record: reads must
+        fail cleanly at the torn frame (not past it), and truncating the
+        tail restores appendability — the recovery path's contract
+        (server._recover_from_wal torn-tail truncation)."""
+        path = str(tmp_path / "torn.wal")
+        h = StorageHub(path)
+        a = h.do_sync_action(LogAction("append", entry="good", sync=True))
+        h.stop()
+        with open(path, "ab") as f:  # torn frame: header, missing body
+            f.write((999999).to_bytes(8, "little") + b"par")
+        h2 = StorageHub(path)
+        ok = h2.do_sync_action(LogAction("read", offset=0))
+        assert ok.offset_ok and ok.entry == "good"
+        torn = h2.do_sync_action(LogAction("read", offset=a.end_offset))
+        assert not torn.offset_ok
+        res = h2.do_sync_action(
+            LogAction("truncate", offset=a.end_offset, sync=True)
+        )
+        assert res.offset_ok
+        h2.do_sync_action(LogAction("append", entry="after", sync=False))
+        assert h2.do_sync_action(LogAction("sync")).offset_ok
+        back = h2.do_sync_action(LogAction("read", offset=a.end_offset))
+        assert back.offset_ok and back.entry == "after"
+        h2.stop()
+
     def test_native_backend_used_when_available(self, tmp_path):
         if load_wal() is None:
             pytest.skip("no toolchain")
