@@ -134,7 +134,10 @@ class ExternalApi:
             pass
         finally:
             self._writers.pop(int(client), None)
-            writer.close()
+            try:
+                writer.close()
+            except RuntimeError:
+                pass  # loop already closed during teardown
 
     async def _ticker(self) -> None:
         """Batch ticker (parity: external.rs:697-730)."""
@@ -149,7 +152,7 @@ class ExternalApi:
         self._server = await safetcp.tcp_bind_with_retry(
             host, port, self._servant
         )
-        asyncio.ensure_future(self._ticker())
+        self._ticker_task = asyncio.ensure_future(self._ticker())
         # readiness log line is a de-facto API parsed by cluster scripts
         # (reference: workflow_test.py:57-68)
         pf_info(logger, f"accepting clients @ {host}:{port}")
@@ -163,4 +166,6 @@ class ExternalApi:
         try:
             loop.run_forever()
         finally:
-            loop.close()
+            from ..utils.loops import drain_and_close
+
+            drain_and_close(loop)

@@ -1108,6 +1108,7 @@ class ServerReplica:
         }
         for k in (
             "leader", "commit_bar", "exec_bar", "vote_bar", "bal_max",
+            "bal_prepared", "next_slot", "dur_bar",
             "term", "voted_for", "conf_cur",
         ):
             if k in st:

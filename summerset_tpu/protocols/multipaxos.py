@@ -133,6 +133,19 @@ class MultiPaxosKernel(ProtocolKernel):
         st["exec_bar"] = st["exec_bar"].at[g, me].max(fl)
         for k in self.DURABLE_WINDOWS:
             st[k] = st[k].at[g, me].set(jnp.asarray(rec[k], st[k].dtype))
+        # proposal cursor: resume AFTER everything this replica ever
+        # voted or executed.  Without this, a warm-init leader that
+        # crash-restarts fast enough that no follower campaigns (its
+        # ballot still prepared) re-proposes at slot 0 over committed
+        # slots: the re-proposals can never commit (commit_bar is capped
+        # at next_slot) and every new request wedges behind them.
+        abs_arr = jnp.asarray(rec["win_abs"], jnp.int32)
+        bal_arr = jnp.asarray(rec["win_bal"], jnp.int32)
+        filled = (bal_arr > 0) & (abs_arr >= 0)
+        nslot = jnp.maximum(
+            fl, jnp.max(jnp.where(filled, abs_arr + 1, 0))
+        )
+        st["next_slot"] = st["next_slot"].at[g, me].max(nslot)
 
     def __init__(
         self,
