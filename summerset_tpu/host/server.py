@@ -165,7 +165,10 @@ class ServerReplica:
             else None
         )
         self._conf_active: Optional[dict] = None
-        self._conf_queue: List[Tuple[int, ApiRequest]] = []
+        # entries: (client id, request) from the data plane, or
+        # (None, request) for manager-relayed installs
+        self._conf_queue: List[Tuple[Optional[int], ApiRequest]] = []
+        self._conf_seq_seen = 0
         # EPaxos: leaderless — every replica proposes into its own row;
         # execution runs through the exact host Tarjan applier
         self._epaxos = "st2" in self.state
@@ -528,25 +531,17 @@ class ServerReplica:
                 "conf", req_id=req.req_id, success=False,
             ))
             return
-        if self._conf_kind == "ql":
-            # QL conf entries ride the log: only a leader proposes them,
-            # and installation must reach EVERY group — with split
-            # per-group leadership that is structurally impossible from
-            # one server, so fail loudly instead of timing out (the
-            # reference has one group; multi-group conf would need a
-            # manager-mediated conf plane)
-            if not self._is_leader.any():
-                hint = int(self._leader_hint[0])
-                self._reply(client, ApiReply(
-                    "redirect", req_id=req.req_id, redirect=hint,
-                    success=False,
-                ))
-                return
-            if not self._is_leader.all():
-                self._reply(client, ApiReply(
-                    "conf", req_id=req.req_id, success=False,
-                ))
-                return
+        if self._conf_kind == "ql" and not self._is_leader.all():
+            # QL conf entries ride the log, so only each group's leader
+            # can propose them.  Under split per-group leadership this
+            # server cannot install the conf alone: forward the delta
+            # through the manager, which re-announces it to EVERY server
+            # (each proposes for the groups it leads).  Our own
+            # completion check just waits for conf_cur to reach the
+            # target in all groups — however the entries got there.
+            self.ctrl.send_ctrl(CtrlMsg(
+                "conf_forward", {"delta": dict(req.conf_delta or {})}
+            ))
         self._conf_queue.append((client, req))
 
     def _intake(self) -> Tuple[np.ndarray, np.ndarray, Dict]:
@@ -666,9 +661,10 @@ class ServerReplica:
                 (resp == a["resp"]).all() and (lead == a["leader"]).all()
             )
         if done:
-            self._reply(a["client"], ApiReply(
-                "conf", req_id=a["req_id"], success=True,
-            ))
+            if a["client"] is not None:
+                self._reply(a["client"], ApiReply(
+                    "conf", req_id=a["req_id"], success=True,
+                ))
             new_conf = {
                 "responders": [
                     r for r in range(self.population)
@@ -682,9 +678,10 @@ class ServerReplica:
             ))
             self._conf_active = None
         elif self.tick > a["deadline"]:
-            self._reply(a["client"], ApiReply(
-                "conf", req_id=a["req_id"], success=False,
-            ))
+            if a["client"] is not None:
+                self._reply(a["client"], ApiReply(
+                    "conf", req_id=a["req_id"], success=False,
+                ))
             self._conf_active = None
 
     # --------------------------------------------------------- main loop
@@ -1081,6 +1078,27 @@ class ServerReplica:
                         pass
             self.ctrl.send_ctrl(CtrlMsg("reset_reply"))
             return True
+        elif msg.kind == "install_conf":
+            # manager-relayed ConfChange (split per-group leadership),
+            # newest-seq-wins: a stale relay must neither re-queue
+            # behind a newer one (it would revert the conf when it
+            # activated) nor occupy the single active slot
+            d = msg.payload.get("delta") or {}
+            seq = int(msg.payload.get("seq", 0))
+            if self._conf_kind is not None and seq > self._conf_seq_seen:
+                self._conf_seq_seen = seq
+                resp = 0
+                for r in d.get("responders", []):
+                    resp |= 1 << int(r)
+                a = self._conf_active
+                # drop superseded manager-relayed entries from the queue
+                self._conf_queue = [
+                    (c, q) for c, q in self._conf_queue if c is not None
+                ]
+                if not (a is not None and a.get("resp") == resp):
+                    self._conf_queue.append((None, ApiRequest(
+                        "conf", conf_delta=d,
+                    )))
         elif msg.kind == "take_snapshot":
             self._take_snapshot()
             self.ctrl.send_ctrl(CtrlMsg("snapshot_reply"))

@@ -54,6 +54,7 @@ class ClusterManager:
         self.conf: Optional[dict] = None
         self._next_sid = 0
         self._next_cid = 1000
+        self._conf_seq = 0  # total order over relayed ConfChanges
         # kind -> list of waiter queues: every waiter sees every reply of
         # that kind (and filters by sid), so concurrent ctrl clients can't
         # steal each other's acks
@@ -146,6 +147,27 @@ class ClusterManager:
             pf_info(logger, f"leader status: {self.leader}")
         elif msg.kind == "responders_conf":
             self.conf = p.get("new_conf")
+        elif msg.kind == "conf_forward":
+            # a server that does not lead every group relays a client
+            # ConfChange here; re-announce it to ALL servers so each
+            # group's leader proposes the conf entry for its groups.
+            # The seq is assigned synchronously (single event loop) so
+            # concurrent relays are totally ordered; receivers apply
+            # newest-seq-wins, which keeps every group converging on the
+            # same final conf even when per-connection deliveries of two
+            # racing changes interleave differently.
+            self._conf_seq += 1
+            payload = {"delta": p.get("delta") or {}, "seq": self._conf_seq}
+            for s in list(self.servers.values()):
+                if s.joined and not s.writer.is_closing():
+                    try:
+                        await safetcp.send_msg(
+                            s.writer, CtrlMsg("install_conf", payload)
+                        )
+                    except (ConnectionError, OSError):
+                        pass
+            pf_info(logger, f"conf relayed (seq {self._conf_seq}): "
+                            f"{p.get('delta')}")
         elif msg.kind == "snapshot_up_to":
             pf_info(
                 logger,
