@@ -112,12 +112,10 @@ class ServerReplica:
             kcfg.exec_follows_commit = False
         if hasattr(kcfg, "max_proposals_per_tick"):
             kcfg.max_proposals_per_tick = 1  # one ReqBatch per group/tick
-        if protocol == "EPaxos":
-            # host vids are sequential per group, so key->bucket conflict
-            # detection cannot ride vid % K: collapse to one bucket —
-            # every command interferes (safe total order; the per-key
-            # concurrency axis needs key-residue vid allocation, future)
-            kcfg.num_key_buckets = 1
+        # EPaxos conflict detection rides vid % num_key_buckets: the host
+        # mints vids in residue classes that encode (key bucket, replica)
+        # so same-key commands interfere and different-key commands stay
+        # concurrent (see _intake's per-bucket proposal path)
         self.kernel = make_protocol(
             protocol, self.G, self.population, window, kcfg
         )
@@ -170,9 +168,12 @@ class ServerReplica:
         self._conf_queue: List[Tuple[Optional[int], ApiRequest]] = []
         self._conf_seq_seen = 0
         # EPaxos: leaderless — every replica proposes into its own row;
-        # execution runs through the exact host Tarjan applier
+        # execution runs through the exact host Tarjan applier.  One key
+        # bucket is proposed per group per tick (vid residue must encode
+        # the bucket); the rest wait in _ep_defer for the next ticks.
         self._epaxos = "st2" in self.state
         self._ep_exec: Dict[int, Any] = {}
+        self._ep_defer: Dict[int, list] = {}
         if self._epaxos:
             from .epaxos_exec import EPaxosExecutor
 
@@ -180,6 +181,7 @@ class ServerReplica:
                 self._ep_exec[g] = EPaxosExecutor(
                     self.population, window, self._make_ep_apply(g)
                 )
+                self._ep_defer[g] = []
         # Crossword: host predictive shard-assignment (linreg + qdisc)
         self._adaptive = None
         if "cur_spr" in self.state:
@@ -553,6 +555,9 @@ class ServerReplica:
         piggy: Dict[Tuple[int, int], Any] = {}
         batch = self.external.get_req_batch(timeout=0)
         if not batch:
+            if self._epaxos and any(self._ep_defer.values()):
+                # deferred buckets must drain even on idle intake ticks
+                return self._intake_epaxos({}, n_prop, vbase, piggy)
             return n_prop, vbase, piggy
         by_group: Dict[int, list] = {}
         for client, req in batch:
@@ -566,8 +571,10 @@ class ServerReplica:
                 by_group.setdefault(
                     self.group_of(req.cmd.key), []
                 ).append((client, req))
+        if self._epaxos:
+            return self._intake_epaxos(by_group, n_prop, vbase, piggy)
         for g, reqs in by_group.items():
-            if not self._epaxos and not self._is_leader[g]:
+            if not self._is_leader[g]:
                 pending = []
                 local_ok = self._can_local_read(g)
                 for client, req in reqs:
@@ -596,6 +603,42 @@ class ServerReplica:
             if self._adaptive is not None:
                 nb = float(len(pickle.dumps(reqs)))
                 self._batch_bytes = 0.9 * self._batch_bytes + 0.1 * nb
+        return n_prop, vbase, piggy
+
+    def _key_bucket(self, key: str) -> int:
+        """Key -> EPaxos conflict bucket (independent hash from the
+        group routing so multi-group deployments don't alias)."""
+        K = self.kernel.config.num_key_buckets
+        return zlib.crc32(key.encode() + b"#b") % K
+
+    def _intake_epaxos(self, by_group, n_prop, vbase, piggy):
+        """EPaxos proposal path: every replica proposes (leaderless),
+        ONE key bucket per group per tick, with the vid minted in the
+        residue class ``bucket + K * me (mod K * R)`` so the kernel's
+        ``vid % K`` conflict detection sees real key interference while
+        concurrent proposers stay collision-free.  Requests for other
+        buckets wait in ``_ep_defer`` for the following ticks."""
+        K = self.kernel.config.num_key_buckets
+        R = self.population
+        for g, reqs in by_group.items():
+            self._ep_defer[g].extend(reqs)
+        for g in range(self.G):
+            pend = self._ep_defer[g]
+            if not pend:
+                continue
+            bucket = self._key_bucket(pend[0][1].cmd.key)
+            take, keep = [], []
+            for c, r in pend:
+                (take if self._key_bucket(r.cmd.key) == bucket
+                 else keep).append((c, r))
+            self._ep_defer[g] = keep
+            vid = self.payloads.put(
+                g, take, stride=K * R, residue=bucket + K * self.me
+            )
+            self.origin.add((g, vid))
+            n_prop[g] = 1
+            vbase[g] = vid
+            piggy[(g, vid)] = take
         return n_prop, vbase, piggy
 
     # ------------------------------------------------------------ conf plane
