@@ -54,7 +54,7 @@ from .control import ControlHub
 from .external import ExternalApi
 from .messages import ApiReply, ApiRequest, CtrlMsg
 from .payload import PayloadStore
-from .statemach import StateMachine, apply_command
+from .statemach import CommandResult, StateMachine, apply_command
 from .storage import LogAction, StorageHub
 from .transport import TransportHub
 from ..utils.stopwatch import Stopwatch
@@ -96,6 +96,7 @@ class ServerReplica:
         self.record_breakdown = bool(cfg.pop("record_breakdown", False))
         self._stopwatch = Stopwatch() if self.record_breakdown else None
         self._bd_last_print = time.monotonic()
+        self.near_quorum_reads = bool(cfg.pop("near_quorum_reads", False))
 
         # control plane first: the manager assigns our id (control.rs:43)
         self.ctrl = ControlHub(manager_addr)
@@ -145,6 +146,13 @@ class ServerReplica:
         # runs before any reply/ack referencing them leaves the process
         self._wal_dirty = False
         self._reply_queue: List[Tuple[int, ApiReply]] = []
+        # near-quorum reads (parity: multipaxos/quorumread.rs): per-key
+        # last applied write slot + in-flight read-query bookkeeping
+        self._wslot: Dict[str, int] = {}
+        self._qreads: Dict[int, dict] = {}
+        self._qread_next = 0
+        self._pending_rq: Dict[int, list] = {}  # dst -> [(rid, key, g)]
+        self._pending_rqr: Dict[int, list] = {}
         self.kv_need: Set[int] = set()     # groups that jumped past window
         self.paused = False
         self.stopping = False  # cooperative stop for embedded harnesses
@@ -191,6 +199,14 @@ class ServerReplica:
                 self.population, self.kernel.data_shards, self.me,
             )
             self._batch_bytes = 0.0  # EWMA of proposed batch sizes
+
+        # near-quorum reads need the MultiPaxos-family vote-run contract
+        # and a single-writer-per-slot log (not the EPaxos 2-D space)
+        self._nqr_ok = (
+            self.near_quorum_reads
+            and "vote_bar" in self.state
+            and not self._epaxos
+        )
 
         self._recover_from_snapshot()
         self._recover_from_wal()
@@ -257,6 +273,8 @@ class ServerReplica:
         floors = meta["applied"]
         for g, fl in enumerate(floors[: self.G]):
             self.applied[g] = max(self.applied[g], int(fl))
+        for k, s in meta.get("wslots", {}).items():
+            self._wslot[k] = max(self._wslot.get(k, -1), int(s))
         for g, rows in enumerate(meta.get("ep_rows", [])[: self.G]):
             ex = self._ep_exec.get(g)
             if ex is not None:
@@ -316,6 +334,8 @@ class ServerReplica:
                     for client, req in batch:
                         if req.cmd is not None:
                             apply_command(self.statemach._kv, req.cmd)
+                            if req.cmd.kind == "put":
+                                self._wslot[req.cmd.key] = slot
                 self.applied[g] = max(self.applied[g], slot + 1)
             off = res.end_offset
             n += 1
@@ -403,7 +423,14 @@ class ServerReplica:
         replaced atomically instead of appended (same recovery semantics,
         'production would use an LSM-tree' note mod.rs:278-280)."""
         kv = self.statemach.snapshot_items()
-        meta: Dict[str, Any] = {"applied": list(self.applied)}
+        meta: Dict[str, Any] = {
+            "applied": list(self.applied),
+            # near-quorum reads pick the max write slot across a quorum;
+            # losing this map to a snapshot would make a recovered
+            # replica report wslot -1 for keys it actually holds NEWER
+            # values of, letting a lagging peer's older value win
+            "wslots": dict(self._wslot),
+        }
         if self._epaxos:
             meta["ep_rows"] = [
                 list(self._ep_exec[g].floor) for g in range(self.G)
@@ -584,6 +611,8 @@ class ServerReplica:
                             "reply", req_id=req.req_id, result=res,
                             local=True,
                         ))
+                    elif self._nqr_ok and req.cmd.kind == "get":
+                        self._start_qread(client, req, g)
                     else:
                         pending.append((client, req))
                 hint = int(self._leader_hint[g])
@@ -604,6 +633,105 @@ class ServerReplica:
                 nb = float(len(pickle.dumps(reqs)))
                 self._batch_bytes = 0.9 * self._batch_bytes + 0.1 * nb
         return n_prop, vbase, piggy
+
+    # ------------------------------------------------- near-quorum reads
+    def _tail_writes_key(self, g: int, key: str) -> bool:
+        """Does our voted-but-unexecuted window tail possibly contain a
+        write to ``key``?  Conservative: an unresolvable payload counts
+        as a hit (parity role: quorumread.rs's highest-slot check — a
+        voted write the quorum has seen must block the fast read)."""
+        st = self.state
+        win_abs = np.asarray(st["win_abs"])[g, self.me]
+        win_bal = np.asarray(st["win_bal"])[g, self.me]
+        win_val = np.asarray(st[self.kernel.VALUE_WINDOW])[g, self.me]
+        hi = max(
+            int(np.asarray(st["vote_bar"])[g, self.me]),
+            int(np.asarray(st["next_slot"])[g, self.me]),
+        )
+        tail = (
+            (win_bal > 0) & (win_abs >= self.applied[g]) & (win_abs < hi)
+        )
+        for vid in set(int(v) for v in win_val[tail]):
+            if vid == 0:
+                continue
+            batch = self.payloads.get(g, vid)
+            if batch is None:
+                return True  # can't inspect: be conservative
+            for _c, req in batch:
+                if (
+                    req.cmd is not None
+                    and req.cmd.kind == "put"
+                    and req.cmd.key == key
+                ):
+                    return True
+        return False
+
+    def _local_read_sample(self, g: int, key: str) -> Tuple[Any, int, bool]:
+        return (
+            self.statemach._kv.get(key),
+            self._wslot.get(key, -1),
+            self._tail_writes_key(g, key),
+        )
+
+    def _start_qread(self, client: int, req: ApiRequest, g: int) -> None:
+        """Begin a near-quorum read (quorumread.rs ReadQuery fan-out):
+        sample ourselves now, ask every peer through the tick frames, and
+        serve once a majority answered with no in-flight write in sight.
+        Safety: a completed write holds votes at a majority, which
+        intersects our read quorum — the intersecting member either
+        applied it (its wslot sample reflects it) or still has it in its
+        voted tail (tail hit -> fall back to the leader path)."""
+        rid = self._qread_next
+        self._qread_next += 1
+        key = req.cmd.key
+        self._qreads[rid] = {
+            "client": client,
+            "req": req,
+            "g": g,
+            "key": key,
+            "replies": {self.me: self._local_read_sample(g, key)},
+            "deadline": self.tick + 400,
+        }
+        # fan out to a near-quorum subset, not everyone (quorumread.rs
+        # queries quorum-1 peers; extra samples would be discarded)
+        need = self.kernel.quorum - 1
+        for dst in self.transport.peers()[:max(need, 0)]:
+            self._pending_rq.setdefault(dst, []).append((rid, key, g))
+        self._qread_check(rid)
+
+    def _qread_check(self, rid: int) -> None:
+        qr = self._qreads.get(rid)
+        if qr is None or len(qr["replies"]) < self.kernel.quorum:
+            return
+        del self._qreads[rid]
+        req = qr["req"]
+        samples = list(qr["replies"].values())
+        if any(hit for _v, _s, hit in samples):
+            # an in-flight write touches the key: fall back to the log
+            # path at the leader (the reference's rq_retry hint)
+            hint = int(self._leader_hint[qr["g"]])
+            self._reply(qr["client"], ApiReply(
+                "redirect", req_id=req.req_id, redirect=hint,
+                success=False, rq_retry=True,
+            ))
+            return
+        value, _slot, _hit = max(samples, key=lambda x: x[1])
+        self._reply(qr["client"], ApiReply(
+            "reply", req_id=req.req_id,
+            result=CommandResult("get", value=value), local=True,
+        ))
+
+    def _qread_expire(self) -> None:
+        for rid in [
+            r for r, q in self._qreads.items()
+            if self.tick > q["deadline"]
+        ]:
+            qr = self._qreads.pop(rid)
+            hint = int(self._leader_hint[qr["g"]])
+            self._reply(qr["client"], ApiReply(
+                "redirect", req_id=qr["req"].req_id, redirect=hint,
+                success=False, rq_retry=True,
+            ))
 
     def _key_bucket(self, key: str) -> int:
         """Key -> EPaxos conflict bucket (independent hash from the
@@ -767,16 +895,28 @@ class ServerReplica:
             if self._pending_kv_serve:
                 payload_msg["kv"] = self.statemach.snapshot_items()
                 payload_msg["kv_floor"] = list(self.applied)
+                payload_msg["kv_wslots"] = dict(self._wslot)
                 if self._epaxos:
                     payload_msg["kv_ep"] = [
                         list(self._ep_exec[g].floor)
                         for g in range(self.G)
                     ]
                 self._pending_kv_serve = False
+            rq = self._pending_rq
+            rqr = self._pending_rqr
+            self._pending_rq = {}
+            self._pending_rqr = {}
+
+            def _frame(dst):
+                f = {"msg": frames[dst], **payload_msg}
+                if dst in rq:
+                    f["rq"] = rq[dst]
+                if dst in rqr:
+                    f["rqr"] = rqr[dst]
+                return f
+
             self.transport.send_tick(
-                self.tick,
-                {dst: {"msg": frames[dst], **payload_msg}
-                 for dst in frames},
+                self.tick, {dst: _frame(dst) for dst in frames}
             )
             got = self.transport.recv_tick(self.tick, deadline)
             self._ingest_payloads(got)
@@ -828,6 +968,7 @@ class ServerReplica:
                 sw.record_now(self.tick, 4)  # durable log
             self._apply_committed(fx)
             self._flush_durability()
+            self._qread_expire()
             self._conf_progress()
             self._leader_edges(fx)
             if sw is not None:
@@ -885,11 +1026,23 @@ class ServerReplica:
                     self._pending_kv_serve = True
                 if "kv" in f and self.kv_need:
                     self._merge_kv(
-                        f["kv"], f["kv_floor"], f.get("kv_ep")
+                        f["kv"], f["kv_floor"], f.get("kv_ep"),
+                        f.get("kv_wslots"),
                     )
+                # near-quorum read queries/replies (quorumread.rs planes)
+                for rid, key, g in f.get("rq", []):
+                    self._pending_rqr.setdefault(src, []).append(
+                        (rid,) + self._local_read_sample(g, key)
+                    )
+                for rid, value, wslot, hit in f.get("rqr", []):
+                    qr = self._qreads.get(rid)
+                    if qr is not None and src not in qr["replies"]:
+                        qr["replies"][src] = (value, wslot, hit)
+                        self._qread_check(rid)
 
     def _merge_kv(self, kv: dict, floors: list,
-                  ep_floors: Optional[list] = None) -> None:
+                  ep_floors: Optional[list] = None,
+                  wslots: Optional[dict] = None) -> None:
         """Install-snapshot KV merge, guarded per group: only groups that
         jumped take the provider's state, and only when the provider's
         floor covers our claimed floor — a stale provider must never
@@ -919,6 +1072,14 @@ class ServerReplica:
             k: v for k, v in kv.items() if self.group_of(k) in ok_groups
         }
         self.statemach._kv.update(upd)
+        # the transferred values' write slots must ride along, or a
+        # jumped replica would report stale/absent wslots for NEWER
+        # values and lose the near-quorum-read max-by-wslot comparison
+        # to a lagging peer's older value (linearizability violation)
+        for k in upd:
+            s = (wslots or {}).get(k)
+            if s is not None:
+                self._wslot[k] = max(self._wslot.get(k, -1), int(s))
         for g in ok_groups:
             self.applied[g] = max(self.applied[g], int(floors[g]))
             if self._epaxos:
@@ -1047,6 +1208,8 @@ class ServerReplica:
                 mine = (g, vid) in self.origin
                 for client, req in batch:
                     res = apply_command(self.statemach._kv, req.cmd)
+                    if req.cmd.kind == "put":
+                        self._wslot[req.cmd.key] = slot
                     if mine:
                         self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,

@@ -351,6 +351,121 @@ def ql_cluster(tmp_path_factory):
 
 
 @pytest.fixture(scope="class")
+def nqr_cluster(tmp_path_factory):
+    c = Cluster(
+        "MultiPaxos", 3, tmp_path_factory.mktemp("nqr_cluster"),
+        config={"near_quorum_reads": True},
+    )
+    yield c
+    c.stop()
+
+
+class TestClusterNearQuorumReads:
+    def test_follower_serves_quorum_read(self, nqr_cluster):
+        """Near-quorum reads (parity: multipaxos/quorumread.rs): a
+        follower answers a GET by sampling a majority's (value, write
+        slot) instead of redirecting; an in-flight write to the key
+        falls back to the leader path (rq_retry redirect)."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.host.messages import CtrlRequest
+
+        ep = GenericEndpoint(nqr_cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        drv.checked_put("nqr_key", "v1")
+        time.sleep(0.5)  # let followers apply
+        leader = ep.ctrl.request(CtrlRequest("query_info")).leader or 0
+        follower = next(s for s in sorted(ep.servers) if s != leader)
+        ep2 = GenericEndpoint(nqr_cluster.manager_addr,
+                              server_id=follower)
+        ep2.connect()
+        drv2 = DriverClosedLoop(ep2)
+        got = None
+        for _ in range(20):
+            r = drv2.get("nqr_key")
+            if r.kind == "success" and r.local:
+                got = r
+                break
+            ep2.reconnect(follower)
+            time.sleep(0.2)
+        assert got is not None, "follower never served a quorum read"
+        assert got.result.value == "v1"
+        ep2.leave()
+        ep.leave()
+
+    def test_quorum_read_history_linearizable(self, nqr_cluster):
+        """Writer streams unique values while follower-pinned readers
+        use the quorum-read path; the combined history must check out
+        (the tail-hit fallback is what keeps in-flight writes safe)."""
+        import threading as _threading
+
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+        from summerset_tpu.host.messages import CtrlRequest
+        from summerset_tpu.utils.linearize import (
+            check_history, record_get, record_put,
+        )
+
+        ops = []
+        stop = _threading.Event()
+        ep = GenericEndpoint(nqr_cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        leader = ep.ctrl.request(CtrlRequest("query_info")).leader or 0
+        followers = [s for s in sorted(ep.servers) if s != leader][:2]
+
+        def reader(ci, sid):
+            ep2 = GenericEndpoint(nqr_cluster.manager_addr,
+                                  server_id=sid)
+            ep2.connect()
+            drv2 = DriverClosedLoop(ep2, timeout=3.0)
+            while not stop.is_set():
+                t0 = time.monotonic()
+                r = drv2.get("nqr_hist")
+                t1 = time.monotonic()
+                if r.kind == "success":
+                    val = r.result.value if r.result else None
+                    ops.append(record_get(ci, "nqr_hist", val, t0, t1))
+                else:
+                    ep2.reconnect(sid)
+                    time.sleep(0.05)
+            try:
+                ep2.leave()
+            except Exception:
+                pass
+
+        threads = [
+            _threading.Thread(target=reader, args=(10 + i, sid),
+                              daemon=True)
+            for i, sid in enumerate(followers)
+        ]
+        for t in threads:
+            t.start()
+        for seq in range(12):
+            val = f"w-{seq}"
+            t0 = time.monotonic()
+            rep = drv.put("nqr_hist", val)
+            t1 = time.monotonic()
+            if rep.kind == "success":
+                ops.append(record_put(0, "nqr_hist", val, t0, t1, True))
+            elif rep.kind in ("timeout", "failure"):
+                ops.append(record_put(0, "nqr_hist", val, t0, None,
+                                      False))
+                drv._failover(rep)
+            time.sleep(0.25)
+        time.sleep(0.8)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        ep.leave()
+        reads = [o for o in ops if o.kind == "get"]
+        assert len(reads) > 8, f"too few reads: {len(reads)}"
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+
+@pytest.fixture(scope="class")
 def ql8_cluster(tmp_path_factory):
     c = Cluster(
         "QuorumLeases", 3, tmp_path_factory.mktemp("ql8_cluster"),
