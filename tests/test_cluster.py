@@ -711,7 +711,9 @@ class TestClusterBodega:
         )
         ep2.connect()
         drv2 = DriverClosedLoop(ep2)
-        deadline = time.monotonic() + 30
+        # generous: config leases install only after outgoing leases at
+        # the old conf lapse, and ticks stretch under full-suite load
+        deadline = time.monotonic() + 75
         got = None
         while time.monotonic() < deadline:
             r = drv2.get("bod_key")
