@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""WAN emulation helpers for host clusters: tc-netem per interface.
+
+Parity: reference ``scripts/utils/net.py`` — applies ``tc qdisc ...
+netem delay/jitter/rate`` to each replica's (veth) interface so
+WAN/geo experiments run on one Linux box, and clears them after.
+
+Degradation: requires the ``sch_netem`` kernel module and CAP_NET_ADMIN;
+``netem_available()`` probes first and every apply is a no-op-with-
+warning without it (this build box has tc but no netem module).  Command
+construction is pure and unit-testable (`netem_cmd`).
+
+The device-level counterpart is ``core/netmodel.py`` (delay/jitter/drop
+as tensor transforms), which is what the kernel test suites use; this
+module exists for REAL host clusters on capable machines.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import List, Optional
+
+
+def netem_cmd(dev: str, delay_ms: float = 0.0, jitter_ms: float = 0.0,
+              rate_gbps: float = 0.0, loss_pct: float = 0.0,
+              replace: bool = True) -> List[str]:
+    """Build the ``tc qdisc`` argv for a netem discipline (pure)."""
+    cmd = [
+        "tc", "qdisc", "replace" if replace else "add",
+        "dev", dev, "root", "netem",
+    ]
+    if delay_ms > 0:
+        cmd += ["delay", f"{delay_ms}ms"]
+        if jitter_ms > 0:
+            cmd += [f"{jitter_ms}ms", "distribution", "pareto"]
+    if loss_pct > 0:
+        cmd += ["loss", f"{loss_pct}%"]
+    if rate_gbps > 0:
+        cmd += ["rate", f"{rate_gbps}gbit"]
+    return cmd
+
+
+def clear_cmd(dev: str) -> List[str]:
+    return ["tc", "qdisc", "del", "dev", dev, "root"]
+
+
+def netem_available(dev: str = "lo") -> bool:
+    """Probe: tc present AND the sch_netem module loadable."""
+    if shutil.which("tc") is None:
+        return False
+    probe = subprocess.run(
+        netem_cmd(dev, delay_ms=0.1), capture_output=True, text=True
+    )
+    if probe.returncode == 0:
+        subprocess.run(clear_cmd(dev), capture_output=True)
+        return True
+    return False
+
+
+def apply_netem(dev: str, delay_ms: float = 0.0, jitter_ms: float = 0.0,
+                rate_gbps: float = 0.0, loss_pct: float = 0.0
+                ) -> Optional[str]:
+    """Apply a netem discipline; returns an error string instead of
+    raising so orchestration scripts can degrade to no emulation."""
+    r = subprocess.run(
+        netem_cmd(dev, delay_ms, jitter_ms, rate_gbps, loss_pct),
+        capture_output=True, text=True,
+    )
+    return None if r.returncode == 0 else (r.stderr.strip() or "tc failed")
+
+
+def clear_netem(dev: str) -> None:
+    subprocess.run(clear_cmd(dev), capture_output=True)
+
+
+if __name__ == "__main__":
+    import sys
+
+    dev = sys.argv[1] if len(sys.argv) > 1 else "lo"
+    if not netem_available(dev):
+        print(f"netem unavailable on {dev} (sch_netem module or "
+              "CAP_NET_ADMIN missing); commands it would run:")
+        print(" ", " ".join(netem_cmd(dev, 10, 2, 1)))
+        print(" ", " ".join(clear_cmd(dev)))
+        raise SystemExit(1)
+    err = apply_netem(dev, delay_ms=10, jitter_ms=2, rate_gbps=1)
+    print("applied" if err is None else f"failed: {err}")

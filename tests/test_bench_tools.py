@@ -62,3 +62,40 @@ class TestExternalAdapters:
 
         with pytest.raises((SummersetError, Exception)):
             EtcdKvClient(("127.0.0.1", 2379), timeout=0.1)
+
+
+class TestNetemCmds:
+    def test_command_construction(self):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ))
+        from utils_net import clear_cmd, netem_cmd
+
+        cmd = netem_cmd("veth0", delay_ms=10, jitter_ms=2,
+                        rate_gbps=1, loss_pct=0.5)
+        assert cmd[:7] == [
+            "tc", "qdisc", "replace", "dev", "veth0", "root", "netem",
+        ]
+        assert "delay" in cmd and "10ms" in cmd and "2ms" in cmd
+        assert "loss" in cmd and "0.5%" in cmd
+        assert "rate" in cmd and "1gbit" in cmd
+        assert clear_cmd("veth0") == [
+            "tc", "qdisc", "del", "dev", "veth0", "root",
+        ]
+
+    def test_graceful_degradation_probe(self):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ))
+        from utils_net import netem_available
+
+        # must not raise regardless of kernel capabilities
+        assert netem_available("lo") in (True, False)
