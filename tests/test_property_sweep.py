@@ -1,0 +1,119 @@
+"""Randomized fault-schedule property sweep across protocol kernels.
+
+The tier-4 assurance layer alongside the linearizability harness
+(SURVEY.md §4: "property tests replacing TLA+ assurance"): seeded random
+schedules of pauses and link partitions drive each consensus kernel
+through segments of lockstep ticks on a lossy network, asserting the two
+safety invariants every TLA+ spec in the reference checks:
+
+- **agreement**: no two replicas ever commit different values for the
+  same slot (tla+/multipaxos_smr_style/MultiPaxos.tla consistency);
+- **durability of decisions**: once a (slot -> value) binding is
+  committed anywhere, later states never show a different value there.
+
+Liveness is deliberately NOT asserted (schedules may partition away the
+majority for a while); Raft-family and Paxos-family kernels share the
+same harness.  Seeds are fixed — failures reproduce deterministically.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from summerset_tpu.core import Engine, NetConfig
+from summerset_tpu.protocols import make_protocol
+
+from smr_helpers import check_agreement, committed_values, run_segment
+
+# 7 protocols x 2 seeds x ~400 lockstep ticks each: superset-run only
+pytestmark = pytest.mark.slow
+
+G, R, W, P = 2, 3, 32, 4
+
+CONFIGS = {
+    "multipaxos": {},
+    "raft": {},
+    "rspaxos": {"fault_tolerance": 0},
+    "craft": {"fault_tolerance": 0},
+    "crossword": {"fault_tolerance": 0},
+    "quorumleases": {},
+    "bodega": {},
+}
+
+
+def _kernel(name):
+    import dataclasses
+
+    base = make_protocol(name, G, R, W)
+    cfg = dataclasses.replace(
+        base.config, max_proposals_per_tick=P, **CONFIGS[name]
+    )
+    return make_protocol(name, G, R, W, cfg)
+
+
+def _val_key(name):
+    return "win_val"
+
+
+def _merge_committed(st, acc):
+    """Fold every replica's committed bindings into acc, asserting no
+    binding ever changes (durability of decisions)."""
+    for g in range(G):
+        for r in range(R):
+            for slot, v in committed_values(st, g, r, W).items():
+                key = (g, slot)
+                if key in acc:
+                    assert acc[key] == v, (
+                        f"committed value changed: g{g} slot {slot}: "
+                        f"{acc[key]} -> {v} (replica {r})"
+                    )
+                else:
+                    acc[key] = v
+    return acc
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+@pytest.mark.parametrize("seed", [3, 17])
+def test_random_fault_schedule_safety(name, seed):
+    rng = random.Random(1000 * seed + hash(name) % 997)
+    net = NetConfig(delay_ticks=1, jitter_ticks=1, drop_rate=0.05,
+                    max_delay_ticks=3)
+    eng = Engine(_kernel(name), netcfg=net, seed=seed)
+    state, ns = eng.init()
+
+    committed = {}
+    base = 1
+    for segment in range(6):
+        # random pause set (any subset, including majority loss) and a
+        # random symmetric partition for this segment
+        alive = np.ones((G, R), bool)
+        for r in range(R):
+            if rng.random() < 0.25:
+                alive[:, r] = False
+        link = np.ones((G, R, R), bool)
+        if rng.random() < 0.4:
+            cut = rng.randrange(R)
+            link[:, cut, :] = link[:, :, cut] = False
+            link[:, cut, cut] = True
+        ticks = rng.randrange(30, 70)
+        state, ns, _ = run_segment(
+            eng, state, ns, ticks, n_prop=P,
+            alive=jnp.asarray(alive), link_up=jnp.asarray(link),
+            base_start=base,
+        )
+        base += ticks
+        st = {k: np.asarray(v) for k, v in state.items()}
+        check_agreement(st, G, R, W, val_key=_val_key(name))
+        committed = _merge_committed(st, committed)
+
+    # heal completely and confirm the invariants still hold after
+    # recovery traffic
+    state, ns, _ = run_segment(
+        eng, state, ns, 120, n_prop=P, base_start=base,
+    )
+    st = {k: np.asarray(v) for k, v in state.items()}
+    check_agreement(st, G, R, W, val_key=_val_key(name))
+    _merge_committed(st, committed)
+    assert len(committed) > 0, "nothing ever committed"
