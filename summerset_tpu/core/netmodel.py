@@ -53,6 +53,12 @@ class NetConfig:
     max_delay_ticks: int = 1  # delay-line depth D (auto-raised to fit jitter)
     jitter_ticks: int = 0     # per-(source, tick) extra delay in [0, jitter]
     drop_rate: float = 0.0    # iid per-message loss probability
+    # pack same-shape int32 lanes into single stacked tensors through the
+    # delay line: one big buffer write/read/transpose instead of ~17
+    # per-lane ops per tick — the per-op dispatch floor identified in
+    # PERF.md.  Semantically identical (equivalence-tested); default off
+    # until measured on the real chip.
+    pack_lanes: bool = False
 
     def __post_init__(self):
         if self.delay_ticks < 1:
@@ -61,6 +67,10 @@ class NetConfig:
             object.__setattr__(
                 self, "max_delay_ticks", self.delay_ticks + self.jitter_ticks
             )
+        if self.pack_lanes and self.max_delay_ticks != 1:
+            # packing targets the uniform-1-tick bench path; the jittered
+            # delay-line enqueue is per-lane-shaped
+            raise ValueError("pack_lanes requires max_delay_ticks == 1")
 
 
 @dataclasses.dataclass
@@ -100,9 +110,62 @@ class NetModel:
         self.G = num_groups
         self.R = population
         self.broadcast_lanes = broadcast_lanes
+        # lane-packing plan: filled lazily from the outbox structure
+        self._pack_pair: tuple = ()
+        self._pack_bcast: tuple = ()
+
+    def _plan_packing(self, zero_outbox: Pytree) -> None:
+        """Group same-shape int32 lanes for stacked transport: per-pair
+        [G, R, R] lanes and per-window broadcast [G, R, W] lanes (uniform
+        W only).  ``flags`` (uint32, masked) and odd shapes stay loose."""
+        pair, bcast = [], []
+        bshape = None
+        for k, v in zero_outbox.items():
+            if k == "flags" or v.dtype != jnp.int32:
+                continue
+            if k in self.broadcast_lanes:
+                if bshape is None:
+                    bshape = v.shape
+                if v.shape == bshape:
+                    bcast.append(k)
+            elif v.shape == (self.G, self.R, self.R):
+                pair.append(k)
+        self._pack_pair = tuple(sorted(pair))
+        self._pack_bcast = tuple(sorted(bcast))
+
+    def _pack(self, outbox: Pytree) -> Pytree:
+        packed = {
+            k: v for k, v in outbox.items()
+            if k not in self._pack_pair and k not in self._pack_bcast
+        }
+        if self._pack_pair:
+            packed["__pair__"] = jnp.stack(
+                [outbox[k] for k in self._pack_pair]
+            )
+        if self._pack_bcast:
+            packed["__bcast__"] = jnp.stack(
+                [outbox[k] for k in self._pack_bcast]
+            )
+        return packed
+
+    def _unpack(self, packed: Pytree) -> Pytree:
+        out = {
+            k: v for k, v in packed.items()
+            if k not in ("__pair__", "__bcast__")
+        }
+        if "__pair__" in packed:
+            for i, k in enumerate(self._pack_pair):
+                out[k] = packed["__pair__"][i]
+        if "__bcast__" in packed:
+            for i, k in enumerate(self._pack_bcast):
+                out[k] = packed["__bcast__"][i]
+        return out
 
     def init_netstate(self, zero_outbox: Pytree, seed: int = 17) -> Pytree:
         D = self.cfg.max_delay_ticks
+        if self.cfg.pack_lanes:
+            self._plan_packing(zero_outbox)
+            zero_outbox = self._pack(dict(zero_outbox))
         bufs = jax.tree.map(
             lambda x: jnp.zeros((D,) + x.shape, x.dtype), zero_outbox
         )
@@ -138,10 +201,25 @@ class NetModel:
             flags = jnp.where(ctrl.alive[:, None, :], flags, jnp.uint32(0))
         raw = dict(raw, flags=flags)
 
-        inbox = {
-            k: (v if k in self.broadcast_lanes else jnp.swapaxes(v, 1, 2))
-            for k, v in raw.items()
-        }
+        if self.cfg.pack_lanes:
+            # ONE transpose over the stacked pair tensor, then cheap
+            # per-lane slices back into the dict the kernels consume
+            inbox = {}
+            for k, v in raw.items():
+                if k == "__pair__":
+                    v = jnp.swapaxes(v, 2, 3)
+                elif k != "__bcast__" and k not in self.broadcast_lanes:
+                    v = jnp.swapaxes(v, 1, 2)
+                inbox[k] = v
+            inbox = self._unpack(inbox)
+        else:
+            inbox = {
+                k: (
+                    v if k in self.broadcast_lanes
+                    else jnp.swapaxes(v, 1, 2)
+                )
+                for k, v in raw.items()
+            }
         return dict(netstate, bufs=bufs), inbox
 
     def push(
@@ -167,6 +245,8 @@ class NetModel:
             rng, u = prng.uniform_unit(rng)
             mask &= u >= cfg.drop_rate
         outbox = dict(outbox, flags=jnp.where(mask, flags, jnp.uint32(0)))
+        if self.cfg.pack_lanes:
+            outbox = self._pack(outbox)
 
         tick = netstate["tick"]
         last_due = netstate["last_due"]

@@ -77,12 +77,21 @@ def netstate_sharding(mesh: Mesh, netstate: Pytree) -> Pytree:
     replicate D, shard G/R; ``rng`` is ``[G, R, R]``; scalars replicate.
     """
 
-    def buf_spec(leaf):
-        axes = [None, "group", "replica"] + [None] * (leaf.ndim - 3)
+    def buf_spec(key, leaf):
+        if key in ("__pair__", "__bcast__"):
+            # lane-packed buffers carry a stacked-lane axis after D:
+            # [D, L, G, R_src, ...] — replicate D and L, shard G/R
+            axes = [None, None, "group", "replica"] + (
+                [None] * (leaf.ndim - 4)
+            )
+        else:
+            axes = [None, "group", "replica"] + [None] * (leaf.ndim - 3)
         return NamedSharding(mesh, P(*axes))
 
     out = dict(netstate)
-    out["bufs"] = jax.tree.map(buf_spec, netstate["bufs"])
+    out["bufs"] = {
+        k: buf_spec(k, v) for k, v in netstate["bufs"].items()
+    }
     out["cursor"] = NamedSharding(mesh, P())
     out["tick"] = NamedSharding(mesh, P())
     out["last_due"] = NamedSharding(mesh, P("group", "replica"))

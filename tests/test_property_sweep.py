@@ -17,6 +17,7 @@ same harness.  Seeds are fixed — failures reproduce deterministically.
 """
 
 import random
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -53,10 +54,6 @@ def _kernel(name):
     return make_protocol(name, G, R, W, cfg)
 
 
-def _val_key(name):
-    return "win_val"
-
-
 def _merge_committed(st, acc):
     """Fold every replica's committed bindings into acc, asserting no
     binding ever changes (durability of decisions)."""
@@ -77,7 +74,7 @@ def _merge_committed(st, acc):
 @pytest.mark.parametrize("name", sorted(CONFIGS))
 @pytest.mark.parametrize("seed", [3, 17])
 def test_random_fault_schedule_safety(name, seed):
-    rng = random.Random(1000 * seed + hash(name) % 997)
+    rng = random.Random(1000 * seed + zlib.crc32(name.encode()))
     net = NetConfig(delay_ticks=1, jitter_ticks=1, drop_rate=0.05,
                     max_delay_ticks=3)
     eng = Engine(_kernel(name), netcfg=net, seed=seed)
@@ -105,7 +102,7 @@ def test_random_fault_schedule_safety(name, seed):
         )
         base += ticks
         st = {k: np.asarray(v) for k, v in state.items()}
-        check_agreement(st, G, R, W, val_key=_val_key(name))
+        check_agreement(st, G, R, W)
         committed = _merge_committed(st, committed)
 
     # heal completely and confirm the invariants still hold after
@@ -114,6 +111,6 @@ def test_random_fault_schedule_safety(name, seed):
         eng, state, ns, 120, n_prop=P, base_start=base,
     )
     st = {k: np.asarray(v) for k, v in state.items()}
-    check_agreement(st, G, R, W, val_key=_val_key(name))
+    check_agreement(st, G, R, W)
     _merge_committed(st, committed)
     assert len(committed) > 0, "nothing ever committed"
