@@ -1,0 +1,27 @@
+#!/bin/bash
+# CI entry (parity: the reference's tests_unit + tests_proc workflows).
+#
+#   ./ci.sh            # tier 1+2: default pytest suite + proc tests
+#   ./ci.sh --full     # adds the slow-marked superset (pytest -m "")
+#
+# Tier 1: kernel/unit/integration suites on the 8-device virtual CPU
+#         mesh (tests/conftest.py pins the platform + compile cache).
+# Tier 2: real multi-process clusters (manager + 3 servers + tester
+#         client over localhost TCP) for MultiPaxos AND Raft — the
+#         reference's proc-test shape (.github/workflow_test.py).
+# Tier 3 (--full): every slow-marked fault-scenario kernel test and the
+#         randomized property sweep.
+set -e
+cd "$(dirname "$0")"
+
+echo "=== tier 1: pytest default suite ==="
+python -m pytest tests/ -q
+
+echo "=== tier 2: process-level cluster tests (MultiPaxos, Raft) ==="
+python scripts/proc_test.py
+
+if [ "$1" = "--full" ]; then
+  echo "=== tier 3: full superset (slow tests included) ==="
+  python -m pytest tests/ -q -m ""
+fi
+echo "CI PASS"

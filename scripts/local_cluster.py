@@ -53,6 +53,32 @@ def wait_for_line(log_path: str, needle: str, timeout: float) -> bool:
     return False
 
 
+def make_cluster_env() -> dict:
+    """Child-process env for cluster processes.
+
+    Forces JAX_PLATFORMS=cpu (override deliberately with
+    SUMMERSET_CLUSTER_PLATFORM): the environment may preset the axon TPU
+    tunnel platform, whose sitecustomize hook dials the tunnel at
+    interpreter startup and hangs every child whenever the tunnel is
+    down.  Only the hook's own PYTHONPATH entries are filtered out —
+    other PYTHONPATH deps survive.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = env.get("SUMMERSET_CLUSTER_PLATFORM", "cpu")
+    parts = [REPO]
+    for entry in env.get("PYTHONPATH", "").split(os.pathsep):
+        if not entry or entry == REPO:
+            continue
+        if env["JAX_PLATFORMS"] == "cpu" and os.path.exists(
+            os.path.join(entry, "sitecustomize.py")
+        ):
+            continue  # the tunnel-dialing startup hook
+        parts.append(entry)
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    return env
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-p", "--protocol", default="MultiPaxos")
@@ -70,10 +96,7 @@ def main() -> int:
         shutil.rmtree(args.backer_dir)
     os.makedirs(args.backer_dir, exist_ok=True)
 
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("PYTHONUNBUFFERED", "1")
+    env = make_cluster_env()
 
     bp = args.base_port
     procs = []
@@ -97,8 +120,16 @@ def main() -> int:
         "--srv-port", str(bp), "--cli-port", str(bp + 1),
         "-n", str(args.num_replicas),
     )
+    def teardown():
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+
     if not wait_for_line(man_log, "manager up", 15):
         print("manager failed to start", file=sys.stderr)
+        teardown()
         return 1
 
     cfg = args.config or protocol_defaults(args.protocol, args.num_replicas)
@@ -117,25 +148,26 @@ def main() -> int:
     for r, slog in enumerate(server_logs):
         if not wait_for_line(slog, "accepting clients", 90):
             print(f"server {r} failed to start", file=sys.stderr)
+            teardown()
             return 1
     print(f"cluster ready: manager @ 127.0.0.1:{bp + 1} "
           f"({args.num_replicas} replicas)")
 
-    def shutdown(*_):
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        raise SystemExit(0)
+    def shutdown(code=0, *_):
+        teardown()
+        raise SystemExit(code)
 
-    signal.signal(signal.SIGINT, shutdown)
-    signal.signal(signal.SIGTERM, shutdown)
-    # babysit: exit if any child dies
+    signal.signal(signal.SIGINT, lambda *_: shutdown(0))
+    signal.signal(signal.SIGTERM, lambda *_: shutdown(0))
+    # babysit: a child dying unexpectedly is a FAILURE exit, so wrapper
+    # scripts checking the code see the crash
     while True:
         time.sleep(1)
         for p in procs:
             if p.poll() is not None:
                 print("a cluster process exited; shutting down",
                       file=sys.stderr)
-                shutdown()
+                shutdown(1)
 
 
 if __name__ == "__main__":

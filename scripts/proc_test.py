@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Process-level CI test: real cluster processes + the tester client.
+
+Parity: reference ``.github/workflow_test.py:37-120`` — build, launch a
+3-replica local cluster, wait for every replica's "accepting clients"
+readiness line, run ``summerset_client -u tester``, tear down; CI runs
+it for MultiPaxos AND Raft (``tests_proc.yml:28-33``).
+
+Usage:
+    python scripts/proc_test.py [-p MultiPaxos,Raft] [--base-port 53300]
+Exit code 0 iff every protocol's tester suite passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from local_cluster import (  # noqa: E402
+    make_cluster_env,
+    protocol_defaults,
+    wait_for_line,
+)
+
+TESTS = ",".join([
+    "primitive_ops", "client_reconnect", "node_pause_resume",
+    "non_leader_reset", "leader_node_reset",
+])
+
+
+def run_one(protocol: str, base_port: int) -> bool:
+    backer = tempfile.mkdtemp(prefix=f"proc_test_{protocol.lower()}_")
+    env = dict(os.environ)
+    # FORCE cpu: the environment may preset JAX_PLATFORMS=axon (TPU
+    # tunnel), which wedges server bring-up whenever the tunnel is down;
+    # set SUMMERSET_CLUSTER_PLATFORM to override deliberately
+    env["JAX_PLATFORMS"] = env.get("SUMMERSET_CLUSTER_PLATFORM", "cpu")
+    if env["JAX_PLATFORMS"] == "cpu":
+        # replace (not prepend) PYTHONPATH: the axon sitecustomize hook
+        # dials the TPU tunnel at interpreter startup, which hangs every
+        # child process whenever the tunnel is down
+        env["PYTHONPATH"] = REPO
+    else:
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    procs = []
+
+    def spawn(name, mod, *argv):
+        log = os.path.join(backer, f"{name}.log")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", mod, *argv],
+            env=env, stderr=open(log, "w", buffering=1),
+        ))
+        return log
+
+    ok = False
+    try:
+        man_log = spawn(
+            "manager", "summerset_tpu.cli.manager",
+            "-p", protocol, "--srv-port", str(base_port),
+            "--cli-port", str(base_port + 1), "-n", "3",
+        )
+        if not wait_for_line(man_log, "manager up", 20):
+            print(f"[{protocol}] manager failed to start")
+            return False
+        cfg = protocol_defaults(protocol, 3)
+        slogs = [
+            spawn(
+                f"server{r}", "summerset_tpu.cli.server",
+                "-p", protocol,
+                "-a", str(base_port + 10 + r),
+                "-i", str(base_port + 30 + r),
+                "-m", f"127.0.0.1:{base_port}",
+                "--backer-dir", backer,
+                *(["-c", cfg] if cfg else []),
+            )
+            for r in range(3)
+        ]
+        for r, slog in enumerate(slogs):
+            if not wait_for_line(slog, "accepting clients", 120):
+                print(f"[{protocol}] server {r} failed to start")
+                return False
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "summerset_tpu.cli.client",
+                 "-u", "tester", "-m", f"127.0.0.1:{base_port + 1}",
+                 "--tests", TESTS],
+                env=env, capture_output=True, text=True, timeout=600,
+            )
+            line = next(
+                (ln for ln in out.stdout.splitlines()
+                 if ln.strip().startswith("{")), "{}",
+            )
+            results = json.loads(line)
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            print(f"[{protocol}] tester failed: {e}")
+            return False
+        print(f"[{protocol}] {results}")
+        ok = bool(results) and all(
+            v == "PASS" for v in results.values()
+        )
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        time.sleep(0.5)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        shutil.rmtree(backer, ignore_errors=True)
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-p", "--protocols", default="MultiPaxos,Raft")
+    ap.add_argument("--base-port", type=int, default=53300)
+    args = ap.parse_args()
+    rc = 0
+    for i, proto in enumerate(
+        p for p in args.protocols.split(",") if p
+    ):
+        if not run_one(proto, args.base_port + 100 * i):
+            rc = 1
+    print("PROC TESTS", "PASS" if rc == 0 else "FAIL")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
