@@ -675,7 +675,14 @@ class TestClusterRSFamily:
 
 @pytest.fixture(scope="class")
 def bodega_cluster(tmp_path_factory):
-    c = Cluster("Bodega", 3, tmp_path_factory.mktemp("bodega_cluster"))
+    # long leases relative to the refresh period: tick-rate skew between
+    # replicas under full-suite load otherwise lapses holds faster than
+    # refreshes land, starving the local-read condition for long spells
+    c = Cluster(
+        "Bodega", 3, tmp_path_factory.mktemp("bodega_cluster"),
+        config={"lease_len": 40, "lease_margin": 8, "grant_interval": 4,
+                "conf_timeout": 80},
+    )
     yield c
     c.stop()
 
