@@ -15,29 +15,77 @@ wall-clock second.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+# NOTE: jax is imported lazily inside main(), AFTER _probe_backend().  When
+# the axon TPU tunnel is down, `import jax` itself hangs (the tunnel is
+# dialed from sitecustomize at interpreter startup, before JAX_PLATFORMS is
+# consulted) — so the only safe fail-fast probe is a bounded subprocess.
+BACKEND_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_BACKEND_TIMEOUT", "150"))
 
-from summerset_tpu.core import Engine
-from summerset_tpu.protocols import make_protocol
-from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
-
-GROUPS = 4096
-POPULATION = 5
+# Shapes are env-overridable for A/B sweeps (pack_lanes, window retries)
+# and for fast happy-path verification on CPU; defaults are the headline
+# TPU shape.
+GROUPS = int(os.environ.get("BENCH_GROUPS", "4096"))
+POPULATION = int(os.environ.get("BENCH_POPULATION", "5"))
 # W=128/P=32 doubles commit throughput over the r2/r3 shape (W=64/P=16)
 # at the SAME ~2.1 ms/tick: the ring window, not the tick cost, was the
 # binding constraint (see PERF.md round-4 sweep)
-WINDOW = 128
-PROPOSALS_PER_TICK = 32
-TICKS = 2048
-RUNS = 3
+WINDOW = int(os.environ.get("BENCH_WINDOW", "128"))
+PROPOSALS_PER_TICK = int(os.environ.get("BENCH_PROPS", "32"))
+TICKS = int(os.environ.get("BENCH_TICKS", "2048"))
+RUNS = int(os.environ.get("BENCH_RUNS", "3"))
 BASELINE = 10_000_000.0
 
 
+def _probe_backend(timeout_s=BACKEND_PROBE_TIMEOUT_S):
+    """Check that `import jax; jax.devices()` completes within timeout_s.
+
+    Runs in a subprocess (inheriting the full env, including any tunnel
+    dialing site hooks) so a dead backend makes THIS process exit fast with
+    a clear error instead of hanging the whole capture window.
+    Returns None on success or an error message on failure.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"backend init timed out after {timeout_s}s "
+                "(TPU tunnel down?)")
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()
+        return tail[-1] if tail else f"probe exited {proc.returncode}"
+    return None
+
+
 def main():
+    # An explicit CPU run (A/B sweeps, verification) can't hang on the
+    # tunnel — skip the probe and its extra interpreter+backend bring-up.
+    err = None
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        err = _probe_backend()
+    if err is not None:
+        print(json.dumps({
+            "metric": "committed slots/sec, MultiPaxos (backend unavailable)",
+            "value": 0.0,
+            "unit": "slots/sec",
+            "vs_baseline": 0.0,
+            "error": err,
+        }))
+        sys.exit(1)
+
+    import jax
+    import numpy as np
+
+    from summerset_tpu.core import Engine
+    from summerset_tpu.protocols import make_protocol
+    from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
+
     # exec_follows_commit=False: commit_bar only advances past slots the
     # (synthetic, saturating) applier has released via exec_floor — the
     # measured slots are commit-AND-execute-eligible, not device-only
