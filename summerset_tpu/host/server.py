@@ -644,13 +644,18 @@ class ServerReplica:
         win_abs = np.asarray(st["win_abs"])[g, self.me]
         win_bal = np.asarray(st["win_bal"])[g, self.me]
         win_val = np.asarray(st[self.kernel.VALUE_WINDOW])[g, self.me]
-        hi = max(
-            int(np.asarray(st["vote_bar"])[g, self.me]),
-            int(np.asarray(st["next_slot"])[g, self.me]),
-        )
-        tail = (
-            (win_bal > 0) & (win_abs >= self.applied[g]) & (win_abs < hi)
-        )
+        # Scan EVERY voted-but-unexecuted window slot, with no upper
+        # bound: bounding by vote_bar/next_slot is unsound because a
+        # higher-ballot accept run-reset rewinds vote_bar without zeroing
+        # win_bal above it, and a committed write voted at the old ballot
+        # above the rewound bar would be missed — a stale fast read if
+        # this replica is the read quorum's only intersection with the
+        # write's vote quorum (the reference instead keeps a sticky
+        # per-key highest_slot refreshed at every accept,
+        # quorumread.rs refresh_highest_slot, which likewise survives
+        # ballot resets).  A stale-ballot leftover only costs a
+        # conservative leader fallback until the new run overwrites it.
+        tail = (win_bal > 0) & (win_abs >= self.applied[g])
         for vid in set(int(v) for v in win_val[tail]):
             if vid == 0:
                 continue
@@ -681,9 +686,21 @@ class ServerReplica:
         intersects our read quorum — the intersecting member either
         applied it (its wslot sample reflects it) or still has it in its
         voted tail (tail hit -> fall back to the leader path)."""
+        key = req.cmd.key
+        need = self.kernel.quorum - 1
+        peers = self.transport.peers()
+        if len(peers) < need:
+            # not enough connected peers for a quorum of samples: redirect
+            # to the leader immediately instead of parking the read until
+            # the expiry sweep (it could never complete)
+            self._reply(client, ApiReply(
+                "redirect", req_id=req.req_id,
+                redirect=int(self._leader_hint[g]),
+                success=False, rq_retry=True,
+            ))
+            return
         rid = self._qread_next
         self._qread_next += 1
-        key = req.cmd.key
         self._qreads[rid] = {
             "client": client,
             "req": req,
@@ -692,10 +709,11 @@ class ServerReplica:
             "replies": {self.me: self._local_read_sample(g, key)},
             "deadline": self.tick + 400,
         }
-        # fan out to a near-quorum subset, not everyone (quorumread.rs
-        # queries quorum-1 peers; extra samples would be discarded)
-        need = self.kernel.quorum - 1
-        for dst in self.transport.peers()[:max(need, 0)]:
+        # fan out to EVERY connected peer and complete on the first quorum
+        # of replies (late extras are discarded at _qread_check): querying
+        # exactly quorum-1 peers lets one paused-but-connected or slow
+        # peer stall every read until the expiry redirect
+        for dst in peers:
             self._pending_rq.setdefault(dst, []).append((rid, key, g))
         self._qread_check(rid)
 

@@ -99,6 +99,13 @@ class TransportHub:
         from collections import deque
 
         self.samples: Any = deque(maxlen=4096)
+        # per-peer "clocks comparable" flag: send-stamped delay samples
+        # subtract the sender's time.monotonic() from ours, and monotonic
+        # bases are unrelated across machines — only loopback/same-host
+        # peers produce meaningful deltas, so sampling is gated on it
+        # (bogus cross-host positives would silently steer the adaptive
+        # Crossword spr choice)
+        self._same_host: Dict[int, bool] = {}
         # per-peer receive queues of (tick, payload)
         self._rq: Dict[int, queue.Queue] = {
             p: queue.Queue() for p in range(population) if p != me
@@ -178,6 +185,24 @@ class TransportHub:
         old = self._conns.get(peer)
         if old is not None and old is not sock:
             hard_close(old)
+        try:
+            rip = sock.getpeername()[0]
+            lip = sock.getsockname()[0]
+        except OSError:
+            rip, lip = "", "-"
+
+        def _norm(ip: str) -> str:
+            # dual-stack listeners hand back IPv4-mapped IPv6 addresses
+            return ip[7:] if ip.startswith("::ffff:") else ip
+
+        rip, lip = _norm(rip), _norm(lip)
+        # same host <=> loopback, or the peer's source address equals our
+        # own address on this very connection (same machine via its real
+        # IP; works for bind-all listeners where p2p_addr is 0.0.0.0)
+        self._same_host[peer] = (
+            rip.startswith("127.") or rip == "::1"
+            or (rip != "" and rip == lip)
+        )
         self._conns[peer] = sock
         self._wlocks[peer] = threading.Lock()
         t = threading.Thread(
@@ -207,9 +232,10 @@ class TransportHub:
                 self._rq[peer].put((tick, payload))
                 # per-peer delivery sample for the adaptive perf model
                 # (send-stamped frames; monotonic is machine-wide, so the
-                # delta is a real one-way delay for same-host deployments)
+                # delta is a real one-way delay ONLY for same-host peers —
+                # cross-host samples are dropped, see _same_host above)
                 ts = payload.get("ts") if isinstance(payload, dict) else None
-                if ts is not None:
+                if ts is not None and self._same_host.get(peer, False):
                     self.samples.append(
                         (peer, nbytes, (time.monotonic() - ts) * 1e3)
                     )

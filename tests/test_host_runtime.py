@@ -183,3 +183,68 @@ class TestPayloadStore:
         assert ps.get(0, v1) is None
         assert ps.get(0, v2) == ["b"]
         assert ps.get(1, w1) == ["c"]
+
+
+class TestTailWritesKey:
+    """Regression: the voted-tail scan behind near-quorum reads must not
+    bound its window scan by vote_bar/next_slot — a higher-ballot accept
+    run-reset rewinds vote_bar WITHOUT zeroing win_bal above it, and a
+    committed write voted at the old ballot above the rewound bar used to
+    be invisible (hit=False), letting a fast read return an older value
+    (parity role: quorumread.rs refresh_highest_slot survives resets)."""
+
+    @staticmethod
+    def _bare_server(win_abs, win_bal, win_val):
+        import numpy as np
+
+        from summerset_tpu.host.server import ServerReplica as Server
+
+        srv = Server.__new__(Server)
+        srv.me = 0
+        srv.applied = [0]
+        srv.payloads = PayloadStore(1)
+        srv.state = {
+            "win_abs": np.asarray([[win_abs]], dtype=np.int32),
+            "win_bal": np.asarray([[win_bal]], dtype=np.int32),
+            "win_val": np.asarray([[win_val]], dtype=np.int32),
+            "vote_bar": np.asarray([[1]], dtype=np.int32),
+            "next_slot": np.asarray([[1]], dtype=np.int32),
+        }
+
+        class _Ker:
+            VALUE_WINDOW = "win_val"
+
+        srv.kernel = _Ker()
+        return srv
+
+    def test_vote_above_rewound_bar_still_blocks_fast_read(self):
+        from summerset_tpu.host.server import ApiRequest
+
+        # slot 2 holds a voted put("k") at vid 7, but vote_bar/next_slot
+        # were rewound to 1 by a ballot reset
+        srv = self._bare_server(
+            win_abs=[0, 1, 2, 3], win_bal=[0, 0, 5, 0],
+            win_val=[0, 0, 7, 0],
+        )
+        srv.payloads._data[0][7] = [
+            (0, ApiRequest("req", 0, Command("put", "k", "v2")))
+        ]
+        assert srv._tail_writes_key(0, "k") is True
+        # a different key in the same tail does not block
+        assert srv._tail_writes_key(0, "other") is False
+
+    def test_unresolvable_payload_is_conservative(self):
+        srv = self._bare_server(
+            win_abs=[0, 1, 2, 3], win_bal=[0, 0, 5, 0],
+            win_val=[0, 0, 9, 0],
+        )
+        # vid 9 payload is unknown locally: must count as a hit
+        assert srv._tail_writes_key(0, "k") is True
+
+    def test_applied_slots_do_not_block(self):
+        srv = self._bare_server(
+            win_abs=[0, 1, 2, 3], win_bal=[3, 3, 0, 0],
+            win_val=[4, 5, 0, 0],
+        )
+        srv.applied = [2]  # both voted slots already executed
+        assert srv._tail_writes_key(0, "k") is False
