@@ -248,3 +248,32 @@ class TestTailWritesKey:
         )
         srv.applied = [2]  # both voted slots already executed
         assert srv._tail_writes_key(0, "k") is False
+
+
+class TestUniqueWindowVids:
+    def test_matches_python_reference(self):
+        import numpy as np
+
+        from summerset_tpu.host.server import _unique_window_vids
+
+        rng = np.random.default_rng(7)
+        G, W = 37, 16
+        win = rng.integers(-2, 9, size=(G, W)).astype(np.int32)
+        groups = np.asarray([0, 3, 5, 36, 12])
+        got = _unique_window_vids(win, groups)
+        for g in groups:
+            ref = sorted(
+                {int(x) for x in win[int(g)].ravel() if int(x) > 0}
+            )
+            assert got.get(int(g), []) == ref, g
+        assert set(got) <= {int(g) for g in groups}
+
+    def test_empty_inputs(self):
+        import numpy as np
+
+        from summerset_tpu.host.server import _unique_window_vids
+
+        assert _unique_window_vids(np.zeros((4, 8)), np.asarray([])) == {}
+        assert _unique_window_vids(
+            np.zeros((4, 8), np.int32), np.asarray([1, 2])
+        ) == {}
