@@ -632,6 +632,16 @@ class ServerReplica:
             return int(ex["n_local_buckets"][g, self.me]) == K
         return False
 
+    def _leader_read_ok(self, g: int) -> bool:
+        """May this LEADER serve reads locally under a stable-leader
+        lease (a confirmed quorum of follower vote-refusal promises)?
+        Parity: multipaxos/leaderlease.rs:10-21 + quorumread.rs's
+        highest-slot freshness check, played by _tail_writes_key."""
+        ex = self._last_extra
+        return bool(ex) and "leader_read_ok" in ex and bool(
+            ex["leader_read_ok"][g, self.me]
+        )
+
     def _handle_conf_req(self, client: int, req: ApiRequest) -> None:
         """Queue a client ConfChange (never silently dropped — reply with
         failure if this kernel has no conf plane; parity:
@@ -703,6 +713,26 @@ class ServerReplica:
                         success=False,
                     ))
                 continue
+            if self._leader_read_ok(g):
+                # stable-leader lease: serve GETs from applied state when
+                # no in-flight write to the key sits in the voted tail
+                # (every acked write is applied here — acks ride
+                # execution — and under a held lease no other proposer
+                # can have committed newer state)
+                to_log = []
+                for client, req in reqs:
+                    if (req.cmd.kind == "get"
+                            and not self._tail_writes_key(g, req.cmd.key)):
+                        res = apply_command(self.statemach._kv, req.cmd)
+                        self._reply(client, ApiReply(
+                            "reply", req_id=req.req_id, result=res,
+                            local=True,
+                        ))
+                    else:
+                        to_log.append((client, req))
+                reqs = to_log
+                if not reqs:
+                    continue
             vid = self.payloads.put(
                 g, reqs, stride=self.population, residue=self.me
             )

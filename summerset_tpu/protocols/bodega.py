@@ -115,6 +115,11 @@ class BodegaKernel(MultiPaxosKernel):
         config: ReplicaConfigBodega | None = None,
     ):
         config = config or ReplicaConfigBodega()
+        if config.leader_leases:
+            raise ValueError(
+                "Bodega's roster leases subsume leader leases; the base "
+                "MultiPaxos leader_leases flag is not supported here"
+            )
         super().__init__(num_groups, population, window, config)
         if config.num_key_buckets > 30:
             raise ValueError("num_key_buckets must be <= 30 (int32 bitmaps)")

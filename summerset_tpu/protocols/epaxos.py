@@ -32,10 +32,12 @@ TPU-first redesign (lockstep, struct-of-arrays):
   invariant holds because a leader counts a peer only once the peer's
   ``sb`` covers the instance, and ``sb`` ships with the same tick's
   table.
-- **Window-bitmask acks**: acceptors report, per row owner, a uint32
-  bitmask over that row's window held at Accepting-or-higher at the
-  row's current ballot (``rp_acc``); slow-path commits tally bit counts.
-  Requires ``window <= 32``.
+- **Cumulative accept frontiers**: acceptors report, per row owner,
+  their contiguous accepted frontier over that row (``rp_abar``:
+  column-identified, Accepting-or-higher at the row's default ballot);
+  slow-path commits tally frontier coverage.  (A per-position bitmask
+  was unsound — ring aliasing let an ack for column c' = c (mod W)
+  count for c; see the producer note in ``_build_outbox``.)
 - **Execution** (device-only mode) is a row-frontier heuristic: per row,
   the first unexecuted instance; a row-level dependency closure (R x R
   boolean squaring) detects cycles, broken by ``(seq, row)`` order — the
@@ -73,7 +75,7 @@ from . import register_protocol
 from .common import INF as _INF, make_greater_ballot, range_cover
 
 # flag bits
-BEACON = 1    # ow/tb/sb/rp_acc lanes valid (sent every tick)
+BEACON = 1    # ow/tb/sb/rp_abar lanes valid (sent every tick)
 ERP = 2       # explicit-prepare campaign for erp_row at erp_bal
 RV = 4        # rv_* lanes carry my stored copy of rv_row (ERP response)
 RO = 8        # ro_* lanes drive a recovered row at ro_bal
@@ -102,7 +104,7 @@ class EPaxosKernel(ProtocolKernel):
         "ro_row", "ro_bal", "ro_abs", "ro_phase", "ro_seq", "ro_val",
         "ro_noop", "ro_deps",
         "rv_row", "rv_bal", "rv_abs", "rv_st", "rv_vbal", "rv_seq",
-        "rv_val", "rv_noop", "rv_deps",
+        "rv_val", "rv_noop", "rv_deps", "rv_bump", "rv_cmt",
     })
 
     # durable acceptor record: the whole 2-D stored-copy space plus the
@@ -112,7 +114,7 @@ class EPaxosKernel(ProtocolKernel):
     # interference and break execution order)
     DURABLE_SCALARS = ("own_next",)
     DURABLE_WINDOWS = (
-        "abs2", "st2", "bal2", "seq2", "val2", "noop2", "deps2",
+        "abs2", "st2", "bal2", "seq2", "val2", "noop2", "deps2", "pbump2",
         "it_col", "it_seq",
     )
     VALUE_WINDOW = "val2"
@@ -138,8 +140,6 @@ class EPaxosKernel(ProtocolKernel):
         config: ReplicaConfigEPaxos | None = None,
     ):
         super().__init__(num_groups, population, window)
-        if window > 32:
-            raise ValueError("epaxos window must be <= 32 (uint32 ack masks)")
         self.config = config or ReplicaConfigEPaxos()
         half = population // 2
         self.simple_q = half + 1
@@ -165,6 +165,11 @@ class EPaxosKernel(ProtocolKernel):
             "val2": z(G, R, R, W),
             "noop2": jnp.zeros((G, R, R, W), jnp.bool_),
             "deps2": z(G, R, R, W, R),
+            # preaccept-merge marker: True iff my stored PREACC copy's
+            # (seq, deps) were bumped past the owner's lane by my tables
+            # at ingest — an UNBUMPED copy equals the owner's original
+            # attrs and is the only valid fast-commit witness in recovery
+            "pbump2": jnp.zeros((G, R, R, W), jnp.bool_),
             # per-row frontiers
             "seen_bar": z(G, R, R),
             "cmt_row": z(G, R, R),
@@ -195,7 +200,9 @@ class EPaxosKernel(ProtocolKernel):
         pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
         return {
             "flags": jnp.zeros((G, R, R), jnp.uint32),
-            "rp_acc": jnp.zeros((G, R, R), jnp.uint32),
+            "rp_abar": jnp.zeros((G, R, R), i32),
+            "rp_abase": jnp.zeros((G, R, R), i32),
+            "rp_pbar": jnp.zeros((G, R, R), i32),
             "erp_row": pair(), "erp_bal": pair(), "erp_ext": pair(),
             "ow_abs": jnp.full((G, R, W), -1, i32),
             "ow_phase": wl(), "ow_bal": wl(), "ow_seq": wl(), "ow_val": wl(),
@@ -211,6 +218,7 @@ class EPaxosKernel(ProtocolKernel):
             "rv_abs": jnp.full((G, R, W), -1, i32),
             "rv_st": wl(), "rv_vbal": wl(), "rv_seq": wl(), "rv_val": wl(),
             "rv_noop": wb(), "rv_deps": jnp.zeros((G, R, W, R), i32),
+            "rv_bump": wb(), "rv_cmt": jnp.zeros((G, R), i32),
         }
 
     # ------------------------------------------------------------- helpers
@@ -362,6 +370,14 @@ class EPaxosKernel(ProtocolKernel):
             (is_pre & fresh)[..., None], merge_deps, l_deps
         )
 
+        # did my phase-1 merge change the owner's attrs?  Tracked so
+        # recovery can tell "copy == owner's original" (fast-commit
+        # witness) from "copy already reflects MY interference view"
+        bumped = (is_pre & fresh) & (
+            (merge_seq > l_seq)
+            | jnp.any(merge_deps != l_deps, axis=4)
+        )
+
         s["abs2"] = jnp.where(apply_m, l_abs, s["abs2"])
         s["st2"] = jnp.where(apply_m, l_ph, s["st2"])
         s["bal2"] = jnp.where(apply_m, bal_lane, s["bal2"])
@@ -369,6 +385,7 @@ class EPaxosKernel(ProtocolKernel):
         s["val2"] = jnp.where(apply_m, l_val, s["val2"])
         s["noop2"] = jnp.where(apply_m, l_noop, s["noop2"])
         s["deps2"] = jnp.where(apply_m[..., None], take_deps, s["deps2"])
+        s["pbump2"] = jnp.where(apply_m, bumped, s["pbump2"])
         self._bump_tables(
             s, apply_m & ~l_noop, l_abs, bucket, take_seq
         )
@@ -457,13 +474,17 @@ class EPaxosKernel(ProtocolKernel):
             bal_o == dbal
         )
 
-        # peers' ingest coverage of my row: inbox sb is [G, src, row];
-        # swap to [G, row(me), src]
-        sb_for_me = jnp.swapaxes(inbox["sb"], 1, 2)
+        # peers' ingest coverage of my row: the attested default-ballot
+        # run [rp_abase, rp_pbar) (pair lanes; see the producer note —
+        # raw seen_bar counted recovery outcomes stored at other ballots
+        # as ingests of MY entries)
         gar = jnp.arange(G)[:, None, None]
-        sb_mine = sb_for_me[gar[..., 0], rid]          # [G, R(me), src]
-        sb_mine = jnp.where(beacon, sb_mine, 0)
-        ing = sb_mine[:, :, :, None] > abs_o[:, :, None, :]  # [G,me,p,W]
+        pbar_mine = jnp.where(beacon, inbox["rp_pbar"], 0)
+        pbase_mine = inbox["rp_abase"]
+        ing = (
+            (pbase_mine[:, :, :, None] <= abs_o[:, :, None, :])
+            & (pbar_mine[:, :, :, None] > abs_o[:, :, None, :])
+        )  # [G,me,src,W]
 
         # fast-path identity reconstruction from peers' tables
         bucket = val_o % K
@@ -511,12 +532,20 @@ class EPaxosKernel(ProtocolKernel):
         own_r = jnp.arange(R)[None, None, None, :] == rid[..., None, None]
         u_deps = jnp.where(own_r, deps_o, u_deps)
 
-        # accept tally via rp_acc bitmasks at the row's current ballot
+        # accept tally via the peers' contiguous accepted frontiers over
+        # my row (column-identified; see the rp_abar producer note on why
+        # a per-position bitmask was unsound)
         accing = live & (st_o == ACCEPTING)
-        acc_bits = jnp.where(beacon, inbox["rp_acc"], jnp.uint32(0))
-        bitpos = (abs_o % W).astype(jnp.uint32)
+        # rp_abar/rp_abase are PAIR lanes addressed to the row owner:
+        # inbox is already [G, me, src] = src's accepted run over MY row.
+        # An ack for column c needs c INSIDE the attested half-open run —
+        # c below the base means the peer executed past it (possibly a
+        # recovery outcome), which attests nothing about MY attrs.
+        abar_mine = jnp.where(beacon, inbox["rp_abar"], 0)
+        abase_mine = inbox["rp_abase"]
         acc_cnt = 1 + jnp.sum(
-            ((acc_bits[:, :, :, None] >> bitpos[:, :, None, :]) & 1).astype(
+            ((abase_mine[:, :, :, None] <= abs_o[:, :, None, :])
+             & (abar_mine[:, :, :, None] > abs_o[:, :, None, :])).astype(
                 jnp.int32
             ),
             axis=2,
@@ -856,28 +885,61 @@ class EPaxosKernel(ProtocolKernel):
         out["tb_seq"] = s["it_seq"]
         out["sb"] = s["seen_bar"]
 
-        # rp_acc: per destination row owner d, the bitmask over d's row of
-        # entries held Accepting+ at the row's DEFAULT ballot.  Entries
-        # stored at recovery ballots are deliberately excluded: a revived
-        # row owner must not count them as acks of its own (possibly
-        # different) attrs — its tally wedges instead, and the stall
-        # detector walks it through self-ERP to learn the recovered
-        # outcomes.  Recovery-driven instances commit via the racc tally.
+        # rp_abar: per destination row owner d, this sender's CONTIGUOUS
+        # accepted frontier over d's row — the first column (walking up
+        # from the sender's exec frontier) NOT held Accepting+ at the
+        # row's DEFAULT ballot.  Column-identified (abs2 must equal the
+        # walked column), unlike a per-position bitmask: a bitmask over
+        # ``abs2 % W`` let an ACCEPTING entry for a DIFFERENT column
+        # c' = c (mod W) of the same row count as an ack for c, and a
+        # command leader could "commit" slow-path attrs no acceptor ever
+        # stored (found by the randomized sweep, seed 71, instance
+        # (1, 236): committed (seq, deps) diverged across replicas).
+        # Entries stored at recovery ballots are deliberately excluded: a
+        # revived row owner must not count them as acks of its own
+        # (possibly different) attrs — its tally wedges instead, and the
+        # stall detector walks it through self-ERP to learn the
+        # recovered outcomes.  Recovery-driven instances commit via the
+        # racc tally.
         dbal_rows = self._default_bal(
             jnp.arange(R, dtype=jnp.int32)
         )[None, None, :, None]
-        accmask = (s["st2"] >= ACCEPTING) & (s["bal2"] == dbal_rows)
-        bits = jnp.sum(
-            jnp.where(
-                accmask,
-                jnp.uint32(1)
-                << (s["abs2"].clip(0) % W).astype(jnp.uint32),
-                jnp.uint32(0),
-            ),
-            axis=3,
-            dtype=jnp.uint32,
+        _, acc_absw = range_cover(s["exec_row"], s["exec_row"] + W, W)
+        acc_cov = (
+            (s["abs2"] == acc_absw)
+            & (s["st2"] >= ACCEPTING)
+            & (s["bal2"] == dbal_rows)
+        )
+        acc_gap = (acc_absw >= s["exec_row"][..., None]) & ~acc_cov
+        acc_first = jnp.min(jnp.where(acc_gap, acc_absw, _INF), axis=3)
+        # the attestation is the HALF-OPEN run [rp_abase, rp_abar): the
+        # base ships too because columns below my exec frontier are NOT
+        # implicit acks — I may have executed a RECOVERY outcome there
+        # (non-default ballot, possibly different attrs), and a revived
+        # owner counting "executed past c" as "accepted my attrs at c"
+        # re-committed divergent (seq, deps) (sweep seed 3, instance
+        # (1, 0): recovery committed the original seq=1, the revived
+        # owner then slow-"committed" seq=58 off this phantom ack)
+        out["rp_abase"] = s["exec_row"]
+        out["rp_abar"] = jnp.clip(
+            acc_first, s["exec_row"], s["exec_row"] + W
         )  # [G, R, row] -> per-pair [G, src, dst=row]
-        out["rp_acc"] = bits
+        # the PREACC-level run backs the owner's fast-path ingest count:
+        # sb (seen_bar) counts ANY stored entry, so a recovery-driven
+        # no-op at position c read as "peer ingested my entry" and a
+        # revived owner could fast-commit its original value over a
+        # committed recovery no-op; this run requires the row's DEFAULT
+        # ballot, which any recovery outcome breaks
+        pre_cov = (
+            (s["abs2"] == acc_absw)
+            & (s["st2"] >= PREACC)
+            & (s["bal2"] == dbal_rows)
+        )
+        pre_gap = (acc_absw >= s["exec_row"][..., None]) & ~pre_cov
+        pre_first = jnp.min(jnp.where(pre_gap, acc_absw, _INF), axis=3)
+        out["rp_pbar"] = jnp.clip(
+            pre_first, s["exec_row"], s["exec_row"] + W
+        )
 
         # ERP campaign
         rec_on = s["rec_row"] >= 0
@@ -931,6 +993,19 @@ class EPaxosKernel(ProtocolKernel):
         out["rv_deps"] = jnp.where(
             rv_live[..., None], self._row_slice(s, "deps2", srow_c), 0
         )
+        out["rv_bump"] = jnp.where(
+            rv_live, self._row_slice(s, "pbump2", srow_c), False
+        )
+        # my committed frontier over the served row: columns below it are
+        # committed HERE even if my window already slid past their copies
+        # — the recoverer must not re-decide them from weaker evidence
+        out["rv_cmt"] = jnp.where(
+            serve,
+            jnp.take_along_axis(s["cmt_row"], srow_c[..., None], axis=2)[
+                ..., 0
+            ],
+            0,
+        )
         do_rv = serve[..., None] & ns_mask
         oflags = oflags | jnp.where(do_rv, jnp.uint32(RV), 0)
 
@@ -975,6 +1050,14 @@ class EPaxosKernel(ProtocolKernel):
         rv_val = rin("rv_val")
         rv_noop = rin("rv_noop")
         rv_deps = rin("rv_deps", (R,))
+        rv_bump = jnp.where(align, rin("rv_bump"), False)
+        # highest committed frontier any responder reports for the row:
+        # columns below it are committed SOMEWHERE even if every visible
+        # window slid past them — re-deciding those from preaccept-level
+        # evidence fabricated fresh attrs over committed instances
+        # (randomized sweep, seed 3, g0 instance (1, 0))
+        rv_cmt_in = jnp.broadcast_to(inbox["rv_cmt"][:, None], (G, R, R))
+        resp_cmt = jnp.max(jnp.where(rv_mine, rv_cmt_in, 0), axis=2)
 
         own_st = self._row_slice(s, "st2", tgt_c)
         own_abs = self._row_slice(s, "abs2", tgt_c)
@@ -1014,6 +1097,12 @@ class EPaxosKernel(ProtocolKernel):
         c_noop = from_src(rv_noop, own_noop, csrc, own_cmt)
         c_deps = from_src_d(rv_deps, own_deps, csrc, own_cmt)
 
+        # columns committed at some responder but not visible as committed
+        # copies anywhere in the quorum: leave them alone — the outcome
+        # reaches us via normal commit propagation or the lost-row
+        # install plane, never via re-decision from weaker evidence
+        lost = (my_ring < resp_cmt[..., None]) & ~any_cmt
+
         # ladder 2: accepting copy at the max voted ballot
         accm = rv_st == ACCEPTING
         own_acc = own_st == ACCEPTING
@@ -1021,7 +1110,7 @@ class EPaxosKernel(ProtocolKernel):
             jnp.max(jnp.where(accm, rv_vbal, 0), axis=2),
             jnp.where(own_acc, own_vbal, 0),
         )
-        any_acc = act & ~any_cmt & (acc_best > 0)
+        any_acc = act & ~any_cmt & ~lost & (acc_best > 0)
         use_own_a = own_acc & (own_vbal >= acc_best)
         asrc = jnp.argmax(jnp.where(accm, rv_vbal, -1), axis=2)[..., None]
         a_seq = from_src(rv_seq, own_seq, asrc, use_own_a)
@@ -1029,55 +1118,45 @@ class EPaxosKernel(ProtocolKernel):
         a_noop = from_src(rv_noop, own_noop, asrc, use_own_a)
         a_deps = from_src_d(rv_deps, own_deps, asrc, use_own_a)
 
-        # ladder 3: >= simple_q - 1 identical non-owner preaccepts at the
-        # row's default ballot (candidate loop over responders + self)
+        # ladder 3: an UNBUMPED preaccept copy at the row's default ballot
+        # — an acceptor whose merge did not change the owner's attrs
+        # stores exactly the original (seq, deps), the only attrs a fast
+        # commit can decide.  One witness suffices: if the fast path
+        # committed, it committed these attrs; if the slow path
+        # committed, an ACCEPTING copy is guaranteed visible in any
+        # recovery quorum (2*simple_q - R >= 1 intersection) and ladder 2
+        # already took it; if nothing committed, the original is a valid
+        # free choice and every racing recoverer derives the same one.
+        # (The previous rule counted BUMPED copies as witnesses and
+        # tie-broke between divergent candidate attrs by loop order —
+        # two recoverers could commit different (seq, deps) for one
+        # instance; randomized sweep seeds 3/41/67/71.)
         dbal = self._default_bal(tgt_c)[..., None]        # [G, R, 1]
-        pre = (rv_st == PREACC) & (rv_vbal == dbal[:, :, None, :])
-        own_pre = (own_st == PREACC) & (own_vbal == dbal)
-        best_cnt = jnp.zeros((G, R, W), jnp.int32)
-        best_cand = jnp.full((G, R, W), -1, jnp.int32)
-        for cand in range(R + 1):
-            if cand < R:
-                cok = pre[:, :, cand, :]
-                cs, cv = rv_seq[:, :, cand, :], rv_val[:, :, cand, :]
-                cd = rv_deps[:, :, cand, :, :]
-            else:
-                cok = own_pre
-                cs, cv, cd = own_seq, own_val, own_deps
-            same = (
-                pre
-                & (rv_seq == cs[:, :, None, :])
-                & (rv_val == cv[:, :, None, :])
-                & jnp.all(rv_deps == cd[:, :, None, :, :], axis=4)
-            )
-            cnt = jnp.sum(same.astype(jnp.int32), axis=2) + (
-                own_pre
-                & (own_seq == cs)
-                & (own_val == cv)
-                & jnp.all(own_deps == cd, axis=3)
-            ).astype(jnp.int32)
-            cnt = jnp.where(cok, cnt, 0)
-            upd = cnt > best_cnt
-            best_cnt = jnp.where(upd, cnt, best_cnt)
-            best_cand = jnp.where(upd, cand, best_cand)
-        ident = act & ~any_cmt & ~any_acc & (
-            best_cnt >= self.simple_q - 1
+        pre_all = (rv_st == PREACC) & (rv_vbal == dbal[:, :, None, :])
+        pre = pre_all & ~rv_bump
+        own_pre_all = (own_st == PREACC) & (own_vbal == dbal)
+        own_bump = own_ok & self._row_slice(s, "pbump2", tgt_c)
+        own_pre = own_pre_all & ~own_bump
+        ident = act & ~any_cmt & ~any_acc & ~lost & (
+            jnp.any(pre, axis=2) | own_pre
         )
-        use_own_i = best_cand == R
-        isrc = jnp.minimum(best_cand, R - 1)[..., None]
+        use_own_i = own_pre & ~jnp.any(pre, axis=2)
+        isrc = jnp.argmax(pre, axis=2)[..., None]
         i_seq = from_src(rv_seq, own_seq, isrc, use_own_i)
         i_val = from_src(rv_val, own_val, isrc, use_own_i)
         i_noop = from_src(rv_noop, own_noop, isrc, use_own_i)
         i_deps = from_src_d(rv_deps, own_deps, isrc, use_own_i)
 
-        # ladder 4: any preaccept -> re-propose the voted value with a
-        # fresh merge from my tables (no quorum fast-committed it);
+        # ladder 4: only bumped preaccepts -> the fast path provably did
+        # not commit (a bumped acceptor's tables fail the owner's
+        # identity check from ingest on) and no accept is visible:
+        # re-propose the voted value with a fresh merge from my tables;
         # ladder 5: nothing -> no-op
-        any_pre = jnp.any(pre, axis=2) | own_pre
-        repro = act & ~any_cmt & ~any_acc & ~ident & any_pre
-        noopf = act & ~any_cmt & ~any_acc & ~ident & ~any_pre
-        use_own_p = own_pre & ~jnp.any(pre, axis=2)
-        psrc = jnp.argmax(pre, axis=2)[..., None]
+        any_pre = jnp.any(pre_all, axis=2) | own_pre_all
+        repro = act & ~any_cmt & ~any_acc & ~lost & ~ident & any_pre
+        noopf = act & ~any_cmt & ~any_acc & ~lost & ~ident & ~any_pre
+        use_own_p = own_pre_all & ~jnp.any(pre_all, axis=2)
+        psrc = jnp.argmax(pre_all, axis=2)[..., None]
         p_val = from_src(rv_val, own_val, psrc, use_own_p)
         p_noop = from_src(rv_noop, own_noop, psrc, use_own_p)
         pbucket = p_val % K

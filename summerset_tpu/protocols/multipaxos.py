@@ -104,6 +104,15 @@ class ReplicaConfigMultiPaxos:
     dur_lag: int = 0                    # WAL ack lag in slots/tick (0=instant)
     exec_follows_commit: bool = True    # device-only mode: exec == commit
     init_leader: int = 0                # warm-start leader id; -1 = cold elect
+    # stable-leader lease plane (reference leaderlease.rs:10-21 via the
+    # clock-free countdown scheme): followers promise vote refusal for
+    # ``leader_lease_len`` ticks on every accepted heartbeat; the leader
+    # counts confirmed promises from heartbeat replies (shortened by
+    # ``lease_margin`` so its belief expires first) and may serve local
+    # reads while a quorum holds
+    leader_leases: bool = False
+    leader_lease_len: int = 12
+    lease_margin: int = 3
 
 
 @register_protocol("MultiPaxos")
@@ -158,6 +167,13 @@ class MultiPaxosKernel(ProtocolKernel):
         self.config = config or ReplicaConfigMultiPaxos()
         if self.config.max_proposals_per_tick > window // 2:
             raise ValueError("max_proposals_per_tick must be <= window/2")
+        if getattr(self.config, "leader_leases", False) and (
+            self.config.hear_timeout_lo <= self.config.leader_lease_len
+        ):
+            raise ValueError(
+                "hear_timeout_lo must exceed leader_lease_len (a follower "
+                "must outlive its own promise before campaigning)"
+            )
         # an Accept range never exceeds the ring window
         self._chunk = min(self.config.chunk_size, window)
 
@@ -222,6 +238,22 @@ class MultiPaxosKernel(ProtocolKernel):
             "win_val": jnp.full((G, R, W), NULL_VAL, i32),
         }
 
+        if getattr(cfg, "leader_leases", False):
+            # follower-side promise countdown + leader-side confirmed
+            # promises (QL/Bodega-style clock-free margin arithmetic).
+            # ll_left starts FULL, not zero: a crash-restarted replica
+            # cannot know whether it promised vote refusal just before
+            # dying, so it must sit out a full promise window before
+            # granting challengers — otherwise a restarted follower
+            # votes a new leader in while the old one still believes
+            # its lease quorum holds and serves stale local reads
+            # (same conservative-full-init pattern as QL's gset_ttl).
+            # Election liveness is unaffected: hear timeouts exceed
+            # leader_lease_len (validated in __init__), so campaigns
+            # start after the holdoff has lapsed anyway.
+            st["ll_left"] = jnp.full((G, R), cfg.leader_lease_len, i32)
+            st["ll_in"] = zeros(G, R, R)
+
         if cfg.init_leader >= 0:
             L = cfg.init_leader
             bal0 = int(initial_ballot(jnp.int32(L)))
@@ -282,6 +314,10 @@ class MultiPaxosKernel(ProtocolKernel):
     def _ingest_heartbeat(self, s, c):
         cfg = self.config
         inbox = c.inbox
+        if getattr(cfg, "leader_leases", False):
+            # countdowns tick once per lockstep tick (first phase to run)
+            s["ll_left"] = jnp.maximum(s["ll_left"] - 1, 0)
+            s["ll_in"] = jnp.maximum(s["ll_in"] - 1, 0)
         hb_ok, hb_bal, hb_src = best_by_ballot(
             c.flags, HEARTBEAT, inbox["hb_bal"]
         )
@@ -305,13 +341,22 @@ class MultiPaxosKernel(ProtocolKernel):
             jnp.maximum(s["commit_bar"], jnp.minimum(hb_cbar, s["vote_bar"])),
             s["commit_bar"],
         )
+        if getattr(cfg, "leader_leases", False):
+            # an accepted heartbeat refreshes our vote-refusal promise to
+            # its sender (reference promise refresh, leaderlease.rs:10-21)
+            s["ll_left"] = jnp.where(hb_ok, cfg.leader_lease_len, s["ll_left"])
         c.hb_ok, c.hb_bal, c.hb_src = hb_ok, hb_bal, hb_src
         c.hb_reply_to = hb_ok
 
     def _vote_gate(self, s, c, p_bal, p_src):
         """Hook: extra veto on granting a Prepare promise (leader leases
         refuse votes for challengers while the promise countdown runs)."""
-        return jnp.ones((self.G, self.R), jnp.bool_)
+        if not getattr(self.config, "leader_leases", False):
+            return jnp.ones((self.G, self.R), jnp.bool_)
+        # no unknown-leader escape: leader is -1 exactly when we have no
+        # heartbeat source — after a restart that is precisely the state
+        # in which a possibly-outstanding promise must be waited out
+        return (s["ll_left"] <= 0) | (p_src == s["leader"])
 
     # ========== 2. PREPARE ingest (promise + voted-window reply)
     def _ingest_prepare(self, s, c):
@@ -461,6 +506,15 @@ class MultiPaxosKernel(ProtocolKernel):
     def _ingest_hb_reply(self, s, c):
         hbr_valid = (c.flags & HB_REPLY) != 0
         c.hbr_valid = hbr_valid
+        if getattr(self.config, "leader_leases", False):
+            # a heartbeat reply confirms the sender's promise; the
+            # leader's belief is shortened by the margin so it expires
+            # strictly before the follower's own countdown
+            s["ll_in"] = jnp.where(
+                hbr_valid,
+                self.config.leader_lease_len - self.config.lease_margin,
+                s["ll_in"],
+            )
         s["peer_exec"] = jnp.where(
             hbr_valid,
             jnp.maximum(s["peer_exec"], c.inbox["hbr_ebar"]),
@@ -577,7 +631,9 @@ class MultiPaxosKernel(ProtocolKernel):
     def _campaign_gate(self, s, c):
         """Hook: extra veto on starting a campaign (own outstanding
         promises must lapse before campaigning at a higher ballot)."""
-        return jnp.ones((self.G, self.R), jnp.bool_)
+        if not getattr(self.config, "leader_leases", False):
+            return jnp.ones((self.G, self.R), jnp.bool_)
+        return s["ll_left"] <= 0
 
     # ========== 7. election timeout -> campaign
     def _election(self, s, c):
@@ -646,6 +702,19 @@ class MultiPaxosKernel(ProtocolKernel):
         # unseen slots would overwrite committed values.  It stops
         # campaigning; a more current replica wins and snapshots it forward.
         behind = c.candidate & (s["prep_hi"] - s["prep_trigger"] > W)
+        # A candidate must also be able to HEAL laggards from its window:
+        # the install-snapshot plane jumps a >window-behind peer to the
+        # leader's exec_bar and resumes the accept stream there, so a
+        # leader whose exec_bar sits below next_slot - W would stream
+        # slots its window no longer holds — the broadcast value lanes
+        # alias (position p serves a NEWER slot) and the peer votes, then
+        # commits, garbage over committed values.  This bites protocols
+        # whose exec frontier can trail votes by more than a window
+        # (RSPaxos full_bar gating; host-mode exec floors), found by the
+        # randomized sweep at seed 29/71 (rspaxos, g0 slot 96).
+        behind |= c.candidate & (
+            jnp.maximum(s["prep_hi"], s["commit_bar"]) - s["exec_bar"] > W
+        )
         s["bal_prep_sent"] = jnp.where(behind, 0, s["bal_prep_sent"])
         c.candidate &= ~behind
         win = self._win_condition(s, c)
@@ -771,6 +840,12 @@ class MultiPaxosKernel(ProtocolKernel):
             active_leader[..., None]
             & ns_mask
             & (s["next_idx"] < (s["next_slot"] - W)[..., None])
+            # the jump target (exec_bar) must itself be in-window, or the
+            # resumed accept stream would serve aliased lane values; the
+            # step-up veto keeps this true for any replica that wins, and
+            # this gate makes an out-of-window exec_bar stall the heal
+            # instead of corrupting it
+            & (s["exec_bar"] >= s["next_slot"] - W)[..., None]
         )
         oflags = oflags | jnp.where(too_behind, jnp.uint32(SNAPSHOT), 0)
         out["snp_bal"] = jnp.where(too_behind, s["bal_max"][..., None], 0)
@@ -866,6 +941,14 @@ class MultiPaxosKernel(ProtocolKernel):
             "is_leader": c.active_leader,
             "snap_bar": snap_bar,
         }
+        if getattr(self.config, "leader_leases", False):
+            # leader local reads under a confirmed quorum of vote
+            # promises (self counts as one; reference leaderlease.rs
+            # lease_cnt >= majority)
+            ll_cnt = jnp.sum((s["ll_in"] > 0).astype(jnp.int32), axis=2) + 1
+            extra["leader_read_ok"] = c.active_leader & (
+                ll_cnt >= self.quorum
+            )
         extra.update(self._effects_extra(s, c))
         return StepEffects(
             commit_bar=s["commit_bar"], exec_bar=s["exec_bar"], extra=extra

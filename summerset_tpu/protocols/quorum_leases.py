@@ -113,6 +113,11 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         config: ReplicaConfigQuorumLeases | None = None,
     ):
         config = config or ReplicaConfigQuorumLeases()
+        if config.leader_leases:
+            raise ValueError(
+                "QuorumLeases carries its own leader-lease plane; use "
+                "enable_leader_leases, not the base MultiPaxos flag"
+            )
         super().__init__(num_groups, population, window, config)
         if config.hear_timeout_lo <= config.leader_lease_len:
             raise ValueError(
@@ -144,8 +149,17 @@ class QuorumLeasesKernel(MultiPaxosKernel):
             rep_gset=jnp.full((G, R, R), cfg.init_responders, i32),
             gset_ttl=jnp.full((G, R, R), hold, i32),
             # leader-lease countdowns: holder (follower promise) and the
-            # leader's confirmed view per peer
-            ll_left=jnp.zeros((G, R), i32),
+            # leader's confirmed view per peer.  ll_left starts FULL
+            # (same conservative init as gset_ttl above): a restarted
+            # replica may have promised vote refusal just before dying,
+            # so it waits a full promise window before granting
+            # challengers; hear timeouts exceed leader_lease_len, so
+            # election liveness is unaffected
+            ll_left=jnp.full(
+                (G, R),
+                cfg.leader_lease_len if cfg.enable_leader_leases else 0,
+                i32,
+            ),
             ll_in=jnp.zeros((G, R, R), i32),
             # reply-based peer liveness: grants to a dead grantee must stop
             # or the write barrier never frees
@@ -218,11 +232,10 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         if not self.config.enable_leader_leases:
             return jnp.ones((self.G, self.R), jnp.bool_)
         # refuse challengers while our promise to the current leader runs
-        return (
-            (s["ll_left"] <= 0)
-            | (p_src == s["leader"])
-            | (s["leader"] < 0)
-        )
+        # (no unknown-leader escape: leader == -1 is exactly the
+        # post-restart state in which an outstanding promise must be
+        # waited out)
+        return (s["ll_left"] <= 0) | (p_src == s["leader"])
 
     def _campaign_gate(self, s, c):
         if not self.config.enable_leader_leases:

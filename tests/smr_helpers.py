@@ -57,3 +57,132 @@ def check_agreement(state, G, R, W, val_key="win_val"):
                 else:
                     merged[slot] = v
     return True
+
+
+# ---------------------------------------------------------------- EPaxos
+# EPaxos states a 2-D instance space instead of a slot window, so the
+# sweep checks instance-level invariants (the ones tla+/ checks for the
+# slot protocols, adapted): committed (value, seq, noop, deps) agreement
+# per instance, durability of committed bindings, and identical
+# host-Tarjan execution order per interference bucket across replicas.
+
+EPAXOS_COMMITTED = 3  # protocols/epaxos.py status code
+
+
+def epaxos_committed_instances(st, g, r):
+    """{(row, col): (val, seq, noop, deps-tuple)} committed in r's view."""
+    out = {}
+    R, W = st["st2"].shape[2], st["st2"].shape[3]
+    for row in range(R):
+        for w in range(W):
+            if st["st2"][g, r, row, w] == EPAXOS_COMMITTED:
+                col = int(st["abs2"][g, r, row, w])
+                if col >= 0:
+                    out[(row, col)] = (
+                        int(st["val2"][g, r, row, w]),
+                        int(st["seq2"][g, r, row, w]),
+                        bool(st["noop2"][g, r, row, w]),
+                        tuple(int(d) for d in st["deps2"][g, r, row, w]),
+                    )
+    return out
+
+
+def epaxos_check_and_merge(st, G, R, acc):
+    """Cross-replica committed-instance agreement + durable-binding merge.
+
+    Asserts the full (value, seq, noop, deps) tuple agrees — EPaxos
+    commits carry final attributes, so any divergence is a safety bug —
+    and that no binding in ``acc`` ever changes across segments."""
+    for g in range(G):
+        merged = {}
+        for r in range(R):
+            for inst, tup in epaxos_committed_instances(st, g, r).items():
+                if inst in merged:
+                    assert merged[inst] == tup, (
+                        f"g{g} instance {inst}: replica {r} committed "
+                        f"{tup} but another replica has {merged[inst]}"
+                    )
+                else:
+                    merged[inst] = tup
+        for inst, tup in merged.items():
+            key = (g,) + inst
+            if key in acc:
+                assert acc[key] == tup, (
+                    f"committed binding changed: {key}: {acc[key]} -> {tup}"
+                )
+            else:
+                acc[key] = tup
+    return acc
+
+
+def _epaxos_common_floors(st, g, R, W):
+    """Per-row start columns every replica can execute from: the window
+    is a ring, so late-run snapshots no longer hold column 0 — each
+    executor starts at the highest column from which EVERY replica's
+    window still holds a contiguous committed run up to its own
+    cmt_row (identical start floors keep the emitted orders comparable)."""
+    floors = [0] * R
+    for row in range(R):
+        for r in range(R):
+            cmt = int(st["cmt_row"][g, r, row])
+            lo = cmt
+            while lo - 1 >= 0 and lo - 1 > cmt - W:
+                p = (lo - 1) % W
+                if (st["abs2"][g, r, row, p] == lo - 1
+                        and st["st2"][g, r, row, p] == EPAXOS_COMMITTED):
+                    lo -= 1
+                else:
+                    break
+            floors[row] = max(floors[row], lo)
+    return floors
+
+
+def epaxos_exec_orders(st, G, R, W, K):
+    """Host-Tarjan execution order per (group, replica), projected per
+    interference bucket (vid % K).  The authoritative execution path is
+    the host applier (host/epaxos_exec.py), so the sweep checks THAT
+    order, not the in-kernel frontier heuristic."""
+    from summerset_tpu.host.epaxos_exec import EPaxosExecutor
+
+    orders = {}
+    for g in range(G):
+        floors = _epaxos_common_floors(st, g, R, W)
+        for r in range(R):
+            rec = []
+            ex = EPaxosExecutor(
+                R, W,
+                apply_fn=lambda row, col, vid, noop: rec.append(
+                    (row, col, int(vid), bool(noop))
+                ),
+            )
+            ex.floor = list(floors)
+            ex.advance(
+                st["abs2"][g, r], st["st2"][g, r], st["seq2"][g, r],
+                st["val2"][g, r], st["noop2"][g, r], st["deps2"][g, r],
+                st["cmt_row"][g, r],
+            )
+            per_bucket = {b: [] for b in range(K)}
+            for row, col, vid, noop in rec:
+                per_bucket[vid % K].append((row, col, vid))
+            orders[(g, r)] = per_bucket
+    return orders
+
+
+def epaxos_check_exec_prefix(st, G, R, W, K, require_progress=0):
+    """Every pair of replicas must agree on same-bucket execution order
+    up to the shorter one's length (EPaxos's determinism guarantee)."""
+    orders = epaxos_exec_orders(st, G, R, W, K)
+    total = 0
+    for g in range(G):
+        for b in range(K):
+            seqs = [orders[(g, r)][b] for r in range(R)]
+            for r in range(1, R):
+                n = min(len(seqs[0]), len(seqs[r]))
+                assert seqs[0][:n] == seqs[r][:n], (
+                    f"g{g} bucket {b}: replica {r} exec order diverges "
+                    f"at {[i for i in range(n) if seqs[0][i] != seqs[r][i]][:3]}"
+                )
+            total += max(len(s) for s in seqs)
+    assert total >= require_progress, (
+        f"host-Tarjan executed only {total} instances"
+    )
