@@ -1,0 +1,155 @@
+"""Failover timeline: kill the leader mid-run, measure the throughput dip
+and the recovery time.
+
+Reference analog: ``scripts/bodega/bench_failover.py`` (SURVEY.md §6) —
+clients stream ops while the leader is crash-restarted; the output is a
+per-bin completion-rate timeline plus the measured gap until throughput
+recovers to half its pre-kill average.
+
+Writes FAILOVER.json at the repo root:
+  {"protocol", "kill_at_s", "bins_ms", "timeline": [ops per bin, ...],
+   "pre_kill_tput", "recovery_ms"}
+
+Usage: python scripts/bench_failover.py [--protocol MultiPaxos]
+       [--secs 12] [--kill-at 6] [--clients 4] [--bin-ms 100]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--protocol", default="MultiPaxos")
+    ap.add_argument("--groups", type=int, default=1)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--secs", type=float, default=12.0)
+    ap.add_argument("--kill-at", type=float, default=6.0)
+    ap.add_argument("--bin-ms", type=int, default=100)
+    ap.add_argument("--tick", type=float, default=0.002)
+    ap.add_argument("--config", default="")
+    ap.add_argument("--out", default=os.path.join(REPO, "FAILOVER.json"))
+    args = ap.parse_args()
+
+    from test_cluster import Cluster
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import GenericEndpoint
+    from summerset_tpu.host.messages import CtrlRequest
+
+    config = {}
+    for kv in filter(None, args.config.split(",")):
+        k, v = kv.split("=", 1)
+        config[k] = json.loads(v)
+
+    tmp = tempfile.mkdtemp(prefix="failover_")
+    cluster = Cluster(args.protocol, args.replicas, tmp, config=config,
+                      tick=args.tick, num_groups=args.groups)
+    print("cluster up", flush=True)
+
+    completions = []  # monotonic timestamps of successful ops
+    stop = threading.Event()
+    t_start = time.monotonic()
+
+    def client(i):
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep, timeout=2.0)
+        n = 0
+        while not stop.is_set():
+            key = f"fo{(n + i) % 32}"
+            r = drv.put(key, f"v{i}-{n}") if n % 2 else drv.get(key)
+            if r.kind == "success":
+                completions.append(time.monotonic())
+            else:
+                drv._failover(r)
+                time.sleep(0.02)
+            n += 1
+        try:
+            ep.leave()
+        except Exception:
+            pass
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    for t in threads:
+        t.start()
+
+    # kill (crash-restart) the current leader at kill_at
+    ep = GenericEndpoint(cluster.manager_addr)
+    ep.connect()
+    time.sleep(args.kill_at)
+    leader = ep.ctrl.request(CtrlRequest("query_info")).leader or 0
+    t_kill = time.monotonic()
+    print(f"killing leader {leader} at {t_kill - t_start:.2f}s", flush=True)
+    threading.Thread(
+        target=lambda: ep.ctrl.request(
+            CtrlRequest("reset_servers", servers=[leader]), timeout=120,
+        ),
+        daemon=True,
+    ).start()
+
+    time.sleep(max(0.0, args.secs - args.kill_at))
+    stop.set()
+    for t in threads:
+        t.join(timeout=15)
+    try:
+        ep.leave()
+    except Exception:
+        pass
+    cluster.stop()
+
+    # bin the completion timeline
+    bin_s = args.bin_ms / 1e3
+    nbins = int(args.secs / bin_s) + 1
+    timeline = [0] * nbins
+    for ts in completions:
+        b = int((ts - t_start) / bin_s)
+        if 0 <= b < nbins:
+            timeline[b] += 1
+
+    kill_bin = int((t_kill - t_start) / bin_s)
+    pre = timeline[max(0, kill_bin - 20):kill_bin]
+    pre_rate = sum(pre) / max(len(pre), 1)
+    recovery_ms = None
+    for b in range(kill_bin + 1, nbins):
+        if timeline[b] >= 0.5 * pre_rate and pre_rate > 0:
+            recovery_ms = int((b - kill_bin) * args.bin_ms)
+            break
+
+    out = {
+        "protocol": args.protocol,
+        "replicas": args.replicas,
+        "clients": args.clients,
+        "secs": args.secs,
+        "kill_at_s": round(t_kill - t_start, 3),
+        "killed_leader": leader,
+        "bins_ms": args.bin_ms,
+        "timeline": timeline,
+        "pre_kill_tput": round(pre_rate / bin_s, 1),
+        "recovery_ms": recovery_ms,
+        "total_ops": len(completions),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "timeline"}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Throughput-latency curve: sweep offered load against a live cluster.
+
+The reference's experiment fleets sweep per-client target frequency and
+plot achieved tput vs p50/p99 (``scripts/crossword/bench_tput_lat.py``,
+SURVEY.md §6).  Same shape here: one in-process cluster (real manager +
+replica event loops + TCP), ClientBench clients paced at each offered
+load, one JSON row per load point.
+
+Writes TPUTLAT.json at the repo root:
+  {"protocol", "groups", "clients", "points": [
+     {"offered", "tput", "lat_p50_ms", "lat_p99_ms"}, ...]}
+
+Usage: python scripts/bench_tput_lat.py [--protocol MultiPaxos]
+       [--loads 50,100,200,400,0] [--secs 6] [--clients 4]
+(load 0 = unlimited, the saturation point)
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def run_point(cluster, clients, secs, freq, put_ratio, value_size,
+              num_keys):
+    from summerset_tpu.client.bench import ClientBench
+    from summerset_tpu.client.endpoint import GenericEndpoint
+
+    results = [None] * clients
+
+    def one(i):
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        bench = ClientBench(
+            ep, secs=secs, freq=freq, put_ratio=put_ratio,
+            value_size=value_size, num_keys=num_keys, interval=1e9,
+            seed=100 + i,
+        )
+        results[i] = bench.run()
+        ep.leave()
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=secs + 60)
+    done = [r for r in results if r]
+    return {
+        "offered": freq * clients if freq > 0 else 0,
+        "tput": round(sum(r["tput"] for r in done), 2),
+        "lat_p50_ms": round(
+            max((r["lat_p50_ms"] for r in done), default=0.0), 3),
+        "lat_p99_ms": round(
+            max((r["lat_p99_ms"] for r in done), default=0.0), 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--protocol", default="MultiPaxos")
+    ap.add_argument("--groups", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--secs", type=float, default=6.0)
+    ap.add_argument("--tick", type=float, default=0.002)
+    ap.add_argument("--loads", default="50,100,200,400,0",
+                    help="per-client req/s; 0 = unlimited")
+    ap.add_argument("--num-keys", type=int, default=64)
+    ap.add_argument("--value-size", default="64")
+    ap.add_argument("--put-ratio", type=float, default=0.5)
+    ap.add_argument("--config", default="",
+                    help="k=v[,k=v...] extra cluster config")
+    ap.add_argument("--out", default=os.path.join(REPO, "TPUTLAT.json"))
+    args = ap.parse_args()
+
+    from test_cluster import Cluster
+
+    config = {}
+    for kv in filter(None, args.config.split(",")):
+        k, v = kv.split("=", 1)
+        config[k] = json.loads(v)
+
+    tmp = tempfile.mkdtemp(prefix="tput_lat_")
+    t0 = time.time()
+    cluster = Cluster(args.protocol, args.replicas, tmp, config=config,
+                      tick=args.tick, num_groups=args.groups)
+    print(f"cluster up in {time.time() - t0:.1f}s", flush=True)
+
+    points = []
+    try:
+        for load in [float(x) for x in args.loads.split(",")]:
+            pt = run_point(cluster, args.clients, args.secs, load,
+                           args.put_ratio, args.value_size, args.num_keys)
+            print(json.dumps(pt), flush=True)
+            points.append(pt)
+    finally:
+        cluster.stop()
+
+    out = {
+        "protocol": args.protocol,
+        "groups": args.groups,
+        "replicas": args.replicas,
+        "clients": args.clients,
+        "secs_per_point": args.secs,
+        "points": points,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"out": args.out, "points": len(points)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
