@@ -88,7 +88,52 @@ def main() -> int:
     ap.add_argument("--backer-dir", default="/tmp/summerset_tpu/cluster")
     ap.add_argument("--fresh", action="store_true",
                     help="wipe backer dir before launch")
+    ap.add_argument("--use-veth", action="store_true",
+                    help="per-replica network namespace + veth uplink "
+                         "(parity: reference local_cluster.py:249,308); "
+                         "needs CAP_NET_ADMIN, probed before use")
+    ap.add_argument("--netem", default="",
+                    help="with --use-veth: delay_ms[,jitter_ms[,loss_pct]] "
+                         "applied per replica veth")
     args = ap.parse_args()
+
+    use_veth = False
+    if args.use_veth:
+        import utils_net
+
+        # validate --netem BEFORE creating any namespaces: a parse crash
+        # after setup would leak the bridge + netns into the root ns
+        try:
+            netem_parts = [float(x) for x in
+                           filter(None, args.netem.split(","))]
+        except ValueError:
+            print(f"invalid --netem {args.netem!r} (want "
+                  "delay_ms[,jitter_ms[,loss_pct]])", file=sys.stderr)
+            return 1
+
+        if not utils_net.netns_available():
+            print("--use-veth requested but `ip netns add` is not "
+                  "permitted here (CAP_NET_ADMIN); falling back to "
+                  "loopback", file=sys.stderr)
+        else:
+            err = utils_net.setup_veth_cluster(args.num_replicas)
+            if err is not None:
+                print(f"--use-veth setup failed ({err}); falling back "
+                      "to loopback", file=sys.stderr)
+            else:
+                use_veth = True
+                if netem_parts:
+                    delay = netem_parts[0]
+                    jitter = netem_parts[1] if len(netem_parts) > 1 else 0.0
+                    loss = netem_parts[2] if len(netem_parts) > 2 else 0.0
+                    for r in range(args.num_replicas):
+                        e = utils_net.shape_veth(
+                            r, delay_ms=delay, jitter_ms=jitter,
+                            loss_pct=loss,
+                        )
+                        if e is not None:
+                            print(f"netem on replica {r} veth failed: "
+                                  f"{e}", file=sys.stderr)
 
     if args.fresh and os.path.isdir(args.backer_dir):
         import shutil
@@ -102,10 +147,15 @@ def main() -> int:
     procs = []
     logs = {}
 
-    def spawn(name, mod, *argv):
+    def spawn(name, mod, *argv, netns_idx=None):
         log_path = os.path.join(args.backer_dir, f"{name}.log")
+        cmd = [sys.executable, "-m", mod, *argv]
+        if netns_idx is not None:
+            import utils_net
+
+            cmd = utils_net.netns_exec_prefix(netns_idx) + cmd
         proc = subprocess.Popen(
-            [sys.executable, "-m", mod, *argv],
+            cmd,
             env=env,
             stderr=open(log_path, "w", buffering=1),
         )
@@ -113,12 +163,21 @@ def main() -> int:
         logs[name] = log_path
         return log_path
 
+    # under --use-veth the manager stays in the root namespace, reachable
+    # from every replica ns at the bridge address; each server binds and
+    # advertises its own namespace IP
+    man_bind = []
+    if use_veth:
+        import utils_net
+
+        man_bind = ["--bind-ip", "0.0.0.0"]
     man_log = spawn(
         "manager",
         "summerset_tpu.cli.manager",
         "-p", args.protocol,
         "--srv-port", str(bp), "--cli-port", str(bp + 1),
         "-n", str(args.num_replicas),
+        *man_bind,
     )
     def teardown():
         for p in procs:
@@ -126,6 +185,10 @@ def main() -> int:
                 p.terminate()
             except OSError:
                 pass
+        if use_veth:
+            import utils_net
+
+            utils_net.teardown_veth_cluster(args.num_replicas)
 
     if not wait_for_line(man_log, "manager up", 15):
         print("manager failed to start", file=sys.stderr)
@@ -135,15 +198,25 @@ def main() -> int:
     cfg = args.config or protocol_defaults(args.protocol, args.num_replicas)
     server_logs = []
     for r in range(args.num_replicas):
+        if use_veth:
+            import utils_net
+
+            srv_net = [
+                "--bind-ip", utils_net.replica_ip(r),
+                "-m", f"{utils_net.bridge_ip()}:{bp}",
+            ]
+        else:
+            srv_net = ["-m", f"127.0.0.1:{bp}"]
         server_logs.append(spawn(
             f"server{r}",
             "summerset_tpu.cli.server",
             "-p", args.protocol,
             "-a", str(bp + 10 + r),
             "-i", str(bp + 30 + r),
-            "-m", f"127.0.0.1:{bp}",
+            *srv_net,
             "--backer-dir", args.backer_dir,
             *(["-c", cfg] if cfg else []),
+            netns_idx=r if use_veth else None,
         ))
     for r, slog in enumerate(server_logs):
         if not wait_for_line(slog, "accepting clients", 90):

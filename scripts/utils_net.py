@@ -86,3 +86,106 @@ if __name__ == "__main__":
         raise SystemExit(1)
     err = apply_netem(dev, delay_ms=10, jitter_ms=2, rate_gbps=1)
     print("applied" if err is None else f"failed: {err}")
+
+
+# --------------------------------------------------------------- netns/veth
+# Per-replica network namespaces with veth uplinks into one bridge, so a
+# single box gives every replica its own interface to shape with netem
+# (parity: reference scripts/local_cluster.py --use-veth +
+# scripts/utils/net.py).  Command construction is pure; application is
+# gated on a capability probe (needs CAP_NET_ADMIN; this build box
+# doesn't grant it, real hosts do).
+
+BRIDGE = "smtpubr0"
+SUBNET = "10.77.0"          # /24; bridge at .1, replica r at .(10+r)
+
+
+def netns_name(idx: int) -> str:
+    return f"smtpu{idx}"
+
+
+def replica_ip(idx: int) -> str:
+    return f"{SUBNET}.{10 + idx}"
+
+
+def bridge_ip() -> str:
+    return f"{SUBNET}.1"
+
+
+def bridge_cmds() -> List[List[str]]:
+    """Create the shared bridge in the root namespace (idempotent-ish:
+    callers run teardown first)."""
+    return [
+        ["ip", "link", "add", BRIDGE, "type", "bridge"],
+        ["ip", "addr", "add", f"{bridge_ip()}/24", "dev", BRIDGE],
+        ["ip", "link", "set", BRIDGE, "up"],
+    ]
+
+
+def netns_cmds(idx: int) -> List[List[str]]:
+    """Create namespace idx + veth pair bridged to the root namespace."""
+    ns = netns_name(idx)
+    host_if = f"veth{ns}"
+    return [
+        ["ip", "netns", "add", ns],
+        ["ip", "link", "add", host_if, "type", "veth",
+         "peer", "name", "eth0", "netns", ns],
+        ["ip", "link", "set", host_if, "master", BRIDGE],
+        ["ip", "link", "set", host_if, "up"],
+        ["ip", "-n", ns, "addr", "add", f"{replica_ip(idx)}/24",
+         "dev", "eth0"],
+        ["ip", "-n", ns, "link", "set", "eth0", "up"],
+        ["ip", "-n", ns, "link", "set", "lo", "up"],
+    ]
+
+
+def netns_teardown_cmds(n: int) -> List[List[str]]:
+    cmds = [["ip", "netns", "del", netns_name(i)] for i in range(n)]
+    cmds.append(["ip", "link", "del", BRIDGE])
+    return cmds
+
+
+def netns_exec_prefix(idx: int) -> List[str]:
+    """argv prefix running a command inside replica idx's namespace."""
+    return ["ip", "netns", "exec", netns_name(idx)]
+
+
+def netns_available() -> bool:
+    """Probe: `ip netns add` works (CAP_NET_ADMIN) — cleaned up after."""
+    if shutil.which("ip") is None:
+        return False
+    probe_ns = "smtpuprobe"
+    r = subprocess.run(["ip", "netns", "add", probe_ns],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        return False
+    subprocess.run(["ip", "netns", "del", probe_ns], capture_output=True)
+    return True
+
+
+def setup_veth_cluster(n: int) -> Optional[str]:
+    """Create bridge + n namespaces; returns an error string on the
+    first failing command (after attempting teardown) or None."""
+    teardown_veth_cluster(n)  # clear leftovers from a dead run
+    for cmd in bridge_cmds() + [c for i in range(n)
+                                for c in netns_cmds(i)]:
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            err = f"{' '.join(cmd)}: {r.stderr.strip() or 'failed'}"
+            teardown_veth_cluster(n)
+            return err
+    return None
+
+
+def teardown_veth_cluster(n: int) -> None:
+    for cmd in netns_teardown_cmds(n):
+        subprocess.run(cmd, capture_output=True)
+
+
+def shape_veth(idx: int, delay_ms: float = 0.0, jitter_ms: float = 0.0,
+               rate_gbps: float = 0.0, loss_pct: float = 0.0
+               ) -> Optional[str]:
+    """Apply netem on replica idx's host-side veth (egress toward the
+    replica); same knobs as apply_netem."""
+    return apply_netem(f"veth{netns_name(idx)}", delay_ms, jitter_ms,
+                       rate_gbps, loss_pct)
