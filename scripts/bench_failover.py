@@ -62,6 +62,16 @@ def main():
                       tick=args.tick, num_groups=args.groups)
     print("cluster up", flush=True)
 
+    # warm the cluster before the timed window opens: the first tick
+    # jit-compiles the kernel (~10s cold on this class of box) and the
+    # server answers nothing meanwhile — without this barrier the
+    # pre-kill window measures the compile stall, not the protocol
+    wep = GenericEndpoint(cluster.manager_addr)
+    wep.connect()
+    DriverClosedLoop(wep).checked_put("warmup", "1")
+    wep.leave()
+    print("warmed up", flush=True)
+
     completions = []  # monotonic timestamps of successful ops
     stop = threading.Event()
     t_start = time.monotonic()
@@ -76,9 +86,18 @@ def main():
             r = drv.put(key, f"v{i}-{n}") if n % 2 else drv.get(key)
             if r.kind == "success":
                 completions.append(time.monotonic())
-            else:
+            elif r.kind in ("timeout", "disconnect"):
+                # dead/paused server or dead socket: move on (redirects
+                # already reconnected inside the driver)
                 drv._failover(r)
                 time.sleep(0.02)
+            elif r.kind == "failure":
+                # server refused (leadership settling): retry in place —
+                # rotating away here thrashes the endpoint around the
+                # membership and can starve the whole run
+                time.sleep(0.05)
+            else:  # redirect: reconnected inside the driver; back off a
+                time.sleep(0.02)  # beat so the loop can't starve servers
             n += 1
         try:
             ep.leave()

@@ -19,6 +19,8 @@ import subprocess
 import sys
 import time
 
+import utils_net
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -99,8 +101,6 @@ def main() -> int:
 
     use_veth = False
     if args.use_veth:
-        import utils_net
-
         # validate --netem BEFORE creating any namespaces: a parse crash
         # after setup would leak the bridge + netns into the root ns
         try:
@@ -151,8 +151,6 @@ def main() -> int:
         log_path = os.path.join(args.backer_dir, f"{name}.log")
         cmd = [sys.executable, "-m", mod, *argv]
         if netns_idx is not None:
-            import utils_net
-
             cmd = utils_net.netns_exec_prefix(netns_idx) + cmd
         proc = subprocess.Popen(
             cmd,
@@ -168,8 +166,6 @@ def main() -> int:
     # advertises its own namespace IP
     man_bind = []
     if use_veth:
-        import utils_net
-
         man_bind = ["--bind-ip", "0.0.0.0"]
     man_log = spawn(
         "manager",
@@ -186,8 +182,6 @@ def main() -> int:
             except OSError:
                 pass
         if use_veth:
-            import utils_net
-
             utils_net.teardown_veth_cluster(args.num_replicas)
 
     if not wait_for_line(man_log, "manager up", 15):
@@ -199,8 +193,6 @@ def main() -> int:
     server_logs = []
     for r in range(args.num_replicas):
         if use_veth:
-            import utils_net
-
             srv_net = [
                 "--bind-ip", utils_net.replica_ip(r),
                 "-m", f"{utils_net.bridge_ip()}:{bp}",

@@ -151,16 +151,30 @@ def netns_exec_prefix(idx: int) -> List[str]:
 
 
 def netns_available() -> bool:
-    """Probe: `ip netns add` works (CAP_NET_ADMIN) — cleaned up after."""
+    """Probe: `ip netns add` works (CAP_NET_ADMIN) — cleaned up after.
+    A leftover probe namespace from a killed prior run is removed first
+    so EEXIST can never read as a permanent capability failure."""
     if shutil.which("ip") is None:
         return False
     probe_ns = "smtpuprobe"
+    subprocess.run(["ip", "netns", "del", probe_ns], capture_output=True)
     r = subprocess.run(["ip", "netns", "add", probe_ns],
                        capture_output=True, text=True)
     if r.returncode != 0:
         return False
     subprocess.run(["ip", "netns", "del", probe_ns], capture_output=True)
     return True
+
+
+def _existing_smtpu_netns() -> List[str]:
+    r = subprocess.run(["ip", "netns", "list"], capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        return []
+    return [
+        line.split()[0] for line in r.stdout.splitlines()
+        if line.split() and line.split()[0].startswith("smtpu")
+    ]
 
 
 def setup_veth_cluster(n: int) -> Optional[str]:
@@ -178,8 +192,15 @@ def setup_veth_cluster(n: int) -> Optional[str]:
 
 
 def teardown_veth_cluster(n: int) -> None:
-    for cmd in netns_teardown_cmds(n):
-        subprocess.run(cmd, capture_output=True)
+    """Remove the bridge and EVERY smtpu* namespace — including ones
+    beyond n left behind by a dead run with a larger replica count
+    (their veths hold addresses in the same /24)."""
+    names = set(_existing_smtpu_netns())
+    names.update(netns_name(i) for i in range(n))
+    names.discard("smtpuprobe")
+    for ns in sorted(names):
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+    subprocess.run(["ip", "link", "del", BRIDGE], capture_output=True)
 
 
 def shape_veth(idx: int, delay_ms: float = 0.0, jitter_ms: float = 0.0,

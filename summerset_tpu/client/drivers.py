@@ -10,6 +10,7 @@ drivers/mod.rs:12-40); ``DriverOpenLoop`` pipelines issues and acks
 from __future__ import annotations
 
 import dataclasses
+import socket
 import time
 from typing import Dict, Optional
 
@@ -19,7 +20,10 @@ from .endpoint import GenericEndpoint
 
 @dataclasses.dataclass
 class DriverReply:
-    kind: str                     # success | redirect | timeout | failure
+    # success | redirect | timeout | failure (server refused) |
+    # disconnect (connection dead — callers must reconnect/rotate, a
+    # retry in place can never succeed)
+    kind: str
     latency: float = 0.0          # seconds (success)
     result: Optional[CommandResult] = None
     redirect: Optional[int] = None
@@ -39,7 +43,7 @@ class DriverClosedLoop:
         try:
             self.ep.send_req(rid, cmd)
         except Exception:
-            return DriverReply("failure")
+            return DriverReply("disconnect")
         deadline = t0 + self.timeout
         while True:
             budget = deadline - time.monotonic()
@@ -47,8 +51,13 @@ class DriverClosedLoop:
                 return DriverReply("timeout")
             try:
                 rep = self.ep.recv_reply(timeout=budget)
+            except socket.timeout:
+                # the budget expired on a healthy connection: that is the
+                # TIMEOUT kind, not a disconnect (the distinction drives
+                # retry-in-place vs rotate in callers)
+                return DriverReply("timeout")
             except Exception:
-                return DriverReply("failure")
+                return DriverReply("disconnect")
             if rep.req_id != rid:
                 continue  # stale reply from a previous timeout
             if rep.kind == "redirect":
@@ -93,7 +102,7 @@ class DriverClosedLoop:
             try:
                 self.ep.send_conf(rid, conf_delta)
             except Exception:
-                self._failover(DriverReply("failure"))
+                self._failover(DriverReply("disconnect"))
                 time.sleep(0.1)
                 continue
             deadline = t0 + max(self.timeout, 15.0)  # conf rides the log
@@ -105,8 +114,11 @@ class DriverClosedLoop:
                     break
                 try:
                     raw = self.ep.recv_reply(timeout=budget)
+                except socket.timeout:
+                    rep = DriverReply("timeout")
+                    break
                 except Exception:
-                    rep = DriverReply("failure")
+                    rep = DriverReply("disconnect")
                     break
                 if raw.req_id != rid:
                     continue
@@ -141,7 +153,7 @@ class DriverClosedLoop:
         connection failure rotates the endpoint to a different server
         (parity: tester.rs:429-433 leave+reconnect around faults; the
         redirect case already reconnected inside ``_issue``)."""
-        if rep.kind in ("timeout", "failure"):
+        if rep.kind in ("timeout", "failure", "disconnect"):
             try:
                 self.ep.rotate()
             except Exception:

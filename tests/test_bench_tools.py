@@ -1,7 +1,16 @@
 """Bench toolkit units: YCSB trace loading, value-size schedules, and
 the external-system adapters' pure mapping + gating."""
 
+import os
+import sys
+
 import pytest
+
+# scripts/ modules (utils_net) are imported by several test classes; the
+# insert lives at module scope so every test passes in isolation
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts",
+))
 
 from summerset_tpu.client.bench import load_ycsb_trace, parse_value_schedule
 from summerset_tpu.client.external_systems import (

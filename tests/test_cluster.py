@@ -241,7 +241,7 @@ class TestClusterTesterSuite:
                     t1 = time.monotonic()
                     if rep.kind == "success":
                         ops.append(record_put(ci, key, val, t0, t1, True))
-                    elif rep.kind in ("timeout", "failure"):
+                    elif rep.kind in ("timeout", "failure", "disconnect"):
                         # may or may not have executed
                         ops.append(record_put(ci, key, val, t0, None,
                                               False))
@@ -253,7 +253,7 @@ class TestClusterTesterSuite:
                     if rep.kind == "success":
                         val = rep.result.value if rep.result else None
                         ops.append(record_get(ci, key, val, t0, t1))
-                    elif rep.kind in ("timeout", "failure"):
+                    elif rep.kind in ("timeout", "failure", "disconnect"):
                         drv._failover(rep)
                 seq += 1
             try:
@@ -449,7 +449,7 @@ class TestClusterNearQuorumReads:
             t1 = time.monotonic()
             if rep.kind == "success":
                 ops.append(record_put(0, "nqr_hist", val, t0, t1, True))
-            elif rep.kind in ("timeout", "failure"):
+            elif rep.kind in ("timeout", "failure", "disconnect"):
                 ops.append(record_put(0, "nqr_hist", val, t0, None,
                                       False))
                 drv._failover(rep)
@@ -839,7 +839,7 @@ class TestClusterQuorumLeases:
             t1 = time.monotonic()
             if rep.kind == "success":
                 ops.append(record_put(0, "lr_key", val, t0, t1, True))
-            elif rep.kind in ("timeout", "failure"):
+            elif rep.kind in ("timeout", "failure", "disconnect"):
                 ops.append(record_put(0, "lr_key", val, t0, None, False))
                 drv._failover(rep)
             time.sleep(0.4)  # leases need quiescence to serve locally
@@ -884,7 +884,7 @@ class TestClusterEPaxos:
                     t1 = time.monotonic()
                     if rep.kind == "success":
                         ops.append(record_put(ci, key, val, t0, t1, True))
-                    elif rep.kind in ("timeout", "failure"):
+                    elif rep.kind in ("timeout", "failure", "disconnect"):
                         ops.append(record_put(ci, key, val, t0, None,
                                               False))
                 else:
@@ -1017,7 +1017,7 @@ class TestClusterLeaderLease:
             t1 = time.monotonic()
             if rep.kind == "success":
                 ops.append(record_put(0, "ll_hist", val, t0, t1, True))
-            elif rep.kind in ("timeout", "failure"):
+            elif rep.kind in ("timeout", "failure", "disconnect"):
                 ops.append(record_put(0, "ll_hist", val, t0, None, False))
                 drv._failover(rep)
             time.sleep(0.25)
