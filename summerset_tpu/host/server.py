@@ -150,6 +150,12 @@ class ServerReplica:
             kcfg.exec_follows_commit = False
         if hasattr(kcfg, "max_proposals_per_tick"):
             kcfg.max_proposals_per_tick = 1  # one ReqBatch per group/tick
+        if protocol.lower() == "epaxos":
+            # leaderless multi-bucket intake: one ReqBatch PER KEY BUCKET
+            # per group per tick, vids passed as an explicit list
+            kcfg.max_proposals_per_tick = max(
+                1, min(kcfg.num_key_buckets, window // 2)
+            )
         # EPaxos conflict detection rides vid % num_key_buckets: the host
         # mints vids in residue classes that encode (key bucket, replica)
         # so same-key commands interfere and different-key commands stay
@@ -216,12 +222,18 @@ class ServerReplica:
         self._conf_queue: List[Tuple[Optional[int], ApiRequest]] = []
         self._conf_seq_seen = 0
         # EPaxos: leaderless — every replica proposes into its own row;
-        # execution runs through the exact host Tarjan applier.  One key
-        # bucket is proposed per group per tick (vid residue must encode
-        # the bucket); the rest wait in _ep_defer for the next ticks.
+        # execution runs through the exact host Tarjan applier.  Every
+        # key bucket with pending requests proposes in the SAME tick
+        # (vids carried as an explicit list; residue encodes the bucket);
+        # _ep_defer only holds overflow beyond max_proposals_per_tick.
         self._epaxos = "st2" in self.state
         self._ep_exec: Dict[int, Any] = {}
         self._ep_defer: Dict[int, list] = {}
+        self._ep_prop_vids = (
+            np.zeros((self.G, self.kernel.config.max_proposals_per_tick),
+                     np.int32)
+            if self._epaxos else None
+        )
         if self._epaxos:
             from .epaxos_exec import EPaxosExecutor
 
@@ -869,33 +881,56 @@ class ServerReplica:
         return zlib.crc32(key.encode() + b"#b") % K
 
     def _intake_epaxos(self, by_group, n_prop, vbase, piggy):
-        """EPaxos proposal path: every replica proposes (leaderless),
-        ONE key bucket per group per tick, with the vid minted in the
-        residue class ``bucket + K * me (mod K * R)`` so the kernel's
-        ``vid % K`` conflict detection sees real key interference while
-        concurrent proposers stay collision-free.  Requests for other
-        buckets wait in ``_ep_defer`` for the following ticks."""
+        """EPaxos proposal path: every replica proposes (leaderless).
+        ALL key buckets with pending requests propose in the same tick —
+        one ReqBatch per bucket, each vid minted in the residue class
+        ``bucket + K * me (mod K * R)`` so the kernel's ``vid % K``
+        conflict detection sees real key interference while concurrent
+        proposers stay collision-free.  The vid list rides the tick's
+        ``prop_vids`` input; only overflow beyond max_proposals_per_tick
+        buckets waits in ``_ep_defer`` (reference: EPaxos commits
+        interfering and non-interfering commands concurrently,
+        dependency.rs:180-240)."""
         K = self.kernel.config.num_key_buckets
         R = self.population
+        pmax = self.kernel.config.max_proposals_per_tick
+        self._ep_prop_vids[:] = 0
         for g, reqs in by_group.items():
             self._ep_defer[g].extend(reqs)
+        own_next = np.asarray(self.state["own_next"])[:, self.me]
+        # the kernel's own window guard reads exec_row as of the LAST
+        # tick (its _propose runs before _execute applies this tick's
+        # exec_floor_rows), so the space computation must use the SAME
+        # stale value — the live Tarjan floor runs one tick ahead and
+        # would let us mint vids the kernel then silently refuses to
+        # propose, orphaning their payload batches
+        exec_me = np.asarray(self.state["exec_row"])[:, self.me, self.me]
         for g in range(self.G):
             pend = self._ep_defer[g]
             if not pend:
                 continue
-            bucket = self._key_bucket(pend[0][1].cmd.key)
-            take, keep = [], []
+            by_bucket: Dict[int, list] = {}
             for c, r in pend:
-                (take if self._key_bucket(r.cmd.key) == bucket
-                 else keep).append((c, r))
+                by_bucket.setdefault(
+                    self._key_bucket(r.cmd.key), []
+                ).append((c, r))
+            space = max(0, int(exec_me[g]) + self.window - int(own_next[g]))
+            take_buckets = list(by_bucket)[:min(pmax, space)]
+            keep = [
+                cr for b in by_bucket if b not in take_buckets
+                for cr in by_bucket[b]
+            ]
             self._ep_defer[g] = keep
-            vid = self.payloads.put(
-                g, take, stride=K * R, residue=bucket + K * self.me
-            )
-            self.origin.add((g, vid))
-            n_prop[g] = 1
-            vbase[g] = vid
-            piggy[(g, vid)] = take
+            for i, b in enumerate(take_buckets):
+                take = by_bucket[b]
+                vid = self.payloads.put(
+                    g, take, stride=K * R, residue=b + K * self.me
+                )
+                self.origin.add((g, vid))
+                self._ep_prop_vids[g, i] = vid
+                piggy[(g, vid)] = take
+            n_prop[g] = len(take_buckets)
+            vbase[g] = int(self._ep_prop_vids[g, 0])
         return n_prop, vbase, piggy
 
     # ------------------------------------------------------------ conf plane
@@ -1071,6 +1106,7 @@ class ServerReplica:
                 inputs["prop_replica"] = jnp.full(
                     (self.G,), self.me, jnp.int32
                 )
+                inputs["prop_vids"] = jnp.asarray(self._ep_prop_vids)
             if self._adaptive is not None:
                 while self.transport.samples:
                     try:

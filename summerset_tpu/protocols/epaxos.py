@@ -708,15 +708,40 @@ class EPaxosKernel(ProtocolKernel):
         )[..., 0]
         space = jnp.maximum(own_exec + W - s["own_next"], 0)
         n_new = jnp.minimum(share, space)
+        pv0 = c.inputs.get("prop_vids")
+        if pv0 is not None:
+            # never propose past the vid list's width: an out-of-range
+            # gather would silently duplicate the last vid across
+            # distinct instances (payload exchange is first-writer-wins,
+            # so the duplicate would commit the wrong batch)
+            n_new = jnp.minimum(n_new, pv0.shape[1])
         vbase = jnp.broadcast_to(
             c.inputs["value_base"][:, None].astype(i32), (G, R)
         )
         m_new, abs_new = range_cover(s["own_next"], s["own_next"] + n_new, W)
         off = abs_new - s["own_next"][..., None]
-        # distinct value ids across replicas: interleave by rid
+        # distinct value ids across replicas: interleave by rid.  In host
+        # mode an explicit per-tick vid LIST may be supplied
+        # (``prop_vids`` [G, max_props], entries beyond n_proposals
+        # ignored): the host mints vids in per-bucket residue classes, so
+        # one tick can propose SEVERAL key buckets at once — consecutive
+        # vbase+off ints could not express that (reference behavior:
+        # EPaxos commits interfering and non-interfering commands
+        # concurrently, dependency.rs:180-240)
+        pv = pv0
+        if pv is not None:
+            pmax = pv.shape[1]
+            pvb = jnp.broadcast_to(
+                pv[:, None, :].astype(i32), (G, R, pmax)
+            )
+            host_vals = jnp.take_along_axis(
+                pvb, jnp.clip(off, 0, pmax - 1), axis=2
+            )
+        else:
+            host_vals = vbase[..., None] + off
         new_vals = jnp.where(
             host_mode[..., None],
-            vbase[..., None] + off,
+            host_vals,
             vbase[..., None] * R + rid[..., None] + off * R,
         )
         bucket = new_vals % K
