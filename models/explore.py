@@ -191,19 +191,35 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
     )
 
 
+# per-protocol config overrides for CLI runs (rspaxos with an extra
+# required ack actually exercises the commit_k/full-quorum veto paths;
+# ft=0 would be the degenerate plain-majority configuration)
+CLI_PRESETS: Dict[str, Dict[str, Any]] = {
+    "rspaxos": {"fault_tolerance": 1},
+}
+
+
 def main() -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--protocols", default="multipaxos,raft")
-    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument(
+        "--protocols", default="multipaxos:6,raft:6,rspaxos:5",
+        help="comma list of name[:depth]; this default regenerates the "
+             "committed MODELCHECK.json in one invocation",
+    )
+    ap.add_argument("--depth", type=int, default=6,
+                    help="depth for entries without an explicit :depth")
     ap.add_argument("--round-ticks", type=int, default=2)
     ap.add_argument("--out", default="")
     args = ap.parse_args()
     results = []
-    for p in args.protocols.split(","):
-        r = explore(p.strip(), depth=args.depth,
-                    round_ticks=args.round_ticks, progress=True)
+    for spec in args.protocols.split(","):
+        name, _, d = spec.strip().partition(":")
+        r = explore(name, depth=int(d) if d else args.depth,
+                    round_ticks=args.round_ticks,
+                    config_overrides=CLI_PRESETS.get(name),
+                    progress=True)
         print(json.dumps(r.as_json()))
         results.append(r.as_json())
         assert not r.violations, r.violations

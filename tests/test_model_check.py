@@ -7,8 +7,10 @@ reached node (reference analog: ``tla+/tlc_model_check.sh`` runs TLC
 over MultiPaxos/Crossword/Bodega specs at tiny constants).
 
 The default tier runs depth 3 (~400 expansions per kernel); the slow
-tier runs depth 6 (the full 7^6-schedule space modulo state dedup).
-Committed run logs live in MODELCHECK.json (scripts/model_check.sh).
+tier runs depth 6 for MultiPaxos/Raft and depth 5 for RSPaxos.
+Committed run logs live in MODELCHECK.json; regenerate them with
+``python models/explore.py --out MODELCHECK.json`` (the --protocols
+default carries the per-protocol depths and config presets).
 """
 
 import os
@@ -35,5 +37,17 @@ def test_exhaustive_depth3(protocol):
 @pytest.mark.parametrize("protocol", ["multipaxos", "raft"])
 def test_exhaustive_depth6(protocol):
     r = explore(protocol, depth=6)
+    assert not r.violations, r.violations
+    assert r.max_committed_slots > 0
+
+
+@pytest.mark.slow
+def test_exhaustive_rspaxos_depth5():
+    """RSPaxos under exhaustion — the kernel whose lagging-exec step-up
+    bug the randomized sweep caught.  fault_tolerance=1 (not the
+    degenerate default 0) so the commit tally really requires
+    quorum + ft acks and the R - ft prepare shortcut is live."""
+    r = explore("rspaxos", depth=5,
+                config_overrides={"fault_tolerance": 1})
     assert not r.violations, r.violations
     assert r.max_committed_slots > 0
