@@ -21,10 +21,24 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# --platform must be consumed BEFORE importing jax: the platform pin only
+# works pre-backend-init.  "cpu" (default) is hermetic for CI boxes;
+# "preset" leaves the environment's platform alone — on a TPU host that
+# is the one-command TPU-in-the-loop serving bench (the kernel ticks on
+# the chip while the client/WAL/apply planes run host-side).
+_plat = "cpu"
+for _i, _a in enumerate(sys.argv[1:], 1):
+    if _a == "--platform" and _i + 1 < len(sys.argv):
+        _plat = sys.argv[_i + 1]
+    elif _a.startswith("--platform="):
+        _plat = _a.split("=", 1)[1]
+
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if _plat != "preset":
+    jax.config.update("jax_platforms", _plat)
+    if _plat == "cpu":
+        jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
@@ -37,6 +51,10 @@ def main() -> None:
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--secs", type=float, default=10.0)
     ap.add_argument("--tick", type=float, default=0.002)
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform pin; 'preset' keeps the env's "
+                         "backend (run on a TPU host for the "
+                         "TPU-in-the-loop serving measurement)")
     ap.add_argument("--num-keys", type=int, default=64)
     ap.add_argument("--value-size", default="64")
     ap.add_argument("--put-ratio", type=float, default=0.5)
@@ -92,6 +110,7 @@ def main() -> None:
         "replicas": args.replicas,
         "clients": len(done),
         "secs": args.secs,
+        "platform": jax.devices()[0].platform,
         "tput": round(tput, 2),
         "lat_p50_ms": round(p50, 3),
         "lat_p99_ms": round(p99, 3),
