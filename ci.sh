@@ -1,7 +1,7 @@
 #!/bin/bash
 # CI entry (parity: the reference's tests_unit + tests_proc workflows).
 #
-#   ./ci.sh            # tier 1+2: default pytest suite + proc tests
+#   ./ci.sh            # tiers 1+2+2b: default suite + proc tests + codeword
 #   ./ci.sh --full     # adds the slow-marked superset (pytest -m "")
 #
 # Tier 1: kernel/unit/integration suites on the 8-device virtual CPU
@@ -9,6 +9,9 @@
 # Tier 2: real multi-process clusters (manager + 3 servers + tester
 #         client over localhost TCP) for MultiPaxos AND Raft — the
 #         reference's proc-test shape (.github/workflow_test.py).
+# Tier 2b: the codeword payload plane — live RSPaxos/CRaft/Crossword
+#         clusters asserting shard-sized peer payload frames (~1/d vs
+#         MultiPaxos full-copy) and leader-crash shard reconstruction.
 # Tier 3 (--full): every slow-marked fault-scenario kernel test and the
 #         randomized property sweep.
 set -e
@@ -19,6 +22,11 @@ python -m pytest tests/ -q
 
 echo "=== tier 2: process-level cluster tests (MultiPaxos, Raft) ==="
 python scripts/proc_test.py
+
+echo "=== tier 2b: codeword payload plane (RS shard serving) ==="
+# the slow-marked cluster tier only — tier 1 already ran this file's
+# fast (codec/store) half
+python -m pytest tests/test_codeword_plane.py -q -m slow
 
 if [ "$1" = "--full" ]; then
   echo "=== tier 3: full superset (slow tests included) ==="

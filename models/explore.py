@@ -23,10 +23,14 @@ install-snapshot heal plane all engage at W=4, which is exactly the
 regime where the sweep found the rspaxos exec-lag step-up bug.
 
 Scope note: durability is checked edge-locally against each path's own
-accumulator; converging paths dedup on state hash, so a binding change
-between two *different* paths to the same state would be caught on
-whichever path reaches it — identical states imply identical windows,
-so in-window rewrites cannot hide.
+accumulator; converging paths dedup on state hash PLUS a digest of the
+accumulator's out-of-window portion.  Identical states imply identical
+windows, so in-window rewrites cannot hide behind dedup — but two paths
+can reach the same (state, netstate) having committed *different* values
+for slots that already slid out of every window; without the accumulator
+digest the second path would be pruned and its divergent history never
+checked against descendants.  Folding the out-of-window bindings into
+the key keeps both paths explored (at the cost of some extra expansion).
 """
 
 from __future__ import annotations
@@ -72,6 +76,20 @@ def _state_hash(state: Dict[str, Any], ns: Any) -> bytes:
         h.update(np.asarray(state[k]).tobytes())
     for leaf in jax.tree_util.tree_leaves(ns):
         h.update(np.asarray(leaf).tobytes())
+    return h.digest()
+
+
+def _oow_digest(acc: Dict[int, int], visible: Dict[int, int]) -> bytes:
+    """Digest of the accumulator's out-of-window portion: committed
+    bindings no longer re-derivable from any replica's window.  Folded
+    into the dedup key so two paths converging on the same state with
+    different slid-out histories are both kept (module docstring)."""
+    items = [(s, v) for s, v in sorted(acc.items()) if s not in visible]
+    if not items:
+        return b""
+    h = hashlib.blake2b(digest_size=8)
+    for s, v in items:
+        h.update(s.to_bytes(8, "little") + v.to_bytes(8, "little"))
     return h.digest()
 
 
@@ -144,8 +162,9 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
 
     nodes = deque()
     np0 = {k: np.asarray(v) for k, v in state0.items()}
-    nodes.append((state0, ns0, _committed(np0, R, W), 0))
-    seen = {_state_hash(state0, ns0)}
+    acc0 = _committed(np0, R, W)
+    nodes.append((state0, ns0, acc0, 0))
+    seen = {_state_hash(state0, ns0) + _oow_digest(acc0, acc0)}
     expanded = 0
     dedup = 0
     max_committed = 0
@@ -174,7 +193,7 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
             acc2 = dict(acc)
             acc2.update(cm)
             max_committed = max(max_committed, len(acc2))
-            h = _state_hash(s2, n2)
+            h = _state_hash(s2, n2) + _oow_digest(acc2, cm)
             if h in seen:
                 dedup += 1
                 continue
@@ -204,7 +223,7 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
-        "--protocols", default="multipaxos:6,raft:6,rspaxos:5",
+        "--protocols", default="multipaxos:6,raft:6,rspaxos:6",
         help="comma list of name[:depth]; this default regenerates the "
              "committed MODELCHECK.json in one invocation",
     )

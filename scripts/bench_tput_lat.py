@@ -29,7 +29,8 @@ sys.path.insert(0, REPO)
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from summerset_tpu.utils.jaxcompat import set_cpu_devices
+set_cpu_devices(8)
 
 sys.path.insert(0, os.path.join(REPO, "tests"))
 

@@ -29,7 +29,10 @@ import argparse
 import shlex
 import subprocess
 import sys
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
 
 SSH = ["ssh", "-o", "StrictHostKeyChecking=no",
        "-o", "BatchMode=yes"]

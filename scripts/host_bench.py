@@ -38,7 +38,8 @@ import jax
 if _plat != "preset":
     jax.config.update("jax_platforms", _plat)
     if _plat == "cpu":
-        jax.config.update("jax_num_cpu_devices", 8)
+        from summerset_tpu.utils.jaxcompat import set_cpu_devices
+        set_cpu_devices(8)
 
 sys.path.insert(0, os.path.join(REPO, "tests"))
 

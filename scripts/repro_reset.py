@@ -8,7 +8,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from summerset_tpu.utils.jaxcompat import set_cpu_devices
+set_cpu_devices(8)
 
 logging.basicConfig(
     level=logging.INFO,

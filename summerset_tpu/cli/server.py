@@ -9,7 +9,10 @@ in a crash-restart while loop: ``run()`` returning True restarts
 from __future__ import annotations
 
 import argparse
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
 
 from ..host.server import ServerReplica
 from ..utils.logging import logger_init, pf_info, pf_logger

@@ -52,11 +52,16 @@ class DriverClosedLoop:
             try:
                 rep = self.ep.recv_reply(timeout=budget)
             except socket.timeout:
-                # the budget expired on a healthy connection: that is the
-                # TIMEOUT kind, not a disconnect (the distinction drives
-                # retry-in-place vs rotate in callers)
+                # the budget expired on a healthy connection WITH ZERO
+                # frame bytes consumed (safetcp raises SummersetError for
+                # a mid-frame timeout, taken by the branch below): the
+                # stream is still frame-aligned, so this is the TIMEOUT
+                # kind and a retry in place is safe
                 return DriverReply("timeout")
             except Exception:
+                # includes a timeout that fired mid-frame: the api stub's
+                # stream is no longer frame-aligned and a retry in place
+                # would unpickle garbage — callers must reconnect/rotate
                 return DriverReply("disconnect")
             if rep.req_id != rid:
                 continue  # stale reply from a previous timeout

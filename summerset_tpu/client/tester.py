@@ -44,7 +44,11 @@ class ClientTester:
     def _fresh(self) -> Tuple[GenericEndpoint, DriverClosedLoop]:
         ep = GenericEndpoint(self.manager_addr)
         ep.connect()
-        return ep, DriverClosedLoop(ep)
+        # generous per-request timeout: the reset cases recover through
+        # WAL replay + mesh rejoin, which stretches well past the default
+        # on slow/loaded boxes (checked_* retries spin fast on redirects,
+        # so only genuinely dead windows pay this budget)
+        return ep, DriverClosedLoop(ep, timeout=8.0)
 
     def _leader(self, ep: GenericEndpoint) -> Optional[int]:
         info = ep.ctrl.request(CtrlRequest("query_info"))

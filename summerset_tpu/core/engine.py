@@ -34,6 +34,31 @@ class Engine:
     ):
         self.kernel = kernel
         self.netcfg = netcfg
+        # Lease-plane safety is CLOCK-FREE only because a grantor's
+        # countdown outlives the holder's belief by more than the maximum
+        # one-way message delay (quorum_leases.py module doc;
+        # leaderlease.rs:10-21): with delay > lease_margin a revocation /
+        # lapsed promise can arrive at the grantor AFTER a stale holder
+        # served a local read at the old conf — a linearizability hole no
+        # test would catch deterministically.  Refuse the geometry here,
+        # where the kernel's tick semantics meet the netmodel's delays.
+        kcfg = getattr(kernel, "config", None)
+        margin = getattr(kcfg, "lease_margin", None)
+        leases_on = (
+            getattr(kcfg, "leader_leases", False)
+            or getattr(kcfg, "enable_leader_leases", False)
+            or hasattr(kcfg, "lease_len")  # QL/Bodega grantor plane
+        )
+        if margin is not None and leases_on and (
+            margin <= netcfg.max_delay_ticks
+        ):
+            raise ValueError(
+                f"lease_margin ({margin}) must exceed the network's "
+                f"max_delay_ticks ({netcfg.max_delay_ticks}): a lease "
+                "margin at or below the one-way delay permits a stale "
+                "holder to serve a local read after its grantor's "
+                "countdown lapsed"
+            )
         self.seed = seed
         self.net = NetModel(netcfg, kernel.G, kernel.R, kernel.broadcast_lanes)
         self._tick_jit = jax.jit(partial(_tick, self.kernel, self.net))

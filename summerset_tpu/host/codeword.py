@@ -1,0 +1,235 @@
+"""CodewordStore: the host-side sharded codeword payload plane.
+
+Parity: reference ``RSCodeword`` storage inside RSPaxos / CRaft /
+Crossword instances (``rspaxos/mod.rs:597-608``: the leader erasure-codes
+each request batch and sends replica ``r`` only its assigned shard
+subset; ``crossword/gossiping.rs:14-193``: followers fill missing shards
+off the critical path; ``rspaxos/leadership.rs:142-165``: committed-but-
+shard-starved replicas issue Reconstruct reads answered from held
+shards).
+
+TPU-native split: the device kernels track shard *availability* (vote
+runs, ``full_bar`` gating, RECON_REQ/RECON_REPLY cover frontiers) over
+int32 value references; this store owns the actual shard bytes keyed by
+``(group, value id)``:
+
+- **encode-once caching**: the proposer serializes a ReqBatch once and
+  encodes it through :class:`~summerset_tpu.ops.rscoding.RSCode` (Pallas
+  bit-sliced GF(2^8) on TPU, XLA bit-slice on CPU) into the full
+  ``[T, L]`` codeword; per-peer sends are row slices of that cache.
+- **availability bitmaps**: one int mask over the ``T`` shard ids per
+  value, maintained on every ingest — the host analog of the kernel's
+  per-slot shard-holder tallies.
+- **reconstruct integration**: once ``d`` distinct shards are held,
+  ``reconstruct_batch`` decodes back to the request batch (and restores
+  the full codeword via ``reconstruct_all`` so the replica can serve any
+  shard id in later gossip rounds — what a new leader needs before
+  re-distributing adopted slots under its own assignment).
+
+Assignment geometry (balanced diagonal family, ``adaptive.rs:44-67``):
+replica ``r`` owns base shards ``[r * dj, (r + 1) * dj) mod T``; a width-
+``spr`` assignment extends that run to ``spr`` shards.  RSPaxos/CRaft are
+the ``dj = 1, T = R, spr = 1`` degenerate case (shard ``r`` -> replica
+``r``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.rscoding import (
+    RSCode,
+    decode_rows,
+    encode_payload,
+    unpack_bytes,
+)
+
+
+def assigned_sids(replica: int, spr: int, dj: int, total: int
+                  ) -> Tuple[int, ...]:
+    """Shard ids assigned to ``replica`` under a width-``spr`` balanced
+    diagonal assignment (``crossword/adaptive.rs:44-67``)."""
+    return tuple((replica * dj + k) % total for k in range(spr))
+
+
+class CodewordStore:
+    """Per-(group, value-id) RS shard maps with availability bitmaps."""
+
+    def __init__(self, num_groups: int, code: RSCode, total: int,
+                 dj: int = 1):
+        self.code = code
+        self.d = code.d
+        self.T = total
+        self.dj = dj
+        self._lock = threading.Lock()
+        # group -> vid -> {shard id: [L] int32}
+        self._shards: list = [dict() for _ in range(num_groups)]
+        self._dlen: list = [dict() for _ in range(num_groups)]
+        self._spr: list = [dict() for _ in range(num_groups)]  # encoder only
+        # shard ids received as OUR assignment (proposer "ps" sends /
+        # recovered WAL slices) — the only rows a vote may durably log
+        self._asg: list = [dict() for _ in range(num_groups)]
+
+    # ------------------------------------------------------------- encode
+    def encode(self, group: int, vid: int, batch: Any, spr: int
+               ) -> Tuple[int, np.ndarray]:
+        """Encode-once: serialize + RS-encode ``batch`` into the full
+        ``[T, L]`` codeword, caching every shard locally.  Returns
+        ``(data_len, codeword)``; re-encoding an already-held vid returns
+        the cached rows."""
+        with self._lock:
+            held = self._shards[group].get(vid)
+            if held is not None and len(held) == self.T:
+                self._spr[group].setdefault(vid, spr)
+                return (
+                    self._dlen[group].get(vid, 0),
+                    np.stack([held[i] for i in range(self.T)]),
+                )
+        dlen, cw = encode_payload(self.code, pickle.dumps(batch))
+        with self._lock:
+            self._dlen[group][vid] = dlen
+            self._spr[group][vid] = spr
+            self._shards[group].setdefault(vid, {}).update(
+                {i: cw[i] for i in range(self.T)}
+            )
+        return dlen, cw
+
+    # ------------------------------------------------------------- ingest
+    def add_shards(self, group: int, vid: int, data_len: int,
+                   shards: Dict[int, np.ndarray],
+                   assigned: bool = False) -> None:
+        """Ingest shard rows.  ``assigned=True`` marks them as THIS
+        replica's assignment (a proposer "ps" send or a recovered WAL
+        slice) — eligible for durable vote logging; gossip fills are
+        not (a vote must stand for the voter's own slice, or recovery
+        coverage counts the same shard twice across voters)."""
+        with self._lock:
+            self._dlen[group].setdefault(vid, int(data_len))
+            self._shards[group].setdefault(vid, {}).update(shards)
+            if assigned:
+                self._asg[group].setdefault(vid, set()).update(shards)
+
+    # ------------------------------------------------------------ queries
+    def have_mask(self, group: int, vid: int) -> int:
+        """Availability bitmap over shard ids (bit s = shard s held)."""
+        with self._lock:
+            held = self._shards[group].get(vid)
+            if not held:
+                return 0
+            m = 0
+            for s in held:
+                m |= 1 << s
+            return m
+
+    def can_reconstruct(self, group: int, vid: int) -> bool:
+        with self._lock:
+            return len(self._shards[group].get(vid) or ()) >= self.d
+
+    def shards_for(self, group: int, vid: int,
+                   exclude_mask: int = 0,
+                   only_sids: Optional[Tuple[int, ...]] = None,
+                   ) -> Optional[Tuple[int, Dict[int, np.ndarray]]]:
+        """Held shards for a vid as ``(data_len, {sid: rows})``, minus
+        the requester's ``exclude_mask`` bitmap, optionally restricted to
+        ``only_sids`` (the responder's own diagonal in non-urgent gossip
+        rounds).  None when nothing useful is held."""
+        with self._lock:
+            held = self._shards[group].get(vid)
+            if not held:
+                return None
+            sids = held.keys() if only_sids is None else [
+                s for s in only_sids if s in held
+            ]
+            out = {
+                s: held[s] for s in sids if not (exclude_mask >> s) & 1
+            }
+            if not out:
+                return None
+            return self._dlen[group].get(vid, 0), out
+
+    def wal_shards(self, group: int, vid: int, me: int
+                   ) -> Optional[Tuple[int, Dict[int, np.ndarray]]]:
+        """The shard subset this replica durably logs for a voted vid —
+        always its OWN assignment, never gossip-received foreign rows
+        (``durability.rs`` logs accepted shard data, not full batches):
+
+        - full-codeword holders (the encoder, or a replica that gossip-
+          healed to all T rows) log their assigned diagonal slice —
+          logging all T rows would be worse write amplification than the
+          full-copy pp path this plane replaces;
+        - partial holders log the rows that arrived AS their assignment
+          (proposer sends / recovered WAL slices).  Foreign gossip rows
+          alone yield None: a vote logged over someone else's shard
+          would double-count that shard across voters and leave a
+          committed value unreconstructable after a full-cluster crash
+          (the d-distinct-slices recovery invariant).  The vid then
+          simply stays unlogged until the heal completes (reconstruction
+          restores all T rows, re-entering the first case)."""
+        with self._lock:
+            held = self._shards[group].get(vid)
+            if not held:
+                return None
+            if len(held) == self.T:
+                spr = self._spr[group].get(vid) or self.dj
+                own = assigned_sids(me, max(spr, self.dj), self.dj, self.T)
+                sub = {s: held[s] for s in own if s in held}
+            else:
+                asg = self._asg[group].get(vid) or ()
+                sub = {s: held[s] for s in asg if s in held}
+            if not sub:
+                return None
+            return self._dlen[group].get(vid, 0), sub
+
+    # -------------------------------------------------------- reconstruct
+    def reconstruct_batch(self, group: int, vid: int) -> Optional[Any]:
+        """Decode the request batch once >= d shards are held (None
+        otherwise).  Also restores the full codeword rows so later gossip
+        rounds can serve ANY shard id of this value."""
+        with self._lock:
+            held = self._shards[group].get(vid)
+            if held is None or len(held) < self.d:
+                return None
+            dlen = self._dlen[group].get(vid)
+            if dlen is None:
+                return None
+            held = dict(held)
+        rows = decode_rows(self.code, held)
+        buf = unpack_bytes(rows, dlen)
+        if len(held) < self.T:
+            # restore every row from the decoded data rows — the SAME
+            # lane geometry the encoder used (decode_rows preserves it),
+            # so restored shards are byte-identical to the originals and
+            # safe to mix with encoder-sent shards in later gossip rounds
+            import jax.numpy as jnp
+
+            parity = (
+                np.asarray(self.code.compute_parity(
+                    jnp.asarray(rows)[None]
+                )[0])
+                if self.code.p else rows[:0]
+            )
+            cw = np.concatenate([rows, parity], axis=0)
+            with self._lock:
+                self._shards[group].setdefault(vid, {}).update(
+                    {i: cw[i] for i in range(self.T)}
+                )
+        return pickle.loads(buf)
+
+    # ----------------------------------------------------------------- gc
+    def gc_below(self, group: int, vid_floor: int) -> int:
+        with self._lock:
+            drop = [v for v in self._shards[group] if v < vid_floor]
+            for v in drop:
+                self._shards[group].pop(v, None)
+                self._dlen[group].pop(v, None)
+                self._spr[group].pop(v, None)
+                self._asg[group].pop(v, None)
+        return len(drop)
+
+    def size(self, group: int) -> int:
+        with self._lock:
+            return len(self._shards[group])

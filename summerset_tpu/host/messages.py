@@ -39,6 +39,27 @@ class ApiReply:
     local: bool = False             # served as a leased local read
 
 
+# -------------------------------------------------------------- p2p plane
+# Server-to-server tick frames are plain dicts (host/server.py builds
+# them, host/transport.py ships them): ``msg`` carries the kernel outbox
+# slices; payload-plane keys ride alongside:
+#   pp: {(group, vid): ReqBatch}            full-copy piggybacks
+#   ps: {(group, vid): ShardPayload}        proposer -> peer assigned shards
+#   cw: {(group, vid): ShardPayload}        gossip replies (held shards)
+#   cw_need: [(group, vid, have_mask, urgent)]   shard-gossip requests
+#   need / kv_need / kv / rq / rqr: full-payload + snapshot + quorum-read
+#                                   planes (pre-codeword machinery)
+@dataclasses.dataclass(frozen=True)
+class ShardPayload:
+    """A subset of one value's RS codeword on the wire (parity role:
+    the shard-subset ``RSCodeword`` carried by Accept / Reconstruct
+    messages, ``rspaxos/mod.rs:597-608``, ``messages.rs:468-560``)."""
+
+    data_len: int        # original serialized ReqBatch byte length
+    shards: Dict[int, Any] = dataclasses.field(default_factory=dict)
+    # shard id -> [L] int32 lane array (4 packed bytes per lane)
+
+
 # ------------------------------------------------------------ control plane
 @dataclasses.dataclass(frozen=True)
 class CtrlMsg:

@@ -9,6 +9,13 @@ below the group's snapshot bar.
 The id space mirrors the synthetic-load convention used by the kernels'
 bench mode (``value_base`` input): ids are positive, 0 is reserved for the
 no-op filler (``protocols/common.py`` NULL_VAL).
+
+Store split (codeword plane): this store holds only *full* request
+batches.  The RS protocol family keeps erasure-coded shard subsets in the
+sibling :class:`~summerset_tpu.host.codeword.CodewordStore`; the server's
+``_resolve_payload`` checks here first and falls back to shard
+reconstruction, installing the decoded batch via :meth:`install` so the
+decode cost is paid once per value.
 """
 
 from __future__ import annotations
@@ -47,6 +54,22 @@ class PayloadStore:
             return None  # no-op filler
         with self._lock:
             return self._data[group].get(vid)
+
+    def install(self, group: int, vid: int, batch: Any,
+                overwrite: bool = True) -> None:
+        """Install a batch under a peer-minted / reconstructed vid,
+        keeping the local minting cursor past it (first-writer-wins when
+        ``overwrite`` is False, the payload-exchange dedup rule)."""
+        with self._lock:
+            if overwrite or vid not in self._data[group]:
+                self._data[group][vid] = batch
+            self._next[group] = max(self._next[group], vid + 1)
+
+    def note_seen(self, group: int, vid: int) -> None:
+        """Bump the minting cursor past an externally observed vid
+        (shard-only ingests hold no batch to install)."""
+        with self._lock:
+            self._next[group] = max(self._next[group], vid + 1)
 
     def gc_below(self, group: int, vid_floor: int) -> int:
         """Drop payloads with id < vid_floor (snapshot GC); returns count."""

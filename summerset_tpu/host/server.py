@@ -50,9 +50,10 @@ import jax.numpy as jnp
 from ..protocols import make_protocol
 from ..utils.errors import SummersetError
 from ..utils.logging import pf_info, pf_logger, pf_warn
+from .codeword import assigned_sids
 from .control import ControlHub
 from .external import ExternalApi
-from .messages import ApiReply, ApiRequest, CtrlMsg
+from .messages import ApiReply, ApiRequest, CtrlMsg, ShardPayload
 from .payload import PayloadStore
 from .statemach import CommandResult, StateMachine, apply_command
 from .storage import LogAction, StorageHub
@@ -97,6 +98,16 @@ def _unique_window_vids(val_win: np.ndarray, groups: np.ndarray) -> dict:
                       np.concatenate([bounds, [len(gs)]])):
         out[int(gs[lo])] = vs[lo:hi].tolist()
     return out
+
+
+def _sp_size(sp: ShardPayload) -> int:
+    """Approximate pickled wire size of a ShardPayload without
+    re-serializing it (the frame encoder pickles the real thing moments
+    later; paying a second full pickle just for the egress meter would
+    double the payload-plane serialization cost on the hot path)."""
+    return 64 + sum(
+        np.asarray(a).nbytes + 144 for a in sp.shards.values()
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -242,15 +253,60 @@ class ServerReplica:
                     self.population, window, self._make_ep_apply(g)
                 )
                 self._ep_defer[g] = []
-        # Crossword: host predictive shard-assignment (linreg + qdisc)
+        # Crossword: host predictive shard-assignment (linreg + qdisc);
+        # assignment_adaptive=False pins both the kernel's reactive policy
+        # AND this host override to init_spr (deterministic slicing)
         self._adaptive = None
-        if "cur_spr" in self.state:
+        if "cur_spr" in self.state and getattr(
+            self.kernel.config, "assignment_adaptive", True
+        ):
             from .adaptive import CrosswordAdaptive
 
             self._adaptive = CrosswordAdaptive(
                 self.population, self.kernel.data_shards, self.me,
             )
             self._batch_bytes = 0.0  # EWMA of proposed batch sizes
+            self._spr_tick = [self.kernel.data_shards] * self.G
+
+        # codeword payload plane (RS erasure-coded family): the kernel
+        # runs the coded control plane; this store ships/holds the actual
+        # shard bytes so peer payload frames shrink to ~1/d of the batch
+        # (rspaxos/mod.rs:597-608, crossword/gossiping.rs:14-193)
+        self.codewords = None
+        self._cw_dj = 1
+        self._cw_spr0 = 1
+        if hasattr(self.kernel, "num_data") and "full_bar" in self.state:
+            from ..ops.rscoding import RSCode
+            from .codeword import CodewordStore
+
+            if "cur_spr" in self.state:  # Crossword: T shards, dj-wide base
+                cw_T = self.kernel.total_shards
+                cw_d = self.kernel.data_shards
+                self._cw_dj = self.kernel.dj
+                self._cw_spr0 = self.kernel.init_spr
+            else:  # RSPaxos / CRaft: shard r -> replica r
+                cw_T = self.population
+                cw_d = self.kernel.num_data
+                self._cw_spr0 = 1
+            self.codewords = CodewordStore(
+                self.G, RSCode(cw_d, cw_T - cw_d), cw_T, self._cw_dj
+            )
+        self._cw_first_missing: Dict[Tuple[int, int], int] = {}
+        self._pending_shards: Dict[int, dict] = {}  # dst -> {(g,vid): sp}
+        self._pending_cw: Dict[int, dict] = {}      # dst -> {(g,vid): sp}
+        # per-peer payload-plane egress accounting (bytes + payload count
+        # of the pp/ps frame parts) — the measurable shard-economy hook
+        # the cluster tests and PERF.md read; bytes/count is the per-
+        # payload frame size (~batch for full copies, ~batch/d + parity
+        # overhead for shard sends)
+        self.pp_bytes = [0] * self.population
+        self.pp_items = [0] * self.population
+        self.cw_bytes = [0] * self.population  # gossip-reply egress
+        # CRaft full-copy fallback mirror (host view of _append_mode; may
+        # trail the kernel's stamp by one tick — the same documented
+        # weakening window as the reference's global latch,
+        # craft/mod.rs:280-283)
+        self._craft_mode = "win_full" in self.state
 
         # near-quorum reads need the MultiPaxos-family vote-run contract
         # and a single-writer-per-slot log (not the EPaxos 2-D space)
@@ -355,20 +411,24 @@ class ServerReplica:
                 g, v = rec[1], rec[2]
                 votes[g] = v
                 for vid, batch in v.get("pp", {}).items():
-                    self.payloads._data[g].setdefault(vid, batch)
-                    self.payloads._next[g] = max(
-                        self.payloads._next[g], vid + 1
-                    )
+                    self.payloads.install(g, vid, batch, overwrite=False)
+                    self._logged_vids[g].add(vid)
+                for vid, (dlen, sh) in v.get("cw", {}).items():
+                    # shard-only durable record: a recovered quorum's
+                    # shards re-serve committed values through the gossip
+                    # plane (reference Reconstruct reads)
+                    if self.codewords is not None:
+                        self.codewords.add_shards(
+                            g, vid, dlen, sh, assigned=True
+                        )
+                    self.payloads.note_seen(g, vid)
                     self._logged_vids[g].add(vid)
             elif isinstance(rec, tuple) and rec and rec[0] == "eapply":
                 # EPaxos exec record: replay in logged (= execution)
                 # order; per-row floors advance contiguously
                 _, g, row, col, vid, batch = rec
                 if batch is not None:
-                    self.payloads._data[g][vid] = batch
-                    self.payloads._next[g] = max(
-                        self.payloads._next[g], vid + 1
-                    )
+                    self.payloads.install(g, vid, batch)
                     for client, req in batch:
                         if req.cmd is not None:
                             apply_command(self.statemach._kv, req.cmd)
@@ -380,8 +440,7 @@ class ServerReplica:
                 ) if g in self._ep_exec else self.applied[g]
             else:
                 g, slot, vid, batch = rec
-                self.payloads._data[g][vid] = batch
-                self.payloads._next[g] = max(self.payloads._next[g], vid + 1)
+                self.payloads.install(g, vid, batch)
                 if batch is not None and slot >= self.applied[g]:
                     for client, req in batch:
                         if req.cmd is not None:
@@ -477,12 +536,27 @@ class ServerReplica:
         else:
             cand = keys
         new_pp_by_g: Dict[int, dict] = {}
+        new_cw_by_g: Dict[int, dict] = {}
         taken = []
         for k in cand.tolist():
             g, vid = k >> _VID_BITS, k & ((1 << _VID_BITS) - 1)
-            b = self.payloads.get(g, vid)
-            if b is not None:
-                new_pp_by_g.setdefault(g, {})[vid] = b
+            logged = False
+            if self.codewords is not None:
+                # codeword plane: a voter durably logs the shard subset
+                # its vote stands for (its assigned slice), not the full
+                # batch — the recovered quorum's shards rebuild committed
+                # values through gossip (reference durability.rs logs
+                # accepted shard data)
+                got = self.codewords.wal_shards(g, vid, self.me)
+                if got is not None:
+                    new_cw_by_g.setdefault(g, {})[vid] = got
+                    logged = True
+            if not logged:
+                b = self.payloads.get(g, vid)
+                if b is not None:
+                    new_pp_by_g.setdefault(g, {})[vid] = b
+                    logged = True
+            if logged:
                 self._logged_vids[g].add(vid)
                 taken.append(k)
         if taken:
@@ -499,6 +573,9 @@ class ServerReplica:
             rec: Dict[str, Any] = {k: int(v[g]) for k, v in scal.items()}
             rec.update({k: wins[k][g].tolist() for k in wins})
             rec["pp"] = new_pp
+            new_cw = new_cw_by_g.get(g, {})
+            if new_cw:
+                rec["cw"] = new_cw
             self.wal.do_sync_action(
                 LogAction("append", entry=("vote", g, rec), sync=False)
             )
@@ -552,17 +629,35 @@ class ServerReplica:
         vids_by_g = _unique_window_vids(val_win, np.arange(self.G))
         for g in range(self.G):
             pp = {}
+            cw = {}
             for vid in vids_by_g.get(g, ()):
+                got = (
+                    self.codewords.wal_shards(g, vid, self.me)
+                    if self.codewords is not None else None
+                )
+                if got is not None:
+                    cw[vid] = got
+                    continue
                 b = self.payloads.get(g, vid)
                 if b is not None:
                     pp[vid] = b
             rec: Dict[str, Any] = {k: int(v[g]) for k, v in scal.items()}
             rec.update({k: wins[k][g].tolist() for k in wins})
             rec["pp"] = pp
+            if cw:
+                rec["cw"] = cw
             compact.do_sync_action(
                 LogAction("append", entry=("vote", g, rec), sync=False)
             )
-            new_logged[g] = set(pp)
+            new_logged[g] = set(pp) | set(cw)
+        # the shard store keeps one full codeword per proposed vid at the
+        # proposer; the snapshot floor is the natural GC point (vids
+        # below every durable-window reference can never be re-served)
+        if self.codewords is not None:
+            for g in range(self.G):
+                vids = vids_by_g.get(g)
+                if vids:
+                    self.codewords.gc_below(g, min(vids))
         compact.do_sync_action(LogAction("truncate", offset=compact.size,
                                          sync=True))
         compact.stop()
@@ -703,6 +798,7 @@ class ServerReplica:
                 ).append((client, req))
         if self._epaxos:
             return self._intake_epaxos(by_group, n_prop, vbase, piggy)
+        cw_fallback = self._craft_fallback_groups() if by_group else None
         for g, reqs in by_group.items():
             if not self._is_leader[g]:
                 pending = []
@@ -751,11 +847,80 @@ class ServerReplica:
             self.origin.add((g, vid))
             n_prop[g] = 1
             vbase[g] = vid
-            piggy[(g, vid)] = reqs
+            if self.codewords is not None and not (
+                cw_fallback is not None and bool(cw_fallback[g])
+            ):
+                # codeword plane: peers get only their assigned shard
+                # subset; the full batch stays host-local at the proposer
+                self._distribute_shards(g, vid, reqs)
+            else:
+                piggy[(g, vid)] = reqs
             if self._adaptive is not None:
                 nb = float(len(pickle.dumps(reqs)))
                 self._batch_bytes = 0.9 * self._batch_bytes + 0.1 * nb
         return n_prop, vbase, piggy
+
+    # ---------------------------------------------- codeword payload plane
+    def _craft_fallback_groups(self) -> Optional[np.ndarray]:
+        """Host mirror of CRaft's per-append full-copy fallback rule
+        (``_append_mode``: more than fault_tolerance peers look dead ->
+        ship full batches so the majority-threshold commit stays
+        recoverable).  Reads the liveness countdowns as of the last tick,
+        so it can trail the kernel's stamp by one tick — the same
+        documented weakening window as the reference's global latch
+        (craft/mod.rs:280-283)."""
+        if not (self._craft_mode and self.codewords is not None):
+            return None
+        ac = np.asarray(self.state["alive_cnt"])[:, self.me]
+        return (ac <= 0).sum(axis=1) > self.kernel.config.fault_tolerance
+
+    def _spr_choice(self, g: int) -> int:
+        """Shards-per-replica width for this tick's sends: the SAME
+        per-group value the kernel receives as ``spr_override``, clipped
+        the way the kernel clips it, so the stamped ``win_spr`` matches
+        the bytes actually on the wire.  Static (init_spr / 1) when no
+        adaptive policy runs (RSPaxos/CRaft, or assignment_adaptive
+        off)."""
+        if self._adaptive is None:
+            return self._cw_spr0
+        d = self.kernel.data_shards
+        return int(min(max(int(self._spr_tick[g]), self._cw_dj), d))
+
+    def _distribute_shards(self, g: int, vid: int, batch: Any) -> None:
+        """Leader-side send plan: encode once (Pallas on TPU, XLA
+        bit-slice on CPU), then queue each peer's assigned row slice of
+        the codeword for this tick's frame (rspaxos/mod.rs:597-608;
+        Crossword: ``win_spr``-width diagonal slices)."""
+        spr = self._spr_choice(g)
+        dlen, cw = self.codewords.encode(g, vid, batch, spr)
+        T = self.codewords.T
+        for dst in range(self.population):
+            if dst == self.me:
+                continue
+            sids = assigned_sids(dst, spr, self._cw_dj, T)
+            sp = ShardPayload(dlen, {s: cw[s] for s in sids})
+            self._pending_shards.setdefault(dst, {})[(g, vid)] = sp
+            self.pp_bytes[dst] += _sp_size(sp)
+            self.pp_items[dst] += 1
+
+    def _resolve_payload(self, g: int, vid: int) -> Optional[Any]:
+        """Full batch for ``(g, vid)``: the payload store, else a
+        codeword reconstruction from >= d held shards (decoded once,
+        then installed)."""
+        b = self.payloads.get(g, vid)
+        if b is None and vid != 0 and self.codewords is not None:
+            b = self.codewords.reconstruct_batch(g, vid)
+            if b is not None:
+                self.payloads.install(g, vid, b, overwrite=False)
+                self.missing.discard((g, vid))
+                self._cw_first_missing.pop((g, vid), None)
+                if bool(self._is_leader[g]):
+                    # a leader that had to reconstruct (an adopted slot
+                    # from a crashed predecessor) redistributes fresh
+                    # slices under its current assignment so followers'
+                    # votes are backed by shard bytes again
+                    self._distribute_shards(g, vid, b)
+        return b
 
     # ------------------------------------------------- near-quorum reads
     def _tail_writes_key(self, g: int, key: str) -> bool:
@@ -1041,6 +1206,19 @@ class ServerReplica:
                 sw.record_now(self.tick, 0, t0)
 
             # 1. client intake -> payload ids (one ReqBatch per group/tick)
+            if self._adaptive is not None:
+                # fold delivery samples + pick this tick's assignment
+                # width BEFORE intake: the same choice slices the shard
+                # sends and rides the spr_override kernel input below
+                while self.transport.samples:
+                    try:
+                        p, nb, dly = self.transport.samples.popleft()
+                    except IndexError:
+                        break
+                    self._adaptive.observe(p, nb, dly)
+                self._spr_tick = self._adaptive.overrides(
+                    self.G, self._batch_bytes
+                )
             n_prop, vbase, piggy = self._intake()
             if sw is not None:
                 sw.record_now(self.tick, 1)
@@ -1052,10 +1230,62 @@ class ServerReplica:
             self._pending_serve = {}
             payload_msg: Dict[str, Any] = {
                 "pp": piggy,
-                "need": sorted(self.missing)[:64],
                 "kv_need": bool(self.kv_need),
                 "ts": time.monotonic(),  # adaptive delivery sampling
             }
+            cw_need_by_dst: Dict[int, list] = {}
+            # the full-payload "need" plane stays on in codeword mode:
+            # CRaft full-copy-fallback values are never encoded into any
+            # shard store, so only a full-batch serve can heal them.
+            # Responders skip vids they hold shards for (the gossip
+            # plane's job), so coded values never regress to full-copy
+            # serving through this path.
+            needs = sorted(self.missing)[:64]
+            payload_msg["need"] = needs
+            if self.codewords is not None:
+                # shard-gossip requests, TARGETED: ask the fewest peers
+                # whose base diagonal slices cover the deficit, leaders
+                # last — steady-state heal traffic flows follower-to-
+                # follower and the leader's egress is genuinely shed
+                # (Compartmentalization-style), not re-centralized.
+                # Entries unserved for ~40 ticks escalate to urgent:
+                # broadcast, and peers answer with ANY held shard.
+                cw_T, cw_dj = self.codewords.T, self._cw_dj
+                for g, vid in needs:
+                    first = self._cw_first_missing.setdefault(
+                        (g, vid), self.tick
+                    )
+                    have = self.codewords.have_mask(g, vid)
+                    if self.tick - first > 40:
+                        for dst in range(self.population):
+                            if dst != self.me:
+                                cw_need_by_dst.setdefault(dst, []).append(
+                                    (g, vid, have, True)
+                                )
+                        continue
+                    lead = int(self._leader_hint[g])
+                    order = sorted(
+                        (d for d in range(self.population)
+                         if d != self.me),
+                        key=lambda d: (d == lead, d),
+                    )
+                    cover = have
+                    for dst in order:
+                        add = [
+                            s for s in assigned_sids(
+                                dst, cw_dj, cw_dj, cw_T
+                            )
+                            if not (cover >> s) & 1
+                        ]
+                        if not add:
+                            continue
+                        cw_need_by_dst.setdefault(dst, []).append(
+                            (g, vid, have, False)
+                        )
+                        for s in add:
+                            cover |= 1 << s
+                        if bin(cover).count("1") >= self.codewords.d:
+                            break
             if self._pending_kv_serve:
                 payload_msg["kv"] = self.statemach.snapshot_items()
                 payload_msg["kv_floor"] = list(self.applied)
@@ -1070,6 +1300,10 @@ class ServerReplica:
             rqr = self._pending_rqr
             self._pending_rq = {}
             self._pending_rqr = {}
+            ps_pend = self._pending_shards
+            cw_pend = self._pending_cw
+            self._pending_shards = {}
+            self._pending_cw = {}
 
             def _frame(dst):
                 f = {"msg": frames[dst], **payload_msg}
@@ -1077,11 +1311,24 @@ class ServerReplica:
                     f["rq"] = rq[dst]
                 if dst in rqr:
                     f["rqr"] = rqr[dst]
+                if dst in ps_pend:
+                    f["ps"] = ps_pend[dst]
+                if dst in cw_pend:
+                    f["cw"] = cw_pend[dst]
+                if dst in cw_need_by_dst:
+                    f["cw_need"] = cw_need_by_dst[dst]
                 return f
 
-            self.transport.send_tick(
-                self.tick, {dst: _frame(dst) for dst in frames}
-            )
+            tick_frames = {dst: _frame(dst) for dst in frames}
+            # payload-plane egress accounting (the shard-economy meter:
+            # full-copy piggybacks are identical per peer; shard sends
+            # and gossip replies are sized once at enqueue time)
+            if piggy:
+                pp_len = len(pickle.dumps(piggy))
+                for dst in tick_frames:
+                    self.pp_bytes[dst] += pp_len
+                    self.pp_items[dst] += len(piggy)
+            self.transport.send_tick(self.tick, tick_frames)
             got = self.transport.recv_tick(self.tick, deadline)
             self._ingest_payloads(got)
             inbox = self._assemble_inbox(last_out, got)
@@ -1108,15 +1355,11 @@ class ServerReplica:
                 )
                 inputs["prop_vids"] = jnp.asarray(self._ep_prop_vids)
             if self._adaptive is not None:
-                while self.transport.samples:
-                    try:
-                        p, nb, dly = self.transport.samples.popleft()
-                    except IndexError:
-                        break
-                    self._adaptive.observe(p, nb, dly)
+                # the same choice that sliced this tick's shard sends
+                # (picked before intake) — kernel win_spr stamps stay in
+                # lockstep with the bytes on the wire
                 inputs["spr_override"] = jnp.asarray(
-                    self._adaptive.overrides(self.G, self._batch_bytes),
-                    jnp.int32,
+                    self._spr_tick, jnp.int32
                 )
             if sw is not None:
                 sw.record_now(self.tick, 2)  # frame exchange + inbox
@@ -1175,15 +1418,52 @@ class ServerReplica:
         for src, fl in got.items():
             for f in fl or ():
                 for (g, vid), batch in f.get("pp", {}).items():
-                    if self.payloads.get(g, vid) is None:
-                        self.payloads._data[g][vid] = batch
-                        self.payloads._next[g] = max(
-                            self.payloads._next[g], vid + 1
-                        )
+                    self.payloads.install(g, vid, batch, overwrite=False)
                     self.missing.discard((g, vid))
+                    self._cw_first_missing.pop((g, vid), None)
+                # codeword plane: proposer-assigned shard subsets ("ps")
+                # and gossip fills ("cw") land in the shard store; the
+                # exec path reconstructs lazily once >= d are held
+                if self.codewords is not None:
+                    # "ps" rows are this replica's ASSIGNMENT (vote-
+                    # loggable); "cw" gossip fills are not (wal_shards)
+                    for key in ("ps", "cw"):
+                        for (g, vid), sp in (f.get(key) or {}).items():
+                            self.codewords.add_shards(
+                                g, vid, sp.data_len, sp.shards,
+                                assigned=(key == "ps"),
+                            )
+                            self.payloads.note_seen(g, vid)
+                    # serve shard-gossip requests next tick from held
+                    # shards: non-urgent rounds answer only with our own
+                    # diagonal slice (load stays spread across peers —
+                    # the leader is not re-centralized), urgent rounds
+                    # with anything held the requester lacks
+                    own = assigned_sids(
+                        self.me, self._cw_dj, self._cw_dj,
+                        self.codewords.T,
+                    )
+                    for g, vid, have, urgent in f.get("cw_need", ())[:64]:
+                        held = self.codewords.shards_for(
+                            g, vid, exclude_mask=have,
+                            only_sids=None if urgent else own,
+                        )
+                        if held is not None:
+                            sp = ShardPayload(held[0], held[1])
+                            self._pending_cw.setdefault(src, {})[
+                                (g, vid)
+                            ] = sp
+                            self.cw_bytes[src] += _sp_size(sp)
                 # serve peers' missing payloads / kv requests next tick by
-                # folding them into our own piggyback
+                # folding them into our own piggyback (codeword mode:
+                # only values with no shard presence here — full-copy
+                # fallback batches — take this full-serve path)
                 for g, vid in f.get("need", []):
+                    if (
+                        self.codewords is not None
+                        and self.codewords.have_mask(g, vid)
+                    ):
+                        continue
                     b = self.payloads.get(g, vid)
                     if b is not None:
                         self._pending_serve[(g, vid)] = b
@@ -1357,7 +1637,7 @@ class ServerReplica:
                 return
             is_marker = bool(marker[pos[0]])
             vid = 0 if is_marker else int(win_val[pos[0]])
-            batch = self.payloads.get(g, vid)
+            batch = self._resolve_payload(g, vid)
             if vid != 0 and batch is None:
                 self.missing.add((g, vid))
                 return  # stall the exec floor until the payload arrives
@@ -1494,7 +1774,15 @@ class ServerReplica:
             "peers": self.transport.peers(),
             "was_leader": self.was_leader,
             "wal_size": self.wal.size,
+            "pp_bytes": list(self.pp_bytes),
+            "pp_items": list(self.pp_items),
+            "cw_bytes": list(self.cw_bytes),
+            "net_bytes": dict(self.transport.bytes_sent),
         }
+        if self.codewords is not None:
+            out["cw_vids"] = [
+                self.codewords.size(g) for g in range(self.G)
+            ]
         for k in (
             "leader", "commit_bar", "exec_bar", "vote_bar", "bal_max",
             "bal_prepared", "next_slot", "dur_bar",
@@ -1505,6 +1793,12 @@ class ServerReplica:
         return out
 
     def shutdown(self) -> None:
+        # idempotent: reachable from both the crash-restart loop and an
+        # external harness stop (StorageHub.stop guards the native WAL
+        # double-close; the rest tolerate repeats)
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
         self.external.stop()
         self.transport.close()
         self.statemach.stop()

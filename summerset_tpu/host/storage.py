@@ -7,6 +7,19 @@ entries in a flat file, with optional fsync (``LogAction`` storage.rs:25-45,
 native C++ backend (``native/wal.cpp``) driven by a worker thread; a pure-
 Python mirror keeps toolchain-less hosts working.  Entries are pickled
 Python objects, mirroring the reference's bincode-serialized ``Ent``.
+
+WAL record shapes (written by ``host/server.py``, replayed at recovery):
+
+- ``("vote", g, rec)`` — durable acceptor row for group ``g``: one int
+  per ``DURABLE_SCALARS`` field, one list per ``DURABLE_WINDOWS`` lane,
+  plus payloads for newly voted value ids — ``rec["pp"]`` maps vid ->
+  full ReqBatch (non-coded protocols and CRaft full-copy fallback), and
+  ``rec["cw"]`` maps vid -> ``(data_len, {shard id: [L] int32})`` shard
+  subsets (the codeword plane: each voter logs the slice its vote stands
+  for; a recovered quorum's shards rebuild committed values by gossip).
+- ``(g, slot, vid, batch)`` — exec-time apply record (KV replay source).
+- ``("eapply", g, row, col, vid, batch)`` — EPaxos exec record, replayed
+  in logged (= execution) order.
 """
 
 from __future__ import annotations
@@ -169,8 +182,12 @@ class _NativeWal:
         return self.lib.wal_discard(self.h, off, keep, int(sync)) == 0
 
     def close(self):
-        self.lib.wal_close(self.h)
-        self.h = None
+        # idempotent: a double close would hand the native layer a freed
+        # handle and SIGABRT the whole process (the shutdown path can be
+        # reached from both the replica loop and an external stop)
+        if self.h:
+            self.lib.wal_close(self.h)
+            self.h = None
 
 
 class StorageHub:
@@ -187,6 +204,8 @@ class StorageHub:
         self.native = lib is not None and prefer_native
         self._in: queue.Queue = queue.Queue()
         self._out: queue.Queue = queue.Queue()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
         self._thread = threading.Thread(target=self._logger, daemon=True)
         self._thread.start()
 
@@ -207,6 +226,14 @@ class StorageHub:
         return res
 
     def stop(self) -> None:
+        # idempotent + race-safe: the replica loop's own shutdown and an
+        # external harness stop can both reach here concurrently; a
+        # second backend.close() on the native WAL would abort the
+        # process (wal.cpp frees the handle)
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
         self._in.put(None)
         self._thread.join(timeout=5)
         self.backend.close()

@@ -94,6 +94,10 @@ class TransportHub:
         self.p2p_addr = p2p_addr
         self._conns: Dict[int, socket.socket] = {}
         self._wlocks: Dict[int, threading.Lock] = {}
+        # per-peer cumulative frame egress (bytes on the wire, framing
+        # included) — the coarse half of the payload-economy accounting;
+        # the server keeps the payload-plane-only counter (pp_bytes)
+        self.bytes_sent: Dict[int, int] = {}
         # (peer, frame bytes, delay ms) delivery samples; deque appends
         # are thread-safe, the replica loop drains them opportunistically
         from collections import deque
@@ -252,9 +256,13 @@ class TransportHub:
             sock = self._conns.get(peer)
             if sock is None:
                 continue
+            buf = safetcp.encode_frame((tick, payload))
             try:
                 with self._wlocks[peer]:
-                    safetcp.send_msg_sync(sock, (tick, payload))
+                    sock.sendall(buf)
+                self.bytes_sent[peer] = (
+                    self.bytes_sent.get(peer, 0) + len(buf)
+                )
             except OSError:
                 if self._conns.get(peer) is sock:
                     self._conns.pop(peer, None)

@@ -275,12 +275,18 @@ class RSCode:
 
 
 # ----------------------------------------------------------- byte utilities --
-def pack_bytes(buf: bytes, num_data: int) -> np.ndarray:
+def pack_bytes(buf: bytes, num_data: int, lane_multiple: int = 1
+               ) -> np.ndarray:
     """Split a byte string into d equal shards, packed [d, L] int32
     (zero-padded; shard byte length rounded up to a multiple of 4;
-    little-endian byte order within each lane)."""
+    little-endian byte order within each lane).  ``lane_multiple`` rounds
+    the lane count L up further (128 keeps the Pallas encode path's
+    lane-aligned tiling eligible on TPU backends)."""
     shard_len = -(-len(buf) // num_data)
     shard_len = -(-shard_len // 4) * 4
+    if lane_multiple > 1:
+        q = 4 * lane_multiple
+        shard_len = -(-shard_len // q) * q
     padded = np.zeros(num_data * shard_len, np.uint8)
     padded[: len(buf)] = np.frombuffer(buf, np.uint8)
     return (
@@ -295,3 +301,46 @@ def unpack_bytes(shards: np.ndarray, data_len: int) -> bytes:
     """Inverse of :func:`pack_bytes` given the original byte length."""
     u = np.ascontiguousarray(np.asarray(shards), dtype="<i4")
     return u.view(np.uint8).reshape(-1).tobytes()[:data_len]
+
+
+# -------------------------------------------------- serving entry points --
+# The host data plane (host/codeword.py) ships one serialized ReqBatch per
+# consensus value; these helpers are the serving-shape adapters between
+# byte strings and the codec's [shard, L] int32 lane layout.  On TPU
+# backends the batch dim + 128-lane alignment keep encode on the Pallas
+# kernel; on CPU the same call lowers to the XLA bit-slice path.
+
+def encode_payload(code: RSCode, buf: bytes) -> Tuple[int, np.ndarray]:
+    """Serialized payload -> ``(data_len, [d + p, L] int32 codeword)``.
+
+    The returned codeword rows are the full shard set: rows ``[0, d)``
+    are the (padded) data split, rows ``[d, d + p)`` the parity shards —
+    any ``d`` of them reconstruct the payload (``decode_payload``)."""
+    lane = 128 if code.use_pallas else 1
+    data = pack_bytes(buf, code.d, lane_multiple=lane)
+    if code.p == 0:
+        return len(buf), data
+    parity = np.asarray(code.compute_parity(jnp.asarray(data)[None])[0])
+    return len(buf), np.concatenate([data, parity], axis=0)
+
+
+def decode_rows(code: RSCode, shards: dict) -> np.ndarray:
+    """Any ``d`` held shards ``{shard id: [L] int32}`` -> the ``d`` data
+    shard rows ``[d, L]`` at the encoder's exact lane geometry.
+
+    Prefers data-shard identity rows (no GF work when rows ``[0, d)`` are
+    all held); otherwise inverts the availability submatrix through the
+    codec's cached decode tables (``RSCode.reconstruct_data``)."""
+    d = code.d
+    if len(shards) < d:
+        raise ValueError(f"need {d} shards, have {len(shards)}")
+    if all(i in shards for i in range(d)):
+        return np.stack([np.asarray(shards[i]) for i in range(d)])
+    present = tuple(sorted(shards))[:d]
+    stacked = np.stack([np.asarray(shards[i]) for i in present])
+    return np.asarray(code.reconstruct_data(jnp.asarray(stacked), present))
+
+
+def decode_payload(code: RSCode, shards: dict, data_len: int) -> bytes:
+    """Any ``d`` held shards ``{shard id: [L] int32}`` -> payload bytes."""
+    return unpack_bytes(decode_rows(code, shards), data_len)

@@ -112,6 +112,12 @@ class ReplicaConfigMultiPaxos:
     # reads while a quorum holds
     leader_leases: bool = False
     leader_lease_len: int = 12
+    # must exceed the max one-way network delay (in ticks): the grantor's
+    # promise outliving the holder's belief by more than a delivery delay
+    # is the whole clock-free safety argument.  Engine construction
+    # enforces lease_margin > NetConfig.max_delay_ticks whenever a lease
+    # plane is active (core/engine.py); host deployments over real TCP
+    # must budget it against tick_interval x observed one-way latency.
     lease_margin: int = 3
 
 

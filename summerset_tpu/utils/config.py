@@ -13,7 +13,10 @@ applies the same semantics via ``tomllib``.
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
 from typing import Type, TypeVar
 
 from .errors import SummersetError

@@ -1,0 +1,42 @@
+"""jax version compatibility shims.
+
+The repo targets the ``jax_num_cpu_devices`` config knob (jax >= 0.5) to
+build the 8-device virtual CPU mesh the driver contract specifies; older
+jax spells the same thing as an XLA flag that must be in the environment
+before the CPU backend initializes.  Callers here all run before any
+backend-initializing jax call, so the env-var fallback still takes effect.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def set_cpu_devices(n: int) -> None:
+    """Request ``n`` virtual CPU devices, portably across jax versions.
+
+    Must be called before the first backend-initializing jax operation.
+    If the backend is already up this is a no-op — callers that care
+    assert on ``len(jax.devices())`` afterwards.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # jax < 0.5: env-var spelling
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={n}"
+        if "xla_force_host_platform_device_count" in flags:
+            # replace a pre-existing (possibly different) count rather
+            # than silently keeping it — mesh tests would otherwise fail
+            # with opaque sharding errors under a stale preset
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+    except RuntimeError:
+        pass  # backend already initialized; caller asserts device count

@@ -59,15 +59,35 @@ def recv_msg_sync(sock) -> Any:
 
 def recv_msg_sync_len(sock) -> Tuple[Any, int]:
     """Like :func:`recv_msg_sync` but also returns the frame body length
-    (consumed by the Crossword adaptive perf model's delivery samples)."""
+    (consumed by the Crossword adaptive perf model's delivery samples).
+
+    Timeout semantics on timeout-armed sockets: ``socket.timeout``
+    propagates ONLY when zero bytes of the frame were consumed — the
+    stream is still frame-aligned and the caller may safely retry the
+    recv in place.  A timeout after partial consumption raises
+    :class:`SummersetError` instead: the next read would start mid-frame
+    and unpickle garbage, so the caller must treat the connection as dead
+    and reconnect (the ``DriverReply('disconnect')`` path in
+    client/drivers.py)."""
+    consumed = 0
 
     def read_exact(n: int) -> bytes:
+        nonlocal consumed
         buf = b""
         while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
+            try:
+                chunk = sock.recv(n - len(buf))
+            except TimeoutError:
+                if consumed or buf:
+                    raise SummersetError(
+                        f"recv timed out mid-frame ({consumed + len(buf)} "
+                        "bytes consumed): stream no longer frame-aligned"
+                    ) from None
+                raise
             if not chunk:
                 raise SummersetError("connection closed mid-frame")
             buf += chunk
+        consumed += len(buf)
         return buf
 
     (length,) = _LEN.unpack(read_exact(_LEN.size))

@@ -27,14 +27,16 @@ import sys
 
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
 import jax
 
+from summerset_tpu.utils.jaxcompat import set_cpu_devices
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+set_cpu_devices(8)  # jax>=0.5 config knob, or the XLA env flag before that
 jax.config.update(
     "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache")
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-sys.path.insert(0, _REPO)

@@ -280,6 +280,12 @@ class TestClusterTesterSuite:
                 time.sleep(1.5)
                 ctl.ctrl.request(CtrlRequest(
                     "resume_servers", servers=[victim]), timeout=30)
+            # slow boxes: ops trickle under jit pauses + full-suite load;
+            # keep the healthy tail running until the history is big
+            # enough to be worth checking (bounded)
+            deadline = time.monotonic() + 30
+            while len(ops) <= 20 and time.monotonic() < deadline:
+                time.sleep(0.5)
         finally:
             stop.set()
             for t in threads:
@@ -454,6 +460,13 @@ class TestClusterNearQuorumReads:
                                       False))
                 drv._failover(rep)
             time.sleep(0.25)
+        # slow boxes: let the readers accumulate a checkable history
+        deadline = time.monotonic() + 20
+        while (
+            sum(1 for o in ops if o.kind == "get") <= 8
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.5)
         time.sleep(0.8)
         stop.set()
         for t in threads:
@@ -843,6 +856,12 @@ class TestClusterQuorumLeases:
                 ops.append(record_put(0, "lr_key", val, t0, None, False))
                 drv._failover(rep)
             time.sleep(0.4)  # leases need quiescence to serve locally
+        deadline = time.monotonic() + 20
+        while (
+            sum(1 for o in ops if o.kind == "get") <= 5
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.5)
         time.sleep(1.0)
         stop.set()
         for t in threads:
@@ -1021,6 +1040,12 @@ class TestClusterLeaderLease:
                 ops.append(record_put(0, "ll_hist", val, t0, None, False))
                 drv._failover(rep)
             time.sleep(0.25)
+        deadline = time.monotonic() + 20
+        while (
+            sum(1 for o in ops if o.kind == "get") <= 8
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.5)
         time.sleep(0.8)
         stop.set()
         for t in threads:

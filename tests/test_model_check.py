@@ -7,7 +7,7 @@ reached node (reference analog: ``tla+/tlc_model_check.sh`` runs TLC
 over MultiPaxos/Crossword/Bodega specs at tiny constants).
 
 The default tier runs depth 3 (~400 expansions per kernel); the slow
-tier runs depth 6 for MultiPaxos/Raft and depth 5 for RSPaxos.
+tier runs depth 6 for MultiPaxos/Raft/RSPaxos.
 Committed run logs live in MODELCHECK.json; regenerate them with
 ``python models/explore.py --out MODELCHECK.json`` (the --protocols
 default carries the per-protocol depths and config presets).
@@ -42,12 +42,14 @@ def test_exhaustive_depth6(protocol):
 
 
 @pytest.mark.slow
-def test_exhaustive_rspaxos_depth5():
+def test_exhaustive_rspaxos_depth6():
     """RSPaxos under exhaustion — the kernel whose lagging-exec step-up
     bug the randomized sweep caught.  fault_tolerance=1 (not the
     degenerate default 0) so the commit tally really requires
-    quorum + ft acks and the R - ft prepare shortcut is live."""
-    r = explore("rspaxos", depth=5,
+    quorum + ft acks and the R - ft prepare shortcut is live.  Depth 6
+    reaches one more full election + window-wrap round than the depth-5
+    run that shipped with round 5."""
+    r = explore("rspaxos", depth=6,
                 config_overrides={"fault_tolerance": 1})
     assert not r.violations, r.violations
     assert r.max_committed_slots > 0

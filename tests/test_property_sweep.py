@@ -51,6 +51,12 @@ EPAXOS_K = 2  # few buckets -> heavy cross-row interference
 
 CONFIGS = {
     "multipaxos": {},
+    # the stable-leader lease plane under the same randomized drops /
+    # partitions / jitter as the QL/Bodega lease planes: the lease veto
+    # must never let two eras serve concurrently (lease_margin raised
+    # above the sweep's max_delay_ticks=3 — the Engine refuses the
+    # default margin 3 at this geometry, by design)
+    "multipaxos_ll": {"leader_leases": True, "lease_margin": 4},
     "raft": {},
     "rspaxos": {"fault_tolerance": 0},
     "craft": {"fault_tolerance": 0},
@@ -64,11 +70,12 @@ CONFIGS = {
 def _kernel(name):
     import dataclasses
 
-    base = make_protocol(name, G, R, W)
+    proto = name.partition("_")[0]  # config-variant rows: "<proto>_<tag>"
+    base = make_protocol(proto, G, R, W)
     cfg = dataclasses.replace(
         base.config, max_proposals_per_tick=P, **CONFIGS[name]
     )
-    return make_protocol(name, G, R, W, cfg)
+    return make_protocol(proto, G, R, W, cfg)
 
 
 def _merge_committed(st, acc):

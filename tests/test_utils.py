@@ -304,3 +304,38 @@ class TestSafeTcp:
         got, reply = asyncio.run(run())
         assert got == [("put", "k", "v" * 1000)]
         assert reply == {"reply": ("put", "k", "v" * 1000)}
+
+    def test_sync_timeout_at_frame_boundary_is_retryable(self):
+        import socket as _socket
+
+        from summerset_tpu.utils.safetcp import recv_msg_sync
+
+        a, b = _socket.socketpair()
+        try:
+            a.settimeout(0.05)
+            # nothing sent: zero bytes consumed -> socket.timeout (the
+            # retry-in-place TIMEOUT kind in client/drivers.py)
+            with pytest.raises(_socket.timeout):
+                recv_msg_sync(a)
+        finally:
+            a.close()
+            b.close()
+
+    def test_sync_timeout_mid_frame_is_fatal(self):
+        import socket as _socket
+
+        from summerset_tpu.utils.errors import SummersetError
+        from summerset_tpu.utils.safetcp import encode_frame, recv_msg_sync
+
+        a, b = _socket.socketpair()
+        try:
+            a.settimeout(0.1)
+            frame = encode_frame({"k": "v" * 100})
+            b.sendall(frame[: len(frame) - 7])  # truncated mid-body
+            # partial bytes consumed -> the stream is no longer
+            # frame-aligned; must NOT surface as a retryable timeout
+            with pytest.raises(SummersetError):
+                recv_msg_sync(a)
+        finally:
+            a.close()
+            b.close()
