@@ -12,6 +12,12 @@
 # Tier 2b: the codeword payload plane — live RSPaxos/CRaft/Crossword
 #         clusters asserting shard-sized peer payload frames (~1/d vs
 #         MultiPaxos full-copy) and leader-crash shard reconstruction.
+# Tier 2c: the nemesis soak matrix — seeded fault schedules (crash +
+#         partition + message + disk faults) against live MultiPaxos /
+#         Raft / RSPaxos clusters, 3 seeds each; asserts linearizable
+#         histories + bounded recovery, and dumps the fault timeline +
+#         operation history on failure (re-run any seed for a
+#         byte-identical schedule: scripts/nemesis_soak.py --seed N).
 # Tier 3 (--full): every slow-marked fault-scenario kernel test and the
 #         randomized property sweep.
 set -e
@@ -27,6 +33,9 @@ echo "=== tier 2b: codeword payload plane (RS shard serving) ==="
 # the slow-marked cluster tier only — tier 1 already ran this file's
 # fast (codec/store) half
 python -m pytest tests/test_codeword_plane.py -q -m slow
+
+echo "=== tier 2c: nemesis soak matrix (3 seeds x 3 protocols) ==="
+python scripts/nemesis_soak.py --matrix
 
 if [ "$1" = "--full" ]; then
   echo "=== tier 3: full superset (slow tests included) ==="

@@ -41,19 +41,47 @@ def main(argv=None) -> None:
         if args.config
         else {}
     )
+    boot_fails = 0
     while True:
-        replica = ServerReplica(
-            args.protocol,
-            (args.bind_ip, args.api_port),
-            (args.bind_ip, args.p2p_port),
-            (mhost, int(mport)),
-            config=cfg,
-            num_groups=args.num_groups,
-            window=args.window,
-            tick_interval=args.tick_interval,
-            backer_dir=args.backer_dir,
-        )
-        restart = replica.run()
+        try:
+            replica = ServerReplica(
+                args.protocol,
+                (args.bind_ip, args.api_port),
+                (args.bind_ip, args.p2p_port),
+                (mhost, int(mport)),
+                config=cfg,
+                num_groups=args.num_groups,
+                window=args.window,
+                tick_interval=args.tick_interval,
+                backer_dir=args.backer_dir,
+            )
+        except Exception as e:
+            # transient bring-up failure (a peer mid-crash-restart, a
+            # port still draining): retry a few times before giving up —
+            # persistent errors (bad config) still surface
+            boot_fails += 1
+            if boot_fails > 5:
+                raise
+            pf_info(logger, f"bring-up failed: {e!r}; retrying "
+                            f"({boot_fails}/5)")
+            import time
+
+            time.sleep(1.0)
+            continue
+        boot_fails = 0
+        try:
+            restart = replica.run()
+        except Exception as e:
+            # a crash (e.g. the durability gate refusing to ack past a
+            # failed group-commit fsync) restarts like a supervised
+            # process: recovery replays whatever reached the disk.  The
+            # sleep keeps a persistently-crashing replica from
+            # hot-looping through construct/crash cycles.
+            pf_info(logger, f"replica crashed: {e!r}")
+            restart = True
+            import time
+
+            time.sleep(0.5)
         replica.shutdown()
         if not restart:
             break

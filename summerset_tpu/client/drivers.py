@@ -10,12 +10,40 @@ drivers/mod.rs:12-40); ``DriverOpenLoop`` pipelines issues and acks
 from __future__ import annotations
 
 import dataclasses
+import random
 import socket
 import time
 from typing import Dict, Optional
 
 from ..host.statemach import Command, CommandResult
 from .endpoint import GenericEndpoint
+
+
+class Backoff:
+    """Jittered exponential backoff for retry loops.
+
+    The old fixed ``sleep(0.1)`` hot-retry turned every fault window into
+    a synchronized thundering herd against whichever server the clients
+    rotated to — under nemesis schedules the herd itself delayed
+    recovery.  Full jitter (AWS-style: sleep uniform in (0, cur]) breaks
+    the synchronization; the seed keeps a client's delay *sequence*
+    reproducible run to run."""
+
+    def __init__(self, base: float = 0.05, cap: float = 1.0,
+                 seed: int = 0):
+        self.base = base
+        self.cap = cap
+        self._cur = base
+        self._rng = random.Random(seed)
+
+    def reset(self) -> None:
+        self._cur = self.base
+
+    def sleep(self) -> float:
+        d = self._rng.uniform(0.0, self._cur)
+        self._cur = min(self._cur * 2.0, self.cap)
+        time.sleep(d)
+        return d
 
 
 @dataclasses.dataclass
@@ -35,6 +63,7 @@ class DriverClosedLoop:
         self.ep = endpoint
         self.timeout = timeout
         self.next_req = 0
+        self.backoff = Backoff(seed=endpoint.id)
 
     def _issue(self, cmd: Command) -> DriverReply:
         rid = self.next_req
@@ -67,16 +96,24 @@ class DriverClosedLoop:
                 continue  # stale reply from a previous timeout
             if rep.kind == "redirect":
                 hint = rep.redirect
+                self.ep.note_leader(hint)
+                # the reconnect is bounded by THIS request's remaining
+                # budget: a black-holed hinted server must not stretch
+                # the call past self.timeout (the connect used to ride a
+                # fixed 15s socket timeout, overshooting the deadline)
+                budget = deadline - time.monotonic()
                 try:
-                    if (
+                    if budget <= 0:
+                        pass  # out of budget: the caller's retry rotates
+                    elif (
                         hint is not None and hint >= 0
                         and hint != self.ep.current
                     ):
-                        self.ep.reconnect(hint)
+                        self.ep.reconnect(hint, timeout=budget)
                     else:
                         # no hint, or the server pointed at itself
                         # (leadership unsettled): walk the membership
-                        self.ep.rotate()
+                        self.ep.rotate(deadline=deadline)
                 except Exception:
                     pass  # hinted server down: the next retry rotates
                 return DriverReply("redirect", redirect=rep.redirect)
@@ -108,7 +145,7 @@ class DriverClosedLoop:
                 self.ep.send_conf(rid, conf_delta)
             except Exception:
                 self._failover(DriverReply("disconnect"))
-                time.sleep(0.1)
+                self.backoff.sleep()
                 continue
             deadline = t0 + max(self.timeout, 15.0)  # conf rides the log
             rep = None
@@ -129,14 +166,18 @@ class DriverClosedLoop:
                     continue
                 if raw.kind == "redirect":
                     hint = raw.redirect
+                    self.ep.note_leader(hint)
+                    budget = deadline - time.monotonic()
                     try:
-                        if (
+                        if budget <= 0:
+                            pass
+                        elif (
                             hint is not None and hint >= 0
                             and hint != self.ep.current
                         ):
-                            self.ep.reconnect(hint)
+                            self.ep.reconnect(hint, timeout=budget)
                         else:
-                            self.ep.rotate()
+                            self.ep.rotate(deadline=deadline)
                     except Exception:
                         pass
                     rep = DriverReply("redirect", redirect=hint)
@@ -148,31 +189,38 @@ class DriverClosedLoop:
                 )
                 break
             if rep.kind == "success":
+                self.backoff.reset()
                 return rep
             self._failover(rep)
-            time.sleep(0.1)
+            self.backoff.sleep()
         raise AssertionError("conf_change failed after retries")
 
     def _failover(self, rep: DriverReply) -> None:
         """Stop retrying against a dead/paused server: a timeout or a
         connection failure rotates the endpoint to a different server
         (parity: tester.rs:429-433 leave+reconnect around faults; the
-        redirect case already reconnected inside ``_issue``)."""
+        redirect case already reconnected inside ``_issue``).  The walk
+        is bounded by one request budget so a stack of black-holed
+        candidates cannot stall the caller's retry loop."""
         if rep.kind in ("timeout", "failure", "disconnect"):
             try:
-                self.ep.rotate()
+                self.ep.rotate(
+                    deadline=time.monotonic() + self.timeout
+                )
             except Exception:
                 pass
 
     def checked_put(self, key: str, value: str, retries: int = 20):
         """Retry through redirects/timeouts until acked (tester helper,
-        parity: tester.rs checked_put)."""
+        parity: tester.rs checked_put).  Retries back off with jitter
+        (see Backoff) instead of hot-spinning on a faulted cluster."""
         for _ in range(retries):
             rep = self.put(key, value)
             if rep.kind == "success":
+                self.backoff.reset()
                 return rep
             self._failover(rep)
-            time.sleep(0.1)
+            self.backoff.sleep()
         raise AssertionError(f"checked_put({key}) failed after retries")
 
     def checked_get(self, key: str, expect: Optional[str],
@@ -182,9 +230,10 @@ class DriverClosedLoop:
             if rep.kind == "success":
                 got = rep.result.value if rep.result else None
                 assert got == expect, f"get({key}) = {got} != {expect}"
+                self.backoff.reset()
                 return rep
             self._failover(rep)
-            time.sleep(0.1)
+            self.backoff.sleep()
         raise AssertionError(f"checked_get({key}) failed after retries")
 
 

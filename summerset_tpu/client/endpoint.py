@@ -42,8 +42,11 @@ class ClientCtrlStub:
 
 
 class ClientApiStub:
-    def __init__(self, client_id: int, api_addr: Tuple[str, int]):
-        self.sock = socket.create_connection(tuple(api_addr), timeout=15)
+    def __init__(self, client_id: int, api_addr: Tuple[str, int],
+                 connect_timeout: float = 15.0):
+        self.sock = socket.create_connection(
+            tuple(api_addr), timeout=max(connect_timeout, 0.05)
+        )
         self.sock.settimeout(None)
         safetcp.send_msg_sync(self.sock, client_id)
 
@@ -72,12 +75,29 @@ class GenericEndpoint:
         self.api: Optional[ClientApiStub] = None
         self.servers = {}
         self.current: Optional[int] = None
+        # leader-redirect cache: the freshest leader hint this client has
+        # observed (from redirect replies via ``note_leader`` or manager
+        # query_info).  Under fault schedules the manager's view can lag
+        # a whole election behind the servers', so the data-plane hint
+        # takes precedence when picking a failover target.
+        self.leader_cache: Optional[int] = None
 
-    def connect(self) -> None:
+    def note_leader(self, sid: Optional[int]) -> None:
+        """Record a data-plane leader hint (drivers call this on every
+        redirect reply carrying one)."""
+        if sid is not None and sid >= 0:
+            self.leader_cache = sid
+
+    def connect(self, timeout: Optional[float] = None) -> None:
+        """``timeout`` bounds the server CONNECT only; the manager query
+        keeps the ctrl stub's own budget (shrinking it risks stranding a
+        stale reply in the ctrl stream — see ``rotate``)."""
         info = self.ctrl.request(CtrlRequest("query_info"))
         if not info.servers:
             raise SummersetError("no servers joined yet")
         self.servers = info.servers
+        if info.leader is not None:
+            self.leader_cache = info.leader
         target = self.prefer
         if target is None or target not in info.servers:
             target = (
@@ -85,47 +105,84 @@ class GenericEndpoint:
                 if info.leader is not None and info.leader in info.servers
                 else sorted(info.servers)[0]
             )
-        self._connect_to(target)
+        self._connect_to(target, timeout=timeout)
 
-    def _connect_to(self, sid: int) -> None:
+    def _connect_to(self, sid: int,
+                    timeout: Optional[float] = None) -> None:
         if self.api is not None:
             self.api.close()
             self.api = None
         api_addr, _ = self.servers[sid]
-        self.api = ClientApiStub(self.id, api_addr)
+        self.api = ClientApiStub(
+            self.id, api_addr,
+            connect_timeout=15.0 if timeout is None else timeout,
+        )
         self.current = sid
 
-    def reconnect(self, sid: Optional[int] = None) -> None:
+    def reconnect(self, sid: Optional[int] = None,
+                  timeout: Optional[float] = None) -> None:
         if sid is not None and sid in self.servers:
-            self._connect_to(sid)
+            self._connect_to(sid, timeout=timeout)
         else:
-            self.connect()
+            # unknown/stale sid: fall back to a fresh manager-guided
+            # connect, still honoring the caller's connect budget (a
+            # hinted-but-departed server must not stall the request past
+            # its deadline)
+            self.connect(timeout=timeout)
 
-    def rotate(self, avoid: Optional[int] = None) -> None:
+    def rotate(self, avoid: Optional[int] = None,
+               deadline: Optional[float] = None) -> None:
         """Fail over to a different server after a timeout.
 
         Parity: the reference tester leaves + reconnects around faults
         (tester.rs:429-433) and the endpoint re-queries the manager
-        (endpoint.rs:17-54).  Prefers the manager's current leader unless
+        (endpoint.rs:17-54).  Prefers the freshest leader hint — the
+        data-plane redirect cache first, then the manager's view — unless
         that is the server being avoided (e.g. it just got paused and the
         manager has not seen the new leader yet), else round-robins to the
-        next id so repeated timeouts walk the whole membership."""
+        next id so repeated timeouts walk the whole membership.
+
+        ``deadline`` (monotonic seconds) bounds the whole walk: each
+        connect attempt gets at most the remaining budget, so a caller's
+        timeout is honored even when several candidates are black holes.
+        """
+        import time
+
+        def budget() -> Optional[float]:
+            if deadline is None:
+                return None
+            return deadline - time.monotonic()
+
         leader = None
-        try:
-            info = self.ctrl.request(CtrlRequest("query_info"), timeout=5)
-            if info.servers:
-                self.servers = info.servers
-            leader = info.leader
-        except Exception:
-            pass
+        b = budget()
+        # the ctrl query keeps its FIXED 5s timeout: shrinking it below
+        # what the manager needs under load would strand a stale reply
+        # in the ctrl stream (consumed by the NEXT request — a desync
+        # worse than a late rotate).  When the caller's budget is nearly
+        # gone, skip the query and walk the cached membership instead —
+        # the deadline bounding belongs on the connect attempts below.
+        if b is None or b >= 1.0:
+            try:
+                info = self.ctrl.request(
+                    CtrlRequest("query_info"), timeout=5
+                )
+                if info.servers:
+                    self.servers = info.servers
+                leader = info.leader
+            except Exception:
+                pass
         if not self.servers:
             return
         if avoid is None:
             avoid = self.current
         cands = sorted(self.servers)
         order = []
-        if leader is not None and leader in self.servers and leader != avoid:
-            order.append(leader)
+        for hint in (self.leader_cache, leader):
+            if (
+                hint is not None and hint in self.servers
+                and hint != avoid and hint not in order
+            ):
+                order.append(hint)
         start = cands.index(avoid) if avoid in cands else -1
         for off in range(1, len(cands) + 1):
             cand = cands[(start + off) % len(cands)]
@@ -134,8 +191,11 @@ class GenericEndpoint:
         if avoid in cands:
             order.append(avoid)  # last resort: everything else unreachable
         for cand in order:
+            b = budget()
+            if b is not None and b <= 0:
+                return
             try:
-                self._connect_to(cand)
+                self._connect_to(cand, timeout=b)
                 return
             except OSError:
                 continue

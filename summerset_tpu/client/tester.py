@@ -11,10 +11,13 @@ tester.rs:242-316), i.e. real process control, not mocks.
 
 from __future__ import annotations
 
+import random
+import threading
 import time
 from typing import List, Optional, Tuple
 
 from ..host.messages import CtrlRequest
+from ..utils.linearize import record_get, record_put
 from ..utils.logging import pf_info, pf_logger
 from .drivers import DriverClosedLoop
 from .endpoint import GenericEndpoint
@@ -32,6 +35,86 @@ ALL_TESTS = [
     "leader_node_pause",
     "node_pause_resume",
 ]
+
+
+# ------------------------------------------------------- nemesis soak plane
+def recorded_closed_loop(
+    manager_addr: Tuple[str, int],
+    ci: int,
+    keys: List[str],
+    stop: threading.Event,
+    ops: list,
+    seed: int = 0,
+    timeout: float = 3.0,
+) -> None:
+    """One closed-loop client recording a timed operation history in
+    ``utils/linearize`` Op form while faults play (the nemesis soak's
+    workload; parity role: the reference tester's checked ops, plus the
+    Jepsen-style history recording the TLA+ specs only model).
+
+    Semantics of the record: successes carry [t_inv, t_resp]; a put that
+    timed out / disconnected is recorded UNACKED (it may or may not have
+    executed — the checker is free to place or drop it); a redirect is
+    no op at all (the server refused without proposing).  Gets that fail
+    observe nothing and are not recorded.
+    """
+    rng = random.Random(seed * 1009 + ci)
+    try:
+        ep = GenericEndpoint(manager_addr)
+        ep.connect()
+    except Exception:
+        return  # cluster unreachable at spawn: nothing observed
+    drv = DriverClosedLoop(ep, timeout=timeout)
+    seq = 0
+    while not stop.is_set():
+        key = keys[seq % len(keys)]
+        t0 = time.monotonic()
+        if rng.random() < 0.5:
+            val = f"c{ci}-{seq}"
+            rep = drv.put(key, val)
+            t1 = time.monotonic()
+            if rep.kind == "success":
+                ops.append(record_put(ci, key, val, t0, t1, True))
+            elif rep.kind in ("timeout", "failure", "disconnect"):
+                ops.append(record_put(ci, key, val, t0, None, False))
+                drv._failover(rep)
+        else:
+            rep = drv.get(key)
+            t1 = time.monotonic()
+            if rep.kind == "success":
+                val = rep.result.value if rep.result else None
+                ops.append(record_get(ci, key, val, t0, t1))
+            elif rep.kind in ("timeout", "failure", "disconnect"):
+                drv._failover(rep)
+        seq += 1
+    try:
+        ep.leave()
+    except Exception:
+        pass
+
+
+def start_recorded_clients(
+    manager_addr: Tuple[str, int],
+    num_clients: int,
+    keys: List[str],
+    stop: threading.Event,
+    ops: list,
+    seed: int = 0,
+    timeout: float = 3.0,
+) -> List[threading.Thread]:
+    """Spawn ``num_clients`` recorder threads (list.append is atomic, so
+    they share one ``ops`` list).  Join them after setting ``stop``."""
+    threads = [
+        threading.Thread(
+            target=recorded_closed_loop,
+            args=(manager_addr, ci, keys, stop, ops, seed, timeout),
+            daemon=True,
+        )
+        for ci in range(num_clients)
+    ]
+    for t in threads:
+        t.start()
+    return threads
 
 
 class ClientTester:

@@ -39,6 +39,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops import prng
 
@@ -81,6 +82,11 @@ class ControlInputs:
     ``alive``:   [G, R] bool — False freezes a replica (pause): it sends
                  nothing, receives nothing, and its state does not advance.
     ``link_up``: [G, R, R] bool — False drops messages src->dst (partition).
+
+    The partition constructors below build the standard nemesis shapes so
+    tests and the fault-schedule compiler (host/nemesis.py) never
+    hand-assemble ``[G, R, R]`` index masks.  All return ``[G, R, R]``
+    bool arrays (self-links stay up) and compose with ``&``.
     """
 
     alive: Any = None
@@ -92,6 +98,45 @@ class ControlInputs:
             alive=jnp.ones((G, R), jnp.bool_),
             link_up=jnp.ones((G, R, R), jnp.bool_),
         )
+
+    @staticmethod
+    def links_all_up(G: int, R: int):
+        """[G, R, R] mask with every link up."""
+        return jnp.ones((G, R, R), jnp.bool_)
+
+    @staticmethod
+    def split_links(G: int, R: int, side):
+        """Symmetric partition: every link between ``side`` and its
+        complement is down in BOTH directions; links within each side
+        stay up (the classic majority/minority split)."""
+        a = np.zeros(R, bool)
+        a[list(side)] = True
+        link = np.ones((G, R, R), bool)
+        cross = a[:, None] ^ a[None, :]          # [R, R] across the cut
+        link &= ~cross[None, :, :]
+        return jnp.asarray(link)
+
+    @staticmethod
+    def isolate_links(G: int, R: int, *victims):
+        """Isolate each victim from every other replica (both
+        directions); victims keep only their self-link.  With one victim
+        this is the 'isolate-one' nemesis; with several, each victim is
+        alone (victims cannot talk to each other either)."""
+        v = np.zeros(R, bool)
+        v[list(victims)] = True
+        link = np.ones((G, R, R), bool)
+        touched = v[:, None] | v[None, :]        # any link touching a victim
+        link &= ~touched[None, :, :]
+        link |= np.eye(R, dtype=bool)[None, :, :]
+        return jnp.asarray(link)
+
+    @staticmethod
+    def one_way_down(G: int, R: int, src: int, dst: int):
+        """Asymmetric link fault: messages ``src -> dst`` are dropped;
+        the reverse direction still delivers."""
+        link = np.ones((G, R, R), bool)
+        link[:, src, dst] = False
+        return jnp.asarray(link)
 
 
 class NetModel:

@@ -82,9 +82,12 @@ class CtrlRequest:
     """Client -> manager (parity: ``CtrlRequest``, reactor.rs:29-64)."""
 
     kind: str  # query_info | query_conf | reset_servers | pause_servers
-    #            | resume_servers | take_snapshot | leave
+    #            | resume_servers | take_snapshot | inject_faults | leave
     servers: Optional[List[int]] = None  # None = all
     durable: bool = True                 # reset: keep durable files?
+    payload: Optional[Dict[str, Any]] = None  # inject_faults: fault spec
+    #   {"net": FrameFaults spec | None, "wal": wal spec | None, "seed": n}
+    #   relayed verbatim to each target server as a ``fault_ctl`` CtrlMsg
 
 
 @dataclasses.dataclass(frozen=True)

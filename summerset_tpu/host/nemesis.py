@@ -1,0 +1,389 @@
+"""Deterministic nemesis engine: seeded fault schedules across planes.
+
+One ``FaultPlan`` — a seed-generated list of timed fault events — compiles
+into coordinated actions on all three seams of the stack:
+
+1. **Device plane** (``core/netmodel.ControlInputs``): ``compile_device``
+   lowers the plan to per-tick ``alive``/``link_up`` mask sequences for
+   ``Engine.run_ticks`` — the whole schedule executes inside one
+   ``lax.scan`` with zero host involvement, bit-identical per seed.
+2. **Host message plane** (``host/transport.py`` + the
+   ``utils/safetcp.FrameFaults`` shim): partitions, asymmetric link
+   faults, iid drop, duplication, and added delay on a live cluster's
+   p2p mesh, installed through the manager control plane
+   (``CtrlRequest("inject_faults")`` → per-server ``fault_ctl``).
+3. **Disk plane** (``host/storage.StorageHub.set_faults``): torn tail
+   records and failing fsyncs; the replica's durability gate turns these
+   into crashes, and its supervisor restart exercises WAL torn-tail
+   truncation plus manager id reclamation.
+
+Crash/restart and pause/resume ride the existing manager orchestration
+(``reset_servers`` / ``pause_servers``), i.e. real process control.
+
+Determinism contract: ``FaultPlan.generate(seed, ...)`` draws only from
+``random.Random(seed)``, so the same seed always yields a byte-identical
+``timeline()`` (and identical compiled device masks) — every robustness
+bug found under a schedule is a one-line repro (``--seed N``).  On a live
+cluster the *schedule* is deterministic while OS-level interleaving stays
+real, the same split a seeded Jepsen nemesis gives you.
+
+Related work: compartmentalized SMR (arxiv 2012.15762) concentrates bugs
+at plane seams; this engine stresses our three seams under one clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..host.messages import CtrlRequest
+from ..utils.logging import pf_info, pf_logger, pf_warn
+
+logger = pf_logger("nemesis")
+
+# every fault class the engine knows how to schedule; generation defaults
+# to the full set, callers narrow it (e.g. device-only plans skip wal_*)
+ALL_CLASSES = (
+    "crash",       # durable crash-restart (manager-orchestrated)
+    "pause",       # SIGSTOP-style freeze + resume after `duration`
+    "partition",   # symmetric split: targets vs the rest
+    "isolate",     # cut each target from everyone
+    "one_way",     # asymmetric: src->dst down, reverse fine
+    "drop",        # iid per-frame loss at prob `arg` on targets' egress
+    "delay",       # +`arg` seconds one-way ingress delay at targets
+    "dup",         # per-frame duplication at prob `arg`
+    "wal_torn",    # next WAL append tears mid-record; replica crashes
+    "wal_fsync",   # next `arg` fsyncs fail; durability gate crashes
+)
+
+# classes with no device-plane lowering: frame-level delay/duplication are
+# netmodel *config* (delay line depth), not per-tick masks, and the WAL is
+# host-only.  compile_device skips these (documented weakening).
+HOST_ONLY = ("delay", "dup", "wal_torn", "wal_fsync")
+# instantaneous events: no heal action at tick + duration
+INSTANT = ("crash", "wal_torn", "wal_fsync")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One timed fault.  ``tick`` is in nemesis schedule ticks (scaled to
+    wall time by the runner, mapped 1:1 to engine ticks by the device
+    compiler); a non-instant event holds for ``duration`` ticks and then
+    heals."""
+
+    tick: int
+    kind: str
+    targets: Tuple[int, ...] = ()
+    duration: int = 0
+    arg: float = 0.0
+
+    def render(self) -> str:
+        return (
+            f"@{self.tick:05d} {self.kind}"
+            f" targets={list(self.targets)}"
+            f" dur={self.duration} arg={self.arg:g}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    seed: int
+    population: int
+    ticks: int
+    events: Tuple[FaultEvent, ...]
+
+    # ------------------------------------------------------------ build
+    @staticmethod
+    def generate(
+        seed: int,
+        population: int,
+        ticks: int,
+        classes: Sequence[str] = ALL_CLASSES,
+        heal_tail: Optional[int] = None,
+    ) -> "FaultPlan":
+        """Draw a sequential (non-overlapping) schedule from the seed.
+
+        Victim counts are capped at a sub-quorum minority so the cluster
+        can keep serving (or at least electing) *during* the fault, and
+        every fault heals before ``heal_tail`` — the final fault-free
+        stretch the soak's recovery assertion runs in.
+        """
+        import random
+
+        for c in classes:
+            if c not in ALL_CLASSES:
+                raise ValueError(f"unknown fault class {c!r}")
+        rng = random.Random(seed)
+        R = population
+        max_victims = max(1, (R - 1) // 2)
+        if heal_tail is None:
+            heal_tail = max(10, ticks // 4)
+        events: List[FaultEvent] = []
+        t = rng.randint(2, 6)
+        while t < ticks - heal_tail:
+            kind = rng.choice(list(classes))
+            dur = rng.randint(4, max(5, ticks // 6))
+            if t + dur >= ticks - heal_tail:
+                dur = ticks - heal_tail - t - 1
+                if dur < 2 and kind not in INSTANT:
+                    break
+            nv = rng.randint(1, max_victims)
+            targets = tuple(sorted(rng.sample(range(R), nv)))
+            arg = 0.0
+            if kind == "one_way":
+                src, dst = rng.sample(range(R), 2)
+                targets = (src, dst)
+            elif kind == "drop":
+                arg = round(rng.uniform(0.1, 0.5), 3)
+            elif kind == "dup":
+                arg = round(rng.uniform(0.1, 0.4), 3)
+            elif kind == "delay":
+                arg = round(rng.uniform(0.02, 0.2), 3)
+            elif kind == "wal_fsync":
+                arg = float(rng.randint(1, 3))
+            if kind in INSTANT:
+                dur = 0
+            events.append(FaultEvent(t, kind, targets, dur, arg))
+            # crashes are wall-serialized by the manager (ack + rejoin);
+            # leave slack so the next event still lands in its window
+            gap = rng.randint(3, 9) + (6 if kind == "crash" else 0)
+            t += max(dur, 1) + gap
+        return FaultPlan(seed, population, ticks, tuple(events))
+
+    # ------------------------------------------------------- determinism
+    def timeline(self) -> str:
+        """Canonical rendering; byte-identical for identical plans (the
+        repro contract — soak failures print this plus the seed)."""
+        head = (
+            f"# FaultPlan v1 seed={self.seed}"
+            f" population={self.population} ticks={self.ticks}\n"
+        )
+        return head + "".join(e.render() + "\n" for e in self.events)
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.timeline().encode()).hexdigest()[:16]
+
+    # ----------------------------------------------------- device plane
+    def compile_device(self, G: int) -> Dict[str, Any]:
+        """Lower to per-tick ``alive`` [T, G, R] / ``link_up`` [T, G, R, R]
+        mask sequences for ``Engine.run_ticks`` (append to its
+        ``inputs_seq``).  Crash lowers to freeze-and-thaw (``alive`` down
+        for the duration): the device plane has no durable-state-loss
+        analog — that axis is exactly what the host soak covers.
+        ``HOST_ONLY`` classes are skipped here."""
+        from ..core.netmodel import ControlInputs
+
+        T, R = self.ticks, self.population
+        alive = np.ones((T, G, R), bool)
+        link = np.ones((T, G, R, R), bool)
+        for ev in self.events:
+            lo = ev.tick
+            hi = min(ev.tick + max(ev.duration, 1), T)
+            if lo >= T:
+                continue
+            if ev.kind in ("crash", "pause"):
+                alive[lo:hi][:, :, list(ev.targets)] = False
+            elif ev.kind == "partition":
+                m = np.asarray(
+                    ControlInputs.split_links(G, R, ev.targets)
+                )
+                link[lo:hi] &= m[None]
+            elif ev.kind == "isolate":
+                m = np.asarray(
+                    ControlInputs.isolate_links(G, R, *ev.targets)
+                )
+                link[lo:hi] &= m[None]
+            elif ev.kind == "one_way":
+                src, dst = ev.targets
+                m = np.asarray(
+                    ControlInputs.one_way_down(G, R, src, dst)
+                )
+                link[lo:hi] &= m[None]
+            elif ev.kind == "drop":
+                # iid per-(tick, group, link) loss, seeded off the plan:
+                # the same seed compiles the same loss pattern
+                rng = np.random.default_rng([self.seed, ev.tick])
+                keep = rng.random((hi - lo, G, R, R)) >= ev.arg
+                sel = np.zeros(R, bool)
+                sel[list(ev.targets)] = True
+                keep |= ~sel[None, None, :, None]  # only targets' egress
+                keep |= np.eye(R, dtype=bool)[None, None]  # self-links up
+                link[lo:hi] &= keep
+        return {"alive": alive, "link_up": link}
+
+    # ------------------------------------------------------- host plane
+    def host_actions(self) -> List[Tuple[int, str, str, dict]]:
+        """Flatten to a sorted action list for the live-cluster runner:
+        ``(tick, action, desc, spec)`` where ``action`` names a runner
+        verb and ``spec`` its arguments.  Duration events contribute an
+        explicit heal action at ``tick + duration``."""
+        acts: List[Tuple[int, str, str, dict]] = []
+        R = self.population
+
+        def others(ts):
+            return [r for r in range(R) if r not in ts]
+
+        for ev in self.events:
+            ts = list(ev.targets)
+            end = ev.tick + ev.duration
+            if ev.kind == "crash":
+                acts.append((ev.tick, "reset", ev.render(),
+                             {"servers": ts}))
+            elif ev.kind == "pause":
+                acts.append((ev.tick, "pause", ev.render(),
+                             {"servers": ts}))
+                acts.append((end, "resume", f"@{end:05d} resume"
+                             f" targets={ts}", {"servers": ts}))
+            elif ev.kind in ("partition", "isolate"):
+                # cutting both directions at the victims' side alone
+                # severs the link: egress dies at their mute, ingress
+                # from the far side dies at their deaf
+                if ev.kind == "partition":
+                    spec = {"mute": others(ts), "deaf": others(ts)}
+                    net = {r: spec for r in ts}
+                else:
+                    net = {
+                        r: {
+                            "mute": [p for p in range(R) if p != r],
+                            "deaf": [p for p in range(R) if p != r],
+                        }
+                        for r in ts
+                    }
+                acts.append((ev.tick, "net", ev.render(), {"per": net}))
+                acts.append((end, "net_clear", f"@{end:05d} heal"
+                             f" targets={ts}", {"servers": ts}))
+            elif ev.kind == "one_way":
+                src, dst = ev.targets
+                acts.append((ev.tick, "net", ev.render(),
+                             {"per": {src: {"mute": [dst]}}}))
+                acts.append((end, "net_clear", f"@{end:05d} heal"
+                             f" targets=[{src}]", {"servers": [src]}))
+            elif ev.kind in ("drop", "delay", "dup"):
+                key = {"drop": "drop", "delay": "delay", "dup": "dup"}[
+                    ev.kind
+                ]
+                spec = {key: {"*": ev.arg}}
+                acts.append((ev.tick, "net", ev.render(),
+                             {"per": {r: spec for r in ts}}))
+                acts.append((end, "net_clear", f"@{end:05d} heal"
+                             f" targets={ts}", {"servers": ts}))
+            elif ev.kind == "wal_torn":
+                acts.append((ev.tick, "wal", ev.render(),
+                             {"servers": ts, "spec": {"torn": 1}}))
+            elif ev.kind == "wal_fsync":
+                acts.append((
+                    ev.tick, "wal", ev.render(),
+                    {"servers": ts,
+                     "spec": {"fsync_fail": int(ev.arg)}},
+                ))
+        acts.sort(key=lambda a: a[0])
+        return acts
+
+
+class NemesisRunner:
+    """Plays a FaultPlan against a live cluster through the manager
+    control plane.  One schedule tick maps to ``tick_len`` wall seconds;
+    blocking actions (manager-serialized crash-restarts) may slide later
+    events' wall times, but never their order or logical ticks — the
+    logical timeline IS the plan."""
+
+    def __init__(
+        self,
+        manager_addr: Tuple[str, int],
+        plan: FaultPlan,
+        tick_len: float = 0.25,
+        on_action: Optional[Callable[[int, str], None]] = None,
+    ):
+        from ..client.endpoint import GenericEndpoint
+
+        self.plan = plan
+        self.tick_len = tick_len
+        self.ep = GenericEndpoint(manager_addr)  # ctrl stub only
+        self.executed: List[Tuple[int, str]] = []
+        self._on_action = on_action
+
+    # --------------------------------------------------------- plumbing
+    def _request(self, req: CtrlRequest, timeout: float = 60.0):
+        return self.ep.ctrl.request(req, timeout=timeout)
+
+    def _inject(self, servers: List[int], payload: dict) -> None:
+        payload = dict(payload)
+        payload.setdefault(
+            "seed", self.plan.seed * 1000003 % (1 << 31)
+        )
+        self._request(CtrlRequest(
+            "inject_faults", servers=servers, payload=payload,
+        ))
+
+    def _run_action(self, action: str, spec: dict) -> None:
+        if action == "reset":
+            # durable crash-restart; serialized by the manager (ack,
+            # id free, rejoin) — the long pole of the schedule
+            self._request(
+                CtrlRequest("reset_servers", servers=spec["servers"],
+                            durable=True),
+                timeout=240.0,
+            )
+        elif action == "pause":
+            self._request(CtrlRequest(
+                "pause_servers", servers=spec["servers"]))
+        elif action == "resume":
+            self._request(CtrlRequest(
+                "resume_servers", servers=spec["servers"]))
+        elif action == "net":
+            for sid, net in spec["per"].items():
+                self._inject([sid], {"net": net})
+        elif action == "net_clear":
+            self._inject(spec["servers"], {"net": None})
+        elif action == "wal":
+            self._inject(spec["servers"], {"wal": spec["spec"]})
+
+    # ------------------------------------------------------------- play
+    def play(self, stop: Optional[threading.Event] = None) -> None:
+        """Execute the schedule; returns after the last action (all
+        durations healed).  ``stop`` aborts between actions."""
+        t0 = time.monotonic()
+        for tick, action, desc, spec in self.plan.host_actions():
+            if stop is not None and stop.is_set():
+                break
+            lag = t0 + tick * self.tick_len - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                self._run_action(action, spec)
+                self.executed.append((tick, desc))
+                pf_info(logger, f"nemesis {desc}")
+            except Exception as e:
+                # a fault action failing (e.g. victim already down) is
+                # recorded, not fatal — the heal pass below re-clears
+                self.executed.append((tick, f"{desc} !error {e}"))
+                pf_warn(logger, f"nemesis action failed: {desc}: {e}")
+            if self._on_action is not None:
+                self._on_action(tick, desc)
+
+    def heal_all(self) -> None:
+        """Belt-and-braces final heal: clear every injector and resume
+        everyone, so the recovery assertion never races a leftover
+        fault."""
+        try:
+            self._inject(
+                list(range(self.plan.population)),
+                {"net": None, "wal": None},
+            )
+        except Exception:
+            pass
+        try:
+            self._request(CtrlRequest("resume_servers", servers=None))
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.ep.leave()
+        except Exception:
+            pass

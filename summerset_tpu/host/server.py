@@ -323,34 +323,68 @@ class ServerReplica:
         # lower-id peers, accept from higher ids.  The join is re-sent until
         # the mesh completes — concurrent bring-up means a lower-id peer may
         # join after us, so one connect_to_peers snapshot is not enough.
-        self.transport = TransportHub(self.me, self.population, p2p_addr)
-        join = CtrlMsg("new_server_join", {
-            "protocol": protocol,
-            "api_addr": api_addr,
-            "p2p_addr": p2p_addr,
-        })
-        connected: set = set()
-        deadline = time.monotonic() + 60
-        while True:
-            self.ctrl.send_ctrl(join)
-            try:
-                msg = self.ctrl.recv_ctrl(timeout=3)
-            except Exception:
-                msg = None
-            if msg is not None and msg.kind == "connect_to_peers":
-                for peer, addr in msg.payload["to_peers"].items():
-                    p = int(peer)
-                    if p not in connected and not self.transport.connected(p):
-                        self.transport.connect_to_peer(p, addr)
+        try:
+            self.transport = TransportHub(
+                self.me, self.population, p2p_addr
+            )
+            join = CtrlMsg("new_server_join", {
+                "protocol": protocol,
+                "api_addr": api_addr,
+                "p2p_addr": p2p_addr,
+            })
+            connected: set = set()
+            deadline = time.monotonic() + 60
+            while True:
+                self.ctrl.send_ctrl(join)
+                try:
+                    msg = self.ctrl.recv_ctrl(timeout=3)
+                except Exception:
+                    msg = None
+                if msg is not None and msg.kind == "connect_to_peers":
+                    for peer, addr in msg.payload["to_peers"].items():
+                        p = int(peer)
+                        if (
+                            p in connected
+                            or self.transport.connected(p)
+                        ):
+                            continue
+                        try:
+                            self.transport.connect_to_peer(p, addr)
+                        except (SummersetError, OSError):
+                            # the peer may itself be mid-crash-restart
+                            # (nemesis finding: a WAL-fault self-crash
+                            # racing a manager reset): retry next round,
+                            # or it rejoins later and dials us — either
+                            # way killing OUR bring-up over it would
+                            # cascade one crash into two
+                            continue
                         connected.add(p)
-            try:
-                self.transport.wait_for_group(timeout=2)
-                break
-            except Exception:
-                if time.monotonic() > deadline:
-                    raise
+                try:
+                    self.transport.wait_for_group(timeout=2)
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
 
-        self.external = ExternalApi(api_addr)
+            self.external = ExternalApi(api_addr)
+        except BaseException:
+            # failed bring-up must release every port/handle it grabbed:
+            # the supervisor retries the constructor, and a leaked p2p
+            # listener or WAL handle would wedge every retry on rebind
+            tr = getattr(self, "transport", None)
+            if tr is not None:
+                try:
+                    tr.close()
+                except Exception:
+                    pass
+            for closer in (
+                self.wal.stop, self.statemach.stop, self.ctrl.close,
+            ):
+                try:
+                    closer()
+                except Exception:
+                    pass
+            raise
         pf_info(logger, f"replica {self.me} ready")
 
     # ------------------------------------------------------------- routing
@@ -1750,6 +1784,17 @@ class ServerReplica:
                     self._conf_queue.append((None, ApiRequest(
                         "conf", conf_delta=d,
                     )))
+        elif msg.kind == "fault_ctl":
+            # nemesis fault injection (host/nemesis.py): swap the message-
+            # plane and/or disk-plane fault specs.  A key present with a
+            # None value clears that plane; an absent key leaves it alone.
+            p = msg.payload
+            seed = int(p.get("seed", 0))
+            if "net" in p:
+                self.transport.set_faults(p.get("net"), seed=seed)
+            if "wal" in p:
+                self.wal.set_faults(p.get("wal"), seed=seed)
+            self.ctrl.send_ctrl(CtrlMsg("fault_reply"))
         elif msg.kind == "take_snapshot":
             self._take_snapshot()
             self.ctrl.send_ctrl(CtrlMsg("snapshot_reply"))

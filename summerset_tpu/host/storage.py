@@ -206,8 +206,32 @@ class StorageHub:
         self._out: queue.Queue = queue.Queue()
         self._stop_lock = threading.Lock()
         self._stopped = False
+        # disk fault injection (host/nemesis.py): a mutable spec consulted
+        # by the logger thread before each action.  None = no faults.
+        self._faults: Optional[dict] = None
         self._thread = threading.Thread(target=self._logger, daemon=True)
         self._thread.start()
+
+    # -- fault injection -----------------------------------------------------
+    def set_faults(self, spec: Optional[dict], seed: int = 0) -> None:
+        """Arm (or clear, with ``spec=None``) disk-fault injection:
+
+        - ``{"torn": 1}`` — the next append is torn: the record's bytes
+          are only partially persisted (a crash mid-write) and the
+          backend goes sticky-dead, so every later action fails too —
+          one tear is one crash, by construction.  The replica's
+          group-commit fsync then raises, it crashes before any gated
+          ack leaves, and recovery must detect + truncate the tear
+          (``server._recover_from_wal``).
+        - ``{"fsync_fail": n}`` — the next ``n`` sync points fail (EIO-
+          style); the durability gate turns this into a crash as well.
+
+        ``seed`` is accepted for interface symmetry with
+        ``TransportHub.set_faults`` (the WAL faults are count-armed, not
+        probabilistic — a tear either happens at a schedule point or not).
+        """
+        del seed
+        self._faults = dict(spec) if spec else None
 
     # -- channel API ---------------------------------------------------------
     def submit_action(self, action_id: Any, action: LogAction) -> None:
@@ -243,7 +267,35 @@ class StorageHub:
         return self.backend.size
 
     # -- logger thread -------------------------------------------------------
+    def _inject_fault(self, a: LogAction) -> None:
+        """Raise the armed fault for this action, mutating the disk state
+        the way a real crash would (runs on the logger thread, which owns
+        the backend — same single-writer discipline as normal actions)."""
+        f = self._faults
+        if not f:
+            return
+        if f.get("dead"):
+            raise OSError("injected: WAL device dead after torn write")
+        if a.kind == "append" and f.get("torn", 0) > 0:
+            f["torn"] -= 1
+            b = self.backend
+            body = pickle.dumps(a.entry)
+            end = b.append(body, False)
+            # tear the record: keep the header + a body prefix on disk,
+            # exactly what an 8-byte-at-a-time crash leaves behind
+            b.truncate(end - max(1, len(body) // 2), True)
+            f["dead"] = True
+            raise OSError(
+                "injected: torn append (crash mid-record write)"
+            )
+        if f.get("fsync_fail", 0) > 0 and (
+            a.kind == "sync" or a.sync
+        ):
+            f["fsync_fail"] -= 1
+            raise OSError("injected: fsync failed (EIO)")
+
     def _handle(self, a: LogAction) -> LogResult:
+        self._inject_fault(a)
         b = self.backend
         if a.kind == "read":
             got = b.read(a.offset)

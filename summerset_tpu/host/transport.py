@@ -94,6 +94,10 @@ class TransportHub:
         self.p2p_addr = p2p_addr
         self._conns: Dict[int, socket.socket] = {}
         self._wlocks: Dict[int, threading.Lock] = {}
+        # live-cluster fault injection (host/nemesis.py): a FrameFaults
+        # verdict engine consulted on every egress frame (send_tick) and
+        # ingress frame (messenger threads).  None = zero-cost fast path.
+        self._faults: Optional[safetcp.FrameFaults] = None
         # per-peer cumulative frame egress (bytes on the wire, framing
         # included) — the coarse half of the payload-economy accounting;
         # the server keeps the payload-plane-only counter (pp_bytes)
@@ -141,6 +145,19 @@ class TransportHub:
             target=self._acceptor, daemon=True
         )
         self._accept_thread.start()
+
+    # ------------------------------------------------------ fault injection
+    def set_faults(self, spec: Optional[dict], seed: int = 0) -> None:
+        """Install (or clear, with ``spec=None``) a message-fault spec.
+
+        Crash/pause faults stay on the manager control plane; this hook
+        covers the message plane only — partitions (mute/deaf), iid drop,
+        duplication, and added delay — with verdicts drawn from a seeded
+        RNG (see ``safetcp.FrameFaults``).  Swapped atomically; the tick
+        loop and messenger threads read the reference once per frame."""
+        self._faults = (
+            safetcp.FrameFaults(spec, seed) if spec else None
+        )
 
     # ---------------------------------------------------------- mesh setup
     def peers(self) -> list:
@@ -233,6 +250,15 @@ class TransportHub:
         try:
             while True:
                 (tick, payload), nbytes = safetcp.recv_msg_sync_len(sock)
+                faults = self._faults
+                if faults is not None:
+                    if faults.ingress_drop(peer):
+                        continue  # deaf to this peer (one partition half)
+                    d = faults.ingress_delay(peer)
+                    if d > 0:
+                        # sleeping in the per-peer messenger delays every
+                        # later frame too — a slow link, never reordering
+                        time.sleep(d)
                 self._rq[peer].put((tick, payload))
                 # per-peer delivery sample for the adaptive perf model
                 # (send-stamped frames; monotonic is machine-wide, so the
@@ -252,16 +278,25 @@ class TransportHub:
     # ------------------------------------------------------------ tick I/O
     def send_tick(self, tick: int, per_peer: Dict[int, Any]) -> None:
         """Send this tick's outbox slice to each connected peer."""
+        faults = self._faults
         for peer, payload in per_peer.items():
             sock = self._conns.get(peer)
             if sock is None:
                 continue
+            copies = 1
+            if faults is not None:
+                verdict = faults.egress(peer)
+                if verdict == "drop":
+                    continue  # frame lost: kernels' loss machinery heals
+                if verdict == "dup":
+                    copies = 2
             buf = safetcp.encode_frame((tick, payload))
             try:
                 with self._wlocks[peer]:
-                    sock.sendall(buf)
+                    for _ in range(copies):
+                        sock.sendall(buf)
                 self.bytes_sent[peer] = (
-                    self.bytes_sent.get(peer, 0) + len(buf)
+                    self.bytes_sent.get(peer, 0) + copies * len(buf)
                 )
             except OSError:
                 if self._conns.get(peer) is sock:

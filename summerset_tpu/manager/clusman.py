@@ -175,6 +175,7 @@ class ClusterManager:
             )
         elif msg.kind in (
             "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
+            "fault_reply",
         ):
             for q in self._pending_replies.get(msg.kind, ()):
                 q.put_nowait(conn.sid)
@@ -331,6 +332,12 @@ class ClusterManager:
         if req.kind == "take_snapshot":
             return await self._fanout_wait(
                 "take_snapshot", "snapshot_reply", req
+            )
+        if req.kind == "inject_faults":
+            # nemesis plane: relay the fault spec to each target server
+            # (host/nemesis.py composes these into seeded schedules)
+            return await self._fanout_wait(
+                "fault_ctl", "fault_reply", req, extra=req.payload
             )
         return CtrlReply("unknown")
 

@@ -18,8 +18,10 @@ from __future__ import annotations
 import asyncio
 import errno
 import pickle
+import random
 import struct
-from typing import Any, Tuple
+import threading
+from typing import Any, Dict, Tuple
 
 from .errors import SummersetError
 
@@ -27,6 +29,74 @@ _LEN = struct.Struct(">Q")
 
 # Refuse absurd frames (reference caps values at 16MB; give headroom).
 MAX_FRAME = 64 * 1024 * 1024
+
+
+class FrameFaults:
+    """Seeded per-frame fault verdicts for the live TCP planes (the
+    host-side analog of the netmodel's loss/partition masks; parity role:
+    ``tc qdisc netem`` loss/delay/duplicate per veth in the reference's
+    ``scripts/utils/net.py``).
+
+    The spec is a plain dict (it rides a CtrlMsg through the manager):
+
+    - ``mute``:  [peer, ...] — egress to these peers is silently dropped
+                 (one half of a partition; asymmetric faults use only one
+                 side's mute).
+    - ``deaf``:  [peer, ...] — ingress from these peers is discarded.
+    - ``drop``:  {peer or "*": prob} — iid per-frame egress loss.
+    - ``dup``:   {peer or "*": prob} — per-frame egress duplication.
+    - ``delay``: {peer or "*": seconds} — added one-way ingress delay
+                 (applied in the per-peer receive thread, so per-link
+                 FIFO order is preserved — a slow link, not reordering).
+
+    Verdict draws come from one seeded ``random.Random`` behind a lock:
+    the verdict *sequence* is deterministic per (spec, seed), which is
+    what makes a nemesis schedule a one-line repro; wall-clock
+    interleaving with the replica's tick loop is the only nondeterminism
+    left, exactly as with real netem.
+    """
+
+    def __init__(self, spec: Dict[str, Any], seed: int = 0):
+        self.spec = dict(spec or {})
+        self._mute = {int(p) for p in self.spec.get("mute", ())}
+        self._deaf = {int(p) for p in self.spec.get("deaf", ())}
+        self._drop = {
+            str(k): float(v) for k, v in self.spec.get("drop", {}).items()
+        }
+        self._dup = {
+            str(k): float(v) for k, v in self.spec.get("dup", {}).items()
+        }
+        self._delay = {
+            str(k): float(v) for k, v in self.spec.get("delay", {}).items()
+        }
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _rate(table: Dict[str, float], peer: int) -> float:
+        return table.get(str(peer), table.get("*", 0.0))
+
+    def egress(self, peer: int) -> str:
+        """Verdict for one outgoing frame: "drop" | "dup" | "send"."""
+        if peer in self._mute:
+            return "drop"
+        p_drop = self._rate(self._drop, peer)
+        p_dup = self._rate(self._dup, peer)
+        if p_drop <= 0.0 and p_dup <= 0.0:
+            return "send"
+        with self._lock:
+            u = self._rng.random()
+        if u < p_drop:
+            return "drop"
+        if u < p_drop + p_dup:
+            return "dup"
+        return "send"
+
+    def ingress_drop(self, peer: int) -> bool:
+        return peer in self._deaf
+
+    def ingress_delay(self, peer: int) -> float:
+        return self._rate(self._delay, peer)
 
 
 def encode_frame(obj: Any) -> bytes:

@@ -48,6 +48,7 @@ class Cluster:
         self.replicas = {}
         self._threads = []
         self._man_loop = None
+        self._stopping = False
 
         man = ClusterManager(
             protocol, ("127.0.0.1", self.srv_port),
@@ -86,28 +87,47 @@ class Cluster:
         time.sleep(1.0)  # let the warm-start leader settle
 
     def _replica_loop(self, slot: int) -> None:
-        """Crash-restart loop (parity: summerset_server main loop)."""
-        while True:
-            rep = ServerReplica(
-                self.protocol,
-                ("127.0.0.1", self.api_ports[slot]),
-                ("127.0.0.1", self.p2p_ports[slot]),
-                ("127.0.0.1", self.srv_port),
-                config=self.config,
-                tick_interval=self.tick,
-                window=32,
-                num_groups=self.num_groups,
-                backer_dir=self.tmpdir,
-            )
+        """Crash-restart loop (parity: summerset_server main loop under a
+        process supervisor).  An exception out of run() is a crash — e.g.
+        an injected WAL fault failing the group-commit fsync raises
+        rather than ack unsynced writes — and the supervisor restarts the
+        replica so recovery replays whatever actually reached the disk."""
+        while not self._stopping:
+            try:
+                rep = ServerReplica(
+                    self.protocol,
+                    ("127.0.0.1", self.api_ports[slot]),
+                    ("127.0.0.1", self.p2p_ports[slot]),
+                    ("127.0.0.1", self.srv_port),
+                    config=self.config,
+                    tick_interval=self.tick,
+                    window=32,
+                    num_groups=self.num_groups,
+                    backer_dir=self.tmpdir,
+                )
+            except Exception as e:
+                # bring-up can fail transiently when a peer is itself
+                # mid-crash-restart (nemesis finding); the supervisor
+                # retries instead of leaving the slot dead forever
+                print(f"replica slot {slot} bring-up failed: {e!r}; "
+                      "retrying", flush=True)
+                time.sleep(0.5)
+                continue
             self.replicas[rep.me] = rep
-            restart = rep.run()
+            try:
+                restart = rep.run()
+            except Exception as e:
+                print(f"replica {rep.me} crashed: {e!r}; restarting",
+                      flush=True)
+                restart = True
             rep.shutdown()
             self.replicas.pop(rep.me, None)
-            if not restart:
+            if not restart or rep.stopping:
                 return
             time.sleep(0.2)
 
     def stop(self):
+        self._stopping = True
         for rep in list(self.replicas.values()):
             rep.stopping = True
         time.sleep(3 * self.tick + 0.2)

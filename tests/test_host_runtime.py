@@ -119,6 +119,83 @@ class TestStorage:
         assert back.offset_ok and back.entry == "after"
         h2.stop()
 
+    def test_pywal_crash_recovery_truncates_torn_tail(self, tmp_path):
+        """_PyWal crash-recovery contract (ISSUE 2 satellite): a torn /
+        partial tail record left by a crash mid-write — injected through
+        the nemesis WAL fault plane, which persists a header + body
+        prefix exactly like an interrupted write — is detected by the
+        recovery scan and truncated, never parsed as garbage; records
+        before the tear survive, and post-recovery appends land cleanly
+        where the tear was cut."""
+        path = str(tmp_path / "crash.wal")
+        hub = StorageHub(path, prefer_native=False)
+        hub.do_sync_action(LogAction("append", entry=("vote", 0, {"a": 1}),
+                                     sync=True))
+        good = hub.do_sync_action(
+            LogAction("append", entry=(0, 5, 7, [("c", "put")]),
+                      sync=True)
+        )
+        hub.set_faults({"torn": 1})
+        res = hub.do_sync_action(
+            LogAction("append", entry=("vote", 0, {"a": 2}))
+        )
+        assert not res.offset_ok  # the "crash": nothing past here acked
+        hub.stop()
+        assert os.path.getsize(path) > good.end_offset  # partial tail
+
+        # restart: replay the WAL the way server._recover_from_wal does
+        rec = StorageHub(path, prefer_native=False)
+        off, entries = 0, []
+        while True:
+            r = rec.do_sync_action(LogAction("read", offset=off))
+            if not r.offset_ok or r.entry is None:
+                break
+            entries.append(r.entry)
+            off = r.end_offset
+        # both intact records replayed; the torn tail is NOT parsed
+        assert entries == [
+            ("vote", 0, {"a": 1}), (0, 5, 7, [("c", "put")]),
+        ]
+        assert off == good.end_offset
+        # torn-tail condition detected and truncated (recovery contract)
+        assert off < rec.size
+        t = rec.do_sync_action(
+            LogAction("truncate", offset=off, sync=True)
+        )
+        assert t.offset_ok and rec.size == good.end_offset
+        # post-recovery appends land where the tear was cut
+        after = rec.do_sync_action(
+            LogAction("append", entry="post", sync=True)
+        )
+        assert after.end_offset > good.end_offset
+        back = rec.do_sync_action(LogAction("read", offset=off))
+        assert back.offset_ok and back.entry == "post"
+        rec.stop()
+
+    def test_pywal_garbage_length_tail_not_parsed(self, tmp_path):
+        """A tail whose 8-byte length prefix is garbage (huge) must read
+        as end-of-log, not allocate/parse past the file."""
+        path = str(tmp_path / "garb.wal")
+        hub = StorageHub(path, prefer_native=False)
+        good = hub.do_sync_action(
+            LogAction("append", entry="keep", sync=True)
+        )
+        hub.stop()
+        with open(path, "ab") as f:
+            f.write((1 << 60).to_bytes(8, "little") + b"\xff" * 16)
+        rec = StorageHub(path, prefer_native=False)
+        assert rec.do_sync_action(
+            LogAction("read", offset=0)
+        ).entry == "keep"
+        torn = rec.do_sync_action(
+            LogAction("read", offset=good.end_offset)
+        )
+        assert not torn.offset_ok and torn.entry is None
+        assert rec.do_sync_action(
+            LogAction("truncate", offset=good.end_offset, sync=True)
+        ).offset_ok
+        rec.stop()
+
     def test_native_backend_used_when_available(self, tmp_path):
         if load_wal() is None:
             pytest.skip("no toolchain")

@@ -56,6 +56,46 @@ class TestCheckerAccepts:
         ok, diag = check_history(ops2)
         assert ok, diag
 
+    def test_many_unobserved_unacked_puts_check_fast(self):
+        """Nemesis-soak histories leave dozens of timed-out (unacked)
+        puts per key; each would double the Wing&Gong search space.  The
+        unobserved-unacked prune (sound under unique put values) must
+        keep the check effectively linear — this history explodes
+        (2^40 placements) without it."""
+        import time as _time
+
+        ops = [record_put(0, "k", "base", 0.0, 0.5, True)]
+        # 40 concurrent unacked puts nobody ever reads
+        for i in range(40):
+            ops.append(
+                record_put(1 + (i % 3), "k", f"lost-{i}", 1.0, None,
+                           False)
+            )
+        # a long healthy tail of acked writes + matching reads
+        for i in range(10):
+            t = 10.0 + i
+            ops.append(record_put(0, "k", f"w{i}", t, t + 0.2, True))
+            ops.append(record_get(4, "k", f"w{i}", t + 0.3, t + 0.4))
+        t0 = _time.monotonic()
+        ok, diag = check_history(ops)
+        assert ok, diag
+        assert _time.monotonic() - t0 < 5.0
+
+    def test_observed_unacked_put_survives_prune(self):
+        # an unacked put whose value IS read must still be placeable...
+        ops = [
+            record_put(0, "k", "a", 0.0, 1.0, True),
+            record_put(1, "k", "b", 2.0, None, False),
+            record_put(2, "k", "c", 2.0, None, False),  # never read
+            record_get(3, "k", "b", 5.0, 6.0),
+        ]
+        ok, diag = check_history(ops)
+        assert ok, diag
+        # ...and a stale read AFTER observing it is still caught
+        ops_bad = ops + [record_get(3, "k", "a", 7.0, 8.0)]
+        ok, _ = check_history(ops_bad)
+        assert not ok
+
     def test_keys_are_independent(self):
         ops = [
             record_put(0, "x", "1", 0.0, 1.0, True),

@@ -117,6 +117,70 @@ class TestResetServers:
 
         asyncio.run(run())
 
+    def test_unacked_victim_waits_only_short_rejoin_window(self):
+        """The un-acked branch (clusman.py:281): a victim whose control
+        connection died after (maybe) receiving reset_state gets only
+        rejoin_timeout/8 to come back, not the full budget — a genuinely
+        dead server must not stall the serialized reset queue."""
+        async def run():
+            man = make_manager()
+            add_server(man, 0)  # never acks, never rejoins
+            t0 = asyncio.get_event_loop().time()
+            rep = await man._reset_servers(
+                CtrlRequest("reset_servers", servers=[0])
+            )
+            elapsed = asyncio.get_event_loop().time() - t0
+            assert rep.done == []
+            # ack_timeout (0.5) + short window (2.0/8) + settle, well
+            # under the acked-victim budget (0.5 + 2.0 + settle)
+            assert elapsed < man.ack_timeout + man.rejoin_timeout / 2, (
+                elapsed
+            )
+            # the id was freed regardless, so a late restart can reclaim
+            assert 0 not in man.servers
+
+        asyncio.run(run())
+
+    def test_concurrent_restart_id_reclamation_stays_serialized(self):
+        """Concurrent-restart reclamation (the ISSUE.md:281 gap): victim
+        0's connection dies without an ack but its restart reclaims the
+        freed id inside the short window; victim 1 acks and rejoins
+        normally.  The serialized loop must finish 0 (unreported), then
+        still reset 1 — ids never collide and the late queue never
+        wedges."""
+        async def run():
+            man = make_manager()
+            conn0 = add_server(man, 0)
+            conn1 = add_server(man, 1)
+            add_server(man, 2)
+
+            async def silent_restart_0():
+                # conn dies (no ack); the restarted process reclaims id 0
+                # during the short rejoin window
+                await asyncio.sleep(man.ack_timeout + 0.05)
+                conn0.writer.close()
+                if man.servers.get(0) is conn0:
+                    del man.servers[0]
+                add_server(man, 0)
+                man._join_event.set()
+
+            asyncio.ensure_future(silent_restart_0())
+            asyncio.ensure_future(
+                _ack_and_rejoin(man, 1, conn1, delay=0.02)
+            )
+            rep = await man._reset_servers(
+                CtrlRequest("reset_servers", servers=[0, 1])
+            )
+            # only the acked+rejoined victim is reported done ...
+            assert rep.done == [1]
+            # ... but both slots hold fresh connections under their ids
+            assert man.servers[0] is not conn0
+            assert man.servers[1] is not conn1
+            assert not man.servers[0].writer.is_closing()
+            assert not man.servers[1].writer.is_closing()
+
+        asyncio.run(run())
+
     def test_never_rejoined_not_reported_done(self):
         """ADVICE r3 (low): a victim that acks but never rejoins must not
         be reported as successfully reset."""

@@ -10,6 +10,7 @@ import pytest
 
 from smr_helpers import check_agreement, committed_values, run_segment
 from summerset_tpu.core import Engine, NetConfig
+from summerset_tpu.core.netmodel import ControlInputs
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
 
@@ -163,12 +164,9 @@ class TestPartitions:
         eng = Engine(k)
         state, ns = eng.init()
         # partition {3,4} away from {0,1,2}
-        link = np.ones((G, R, R), bool)
-        for a in (0, 1, 2):
-            for b in (3, 4):
-                link[:, a, b] = link[:, b, a] = False
+        link = ControlInputs.split_links(G, R, (3, 4))
         state, ns, fx = run_segment(
-            eng, state, ns, 100, n_prop=P, link_up=jnp.asarray(link)
+            eng, state, ns, 100, n_prop=P, link_up=link
         )
         st = {k_: np.asarray(v) for k_, v in state.items()}
         assert (st["commit_bar"][:, 0] >= (100 - 10) * P).all()
@@ -183,12 +181,9 @@ class TestPartitions:
         state, ns, fx = run_segment(eng, state, ns, 20, n_prop=P)
 
         # partition leader side {0,1} from majority {2,3,4}
-        link = np.ones((G, R, R), bool)
-        for a in (0, 1):
-            for b in (2, 3, 4):
-                link[:, a, b] = link[:, b, a] = False
+        link = ControlInputs.split_links(G, R, (0, 1))
         state, ns, fx = run_segment(
-            eng, state, ns, 300, n_prop=P, link_up=jnp.asarray(link),
+            eng, state, ns, 300, n_prop=P, link_up=link,
             base_start=1000,
         )
         st = {k_: np.asarray(v) for k_, v in state.items()}
@@ -232,10 +227,9 @@ class TestBackfill:
         state, ns, _ = run_segment(eng, state, ns, 10, n_prop=P)
 
         # partition follower 2 away for 5 ticks (~20 slots < W)
-        link = np.ones((G, R, R), bool)
-        link[:, 2, :2] = link[:, :2, 2] = False
+        link = ControlInputs.isolate_links(G, R, 2)
         state, ns, _ = run_segment(
-            eng, state, ns, 5, n_prop=P, link_up=jnp.asarray(link),
+            eng, state, ns, 5, n_prop=P, link_up=link,
             base_start=10,
         )
         # heal; stop proposing so catch-up is pure backfill

@@ -10,6 +10,7 @@ import numpy as np
 
 from smr_helpers import check_agreement, run_segment
 from summerset_tpu.core import Engine
+from summerset_tpu.core.netmodel import ControlInputs
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.quorum_leases import ReplicaConfigQuorumLeases
 import pytest
@@ -141,10 +142,7 @@ class TestPartitionSafety:
         state, ns, _ = run_with_conf(eng, state, ns, 30, P, conf)
 
         # partition {0, 1} | {2, 3, 4}
-        link = np.ones((G, R, R), bool)
-        for a in (0, 1):
-            for b in (2, 3, 4):
-                link[:, a, b] = link[:, b, a] = False
+        link = ControlInputs.split_links(G, R, (0, 1))
         seq_ticks = 200
         t = jnp.arange(seq_ticks, dtype=jnp.int32)
         seq = {

@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 
 from summerset_tpu.core import Engine, NetConfig
+from summerset_tpu.core.netmodel import ControlInputs
 from summerset_tpu.core.engine import _tick
 from summerset_tpu.core.sharding import (
     make_mesh,
@@ -46,11 +47,11 @@ def _run_equivalence(G, R, W, P, group_shards, replica_shards, ticks):
         for r in range(R):
             if rng.random() < 0.2:
                 alive[:, r] = False
-        link = np.ones((G, R, R), bool)
         if rng.random() < 0.3:
             cut = int(rng.integers(R))
-            link[:, cut, :] = link[:, :, cut] = False
-            link[:, cut, cut] = True
+            link = np.asarray(ControlInputs.isolate_links(G, R, cut))
+        else:
+            link = np.ones((G, R, R), bool)
         schedule.append((alive, link))
 
     def inputs_at(t):
