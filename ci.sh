@@ -18,6 +18,10 @@
 #         histories + bounded recovery, and dumps the fault timeline +
 #         operation history on failure (re-run any seed for a
 #         byte-identical schedule: scripts/nemesis_soak.py --seed N).
+# Tier 2d: the telemetry plane — fails if the in-kernel metric lanes
+#         cost >5% of a steady tick (ablation) or a declared metric
+#         name is missing from a live cluster's metrics_dump scrape;
+#         regenerates TELEMETRY.json as a side effect.
 # Tier 3 (--full): every slow-marked fault-scenario kernel test and the
 #         randomized property sweep.
 set -e
@@ -36,6 +40,9 @@ python -m pytest tests/test_codeword_plane.py -q -m slow
 
 echo "=== tier 2c: nemesis soak matrix (3 seeds x 3 protocols) ==="
 python scripts/nemesis_soak.py --matrix
+
+echo "=== tier 2d: telemetry plane (lane overhead + scrape smoke) ==="
+python scripts/telemetry_smoke.py
 
 if [ "$1" = "--full" ]; then
   echo "=== tier 3: full superset (slow tests included) ==="

@@ -147,6 +147,11 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
     kernel = make_protocol(protocol, G, R, W, cfg)
     eng = Engine(kernel, netcfg=NetConfig(delay_ticks=1), seed=0)
     state0, ns0 = eng.init()
+    # drop the metric-lane block (core/telemetry.py): presence is a
+    # static compile condition, so popping it compiles the lane-free
+    # kernel — exploration neither asserts on the lanes nor wants a
+    # [G,R,K] int32 block stored per node
+    state0.pop("telem", None)
     acts = _actions(R)
 
     def run_round(state, ns, alive, link, vbase):
@@ -212,9 +217,14 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
 
 # per-protocol config overrides for CLI runs (rspaxos with an extra
 # required ack actually exercises the commit_k/full-quorum veto paths;
-# ft=0 would be the degenerate plain-majority configuration)
+# ft=0 would be the degenerate plain-majority configuration; crossword
+# pins the reactive assignment policy off so the enumerated fault
+# alphabet — not liveness-countdown feedback — is the only
+# nondeterminism source, and ft=0 keeps commit_k = majority at R=3,
+# the smallest geometry where diagonal shard slicing is live)
 CLI_PRESETS: Dict[str, Dict[str, Any]] = {
     "rspaxos": {"fault_tolerance": 1},
+    "crossword": {"fault_tolerance": 0, "assignment_adaptive": False},
 }
 
 
@@ -223,9 +233,13 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
-        "--protocols", default="multipaxos:6,raft:6,rspaxos:6",
+        "--protocols",
+        default="multipaxos:6,raft:6,rspaxos:6,crossword:5",
         help="comma list of name[:depth]; this default regenerates the "
-             "committed MODELCHECK.json in one invocation",
+             "committed MODELCHECK.json in one invocation (crossword "
+             "runs one level shallower: its per-slot shard tallies give "
+             "it the largest per-node state, and depth 5 already covers "
+             "election + window-wrap + gossip under every schedule)",
     )
     ap.add_argument("--depth", type=int, default=6,
                     help="depth for entries without an explicit :depth")

@@ -102,12 +102,18 @@ def main():
     print(f"cluster up in {time.time() - t0:.1f}s", flush=True)
 
     points = []
+    server_metrics = {}
     try:
         for load in [float(x) for x in args.loads.split(",")]:
             pt = run_point(cluster, args.clients, args.secs, load,
                            args.put_ratio, args.value_size, args.num_keys)
             print(json.dumps(pt), flush=True)
             points.append(pt)
+        # scrape once after the sweep: the snapshot's histograms cover
+        # every load point (server-side breakdown for the curve above)
+        from summerset_tpu.client.endpoint import scrape_metrics
+
+        server_metrics = scrape_metrics(cluster.manager_addr)
     finally:
         cluster.stop()
 
@@ -118,6 +124,7 @@ def main():
         "clients": args.clients,
         "secs_per_point": args.secs,
         "points": points,
+        "server_metrics": server_metrics,
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)

@@ -64,7 +64,9 @@ def main() -> None:
 
     from test_cluster import Cluster  # reuses the in-process harness
     from summerset_tpu.client.bench import ClientBench
-    from summerset_tpu.client.endpoint import GenericEndpoint
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
 
     tmp = tempfile.mkdtemp(prefix="host_bench_")
     t0 = time.time()
@@ -115,10 +117,16 @@ def main() -> None:
         "tput": round(tput, 2),
         "lat_p50_ms": round(p50, 3),
         "lat_p99_ms": round(p99, 3),
+        # server-side breakdown: the metrics_dump scrape (device metric
+        # lanes + host histograms incl. fsync/request latency/loop
+        # stages + sampled ticks-to-commit) rides the committed artifact
+        # so the client percentiles above carry their own explanation
+        "server_metrics": scrape_metrics(cluster.manager_addr),
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps(out), flush=True)
+    print(json.dumps({k: v for k, v in out.items()
+                      if k != "server_metrics"}), flush=True)
     cluster.stop()
 
 

@@ -148,6 +148,14 @@ def run_one(protocol: str, seed: int, args) -> dict:
         stop.set()
         for t in threads:
             t.join(timeout=30)
+        # post-heal telemetry scrape: the committed NEMESIS.json rows
+        # carry each survivor's server-side breakdown (device lanes +
+        # fsync/request-latency histograms), not just the verdict
+        from summerset_tpu.client.endpoint import scrape_metrics
+
+        result["server_metrics"] = scrape_metrics(
+            cluster.manager_addr, compact=True
+        )
         result["num_ops"] = len(ops)
         if len(ops) <= args.min_ops:
             result["error"] = f"history too small: {len(ops)}"

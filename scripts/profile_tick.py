@@ -28,8 +28,12 @@ from summerset_tpu.protocols.multipaxos import (
 )
 
 
-def time_engine(eng, ticks, proposals):
+def time_engine(eng, ticks, proposals, telemetry=True, reps=2):
     state, ns = eng.init()
+    if not telemetry:
+        # the ablation: without the metric-lane leaf the kernel compiles
+        # its lane-free variant (presence is a static condition)
+        state.pop("telem", None)
     # compile the exact (ticks, proposals) variant AND run it once untimed:
     # the first post-compile call carries one-time overhead on this backend
     state, ns = eng.run_synthetic(state, ns, ticks, proposals)
@@ -37,7 +41,7 @@ def time_engine(eng, ticks, proposals):
     state, ns = eng.run_synthetic(state, ns, ticks, proposals)
     jax.block_until_ready(state["commit_bar"])
     best = float("inf")
-    for _ in range(2):
+    for _ in range(reps):
         t0 = time.perf_counter()
         state, ns = eng.run_synthetic(state, ns, ticks, proposals)
         jax.block_until_ready(state["commit_bar"])
@@ -121,6 +125,7 @@ def main():
 
     variants = [
         ("gated baseline W=64", dict()),
+        ("no telemetry lanes", dict(telemetry=False)),
         ("ungated (round-1) prepare-reply", dict(kernel_cls=UngatedPrepareReply)),
         ("no prepare-reply at all", dict(kernel_cls=NoPrepareReply)),
         ("W=32", dict(W=32)),
@@ -136,8 +141,9 @@ def main():
     base = None
     for name, kw in variants:
         g = kw.pop("G", G)
+        telem = kw.pop("telemetry", True)
         eng = build(G=g, P=P, **kw)
-        per = time_engine(eng, args.ticks, P)
+        per = time_engine(eng, args.ticks, P, telemetry=telem)
         rate = g * P / per
         if base is None:
             base = per

@@ -1,0 +1,223 @@
+#!/usr/bin/env python3
+"""Telemetry plane gate (ci.sh tier 2d) + the committed TELEMETRY.json.
+
+Two checks, both hard failures:
+
+1. **Device-lane overhead ablation**: times the MultiPaxos synthetic
+   scan with and without the in-kernel metric lanes (the ``telem`` state
+   leaf — presence is a static compile condition, so the off-variant is
+   genuinely lane-free).  Fails if the lanes cost more than
+   ``--max-overhead-pct`` (default 5%) of a steady tick.
+2. **Metrics-scrape smoke**: brings up a real 3-replica MultiPaxos
+   cluster (manager + TCP + WALs), serves a handful of checked writes
+   and reads, scrapes every server through the ``metrics_dump`` ctrl
+   plane, and fails if any DECLARED host metric name or device lane is
+   missing, if no commits registered, or if the ticks-to-commit
+   distribution is empty.
+
+The combined result is written to TELEMETRY.json at the repo root — a
+live-cluster artifact carrying device metric lanes, host histograms
+(fsync + request latency included), and the sampled ticks-to-commit
+distribution, so "the serving story" is machine-verifiable rather than
+builder-asserted.
+
+Usage: python scripts/telemetry_smoke.py [--groups 1024] [--ticks 256]
+       [--max-overhead-pct 5.0] [--out TELEMETRY.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from summerset_tpu.utils.jaxcompat import set_cpu_devices  # noqa: E402
+
+set_cpu_devices(8)
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def ablation(groups: int, ticks: int, pairs: int = 6) -> dict:
+    """Per-tick cost with vs without the metric lanes.
+
+    Both variants compile up front, then samples run as TIGHTLY
+    interleaved with/without pairs and the best of each side is
+    compared.  On a small shared CI box this matters: back-to-back
+    best-of-N blocks (or re-warming between samples) shift cache state
+    between the sides and swing the apparent overhead by ±10%; tightly
+    interleaved minima put the true lane cost within ~1%
+    (cross-checked against a standalone accumulate micro-benchmark:
+    ~75us/tick at G=1024, under 1% of the tick)."""
+    import time as _time
+
+    from profile_tick import build
+
+    eng = build(G=groups)
+    s_w, n_w = eng.init()
+    s_wo, n_wo = eng.init()
+    s_wo.pop("telem")
+    # compile + steady-state both variants before any timed sample
+    for _ in range(2):
+        s_w, n_w = eng.run_synthetic(s_w, n_w, ticks, 16)
+        jax.block_until_ready(s_w["commit_bar"])
+        s_wo, n_wo = eng.run_synthetic(s_wo, n_wo, ticks, 16)
+        jax.block_until_ready(s_wo["commit_bar"])
+    w, wo = [], []
+    for _ in range(pairs):
+        t0 = _time.perf_counter()
+        s_w, n_w = eng.run_synthetic(s_w, n_w, ticks, 16)
+        jax.block_until_ready(s_w["commit_bar"])
+        w.append((_time.perf_counter() - t0) / ticks)
+        t0 = _time.perf_counter()
+        s_wo, n_wo = eng.run_synthetic(s_wo, n_wo, ticks, 16)
+        jax.block_until_ready(s_wo["commit_bar"])
+        wo.append((_time.perf_counter() - t0) / ticks)
+    with_t, without = min(w), min(wo)
+    overhead = (with_t - without) / without * 100.0
+    return {
+        "groups": groups,
+        "ticks": ticks,
+        "tick_us_with": round(with_t * 1e6, 2),
+        "tick_us_without": round(without * 1e6, 2),
+        "overhead_pct": round(overhead, 2),
+    }
+
+
+def scrape_smoke() -> dict:
+    """Live-cluster scrape: every declared metric must be present."""
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import GenericEndpoint
+    from summerset_tpu.core.telemetry import LANES
+    from summerset_tpu.host.messages import CtrlRequest
+    from summerset_tpu.host.telemetry import DECLARED
+
+    tmp = tempfile.mkdtemp(prefix="telemetry_smoke_")
+    cluster = Cluster(
+        "MultiPaxos", 3, tmp, config={"trace_sample": 1}
+    )
+    try:
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        for i in range(12):
+            drv.checked_put(f"telk{i}", f"v{i}")
+        for i in range(12):
+            drv.checked_get(f"telk{i}", expect=f"v{i}")
+        time.sleep(0.5)  # let followers apply + fsync the tail
+        # the manager waits <=15s per fan-out reply; re-scrape if a
+        # replica stalled behind a JIT recompile and missed the window
+        for _ in range(4):
+            rep = ep.ctrl.request(CtrlRequest("metrics_dump"), timeout=30)
+            if rep.payloads and len(rep.payloads) == 3:
+                break
+            time.sleep(2.0)
+        ep.leave()
+        assert rep.payloads and len(rep.payloads) == 3, (
+            f"scrape incomplete: {rep}"
+        )
+        # declared-name gate over the cluster-wide union: traffic-
+        # dependent metrics (request latency, ticks_to_commit) only
+        # exist where clients were served — the leader — but every
+        # declared name must exist SOMEWHERE after real traffic, and
+        # every device lane on every server
+        union = set()
+        missing = []
+        for sid, snap in sorted(rep.payloads.items()):
+            union |= {
+                k.split("{", 1)[0]
+                for part in ("counters", "gauges", "histograms")
+                for k in snap["host"][part]
+            }
+            for lane in LANES:
+                if lane not in snap["device"]["lanes"]:
+                    missing.append((sid, f"device:{lane}"))
+        missing += [n for n in DECLARED if n not in union]
+        assert not missing, f"declared metrics missing: {missing}"
+        total_commits = sum(
+            s["device"]["lanes"]["commits"] for s in rep.payloads.values()
+        )
+        assert total_commits > 0, "no commits in device lanes"
+        ttc = [
+            s["host"]["histograms"].get("ticks_to_commit", {"count": 0})
+            for s in rep.payloads.values()
+        ]
+        assert any(h["count"] > 0 for h in ttc), (
+            "empty ticks_to_commit distribution"
+        )
+        lat = [
+            v
+            for s in rep.payloads.values()
+            for k, v in s["host"]["histograms"].items()
+            if k.startswith("api_request_latency_us")
+        ]
+        assert any(h["count"] > 0 for h in lat), (
+            "no request-latency samples"
+        )
+        fsync = [
+            v
+            for s in rep.payloads.values()
+            for k, v in s["host"]["histograms"].items()
+            if k.startswith("wal_fsync_us")
+        ]
+        assert any(h["count"] > 0 for h in fsync), "no fsync samples"
+        return {
+            "protocol": "MultiPaxos",
+            "replicas": 3,
+            "declared_ok": True,
+            "servers": {
+                str(sid): snap for sid, snap in sorted(rep.payloads.items())
+            },
+        }
+    finally:
+        cluster.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=1024)
+    ap.add_argument("--ticks", type=int, default=256)
+    ap.add_argument("--max-overhead-pct", type=float, default=5.0)
+    ap.add_argument("--skip-ablation", action="store_true")
+    ap.add_argument("--out", default=os.path.join(REPO, "TELEMETRY.json"))
+    args = ap.parse_args()
+
+    out = {"platform": jax.devices()[0].platform}
+    if not args.skip_ablation:
+        ab = ablation(args.groups, args.ticks)
+        print(json.dumps(ab), flush=True)
+        out["ablation"] = ab
+        if ab["overhead_pct"] > args.max_overhead_pct:
+            print(
+                f"FAIL: device metric lanes cost {ab['overhead_pct']}% "
+                f"> {args.max_overhead_pct}% of a steady tick"
+            )
+            sys.exit(1)
+    out["scrape"] = scrape_smoke()
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"telemetry smoke PASS -> {args.out}", flush=True)
+    # daemon replica threads parked in XLA can std::terminate at normal
+    # teardown (same rationale as nemesis_soak); results are on disk
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

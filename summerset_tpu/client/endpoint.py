@@ -18,6 +18,49 @@ from ..utils import safetcp
 from ..utils.errors import SummersetError
 
 
+def scrape_metrics(manager_addr: Tuple[str, int],
+                   timeout: float = 30.0, compact: bool = False) -> dict:
+    """One-shot telemetry scrape: ``metrics_dump`` through the manager,
+    returning ``{server id (str): snapshot}`` — the JSON-able per-server
+    combination of device metric lanes, host registry histograms, and
+    sampled slot traces (``server.metrics_snapshot``).  ``compact=True``
+    trims each snapshot to the device lane totals plus the headline
+    histograms (for artifacts committing many runs, e.g. the soak
+    matrix).  Best-effort: an unreachable manager or mid-fault cluster
+    yields ``{}`` rather than failing the caller's bench/soak run."""
+    # only the NETWORK half is best-effort: a snapshot-schema mismatch in
+    # the trimming below must raise loudly, not silently commit
+    # server_metrics: {} into bench artifacts while CI stays green
+    try:
+        stub = ClientCtrlStub(manager_addr)
+        try:
+            rep = stub.request(CtrlRequest("metrics_dump"), timeout=timeout)
+        finally:
+            stub.close()
+    except Exception:
+        return {}
+    out = {
+        str(sid): snap
+        for sid, snap in sorted((rep.payloads or {}).items())
+    }
+    if compact:
+        keep = ("ticks_to_commit", "api_request_latency_us",
+                "wal_fsync_us", "wal_group_commit_batch")
+        out = {
+            sid: {
+                "tick": snap["tick"],
+                "device_lanes": snap["device"]["lanes"],
+                "histograms": {
+                    k: v
+                    for k, v in snap["host"]["histograms"].items()
+                    if k.split("{", 1)[0] in keep
+                },
+            }
+            for sid, snap in out.items()
+        }
+    return out
+
+
 class ClientCtrlStub:
     def __init__(self, manager_addr: Tuple[str, int]):
         self.sock = socket.create_connection(manager_addr, timeout=15)

@@ -19,6 +19,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import telemetry
 from .netmodel import ControlInputs, NetConfig, NetModel
 from .protocol import ProtocolKernel, StepEffects
 
@@ -71,6 +72,9 @@ class Engine:
 
     def init(self) -> Tuple[Pytree, Pytree]:
         state = self.kernel.init_state(self.seed)
+        # metric lanes ride the scan carry (core/telemetry.py); drop the
+        # leaf (state.pop("telem")) to compile the lane-free ablation
+        telemetry.attach(state, self.kernel.G, self.kernel.R)
         netstate = self.net.init_netstate(self.kernel.zero_outbox(), self.seed)
         return state, netstate
 
@@ -150,7 +154,16 @@ def _tick(
             exec_bar=new_state["exec_bar"],
             extra={k: mask_extra(v) for k, v in fx.extra.items()},
         )
-    netstate = net.push(netstate, outbox, ctrl)
+    if telemetry.TELEM_KEY in new_state:
+        # drop/delay lanes are accounted where the masks and jitter draws
+        # live; a dead sender's masked messages are pause semantics, not
+        # drops (netmodel.push excludes them)
+        netstate, tel = net.push(
+            netstate, outbox, ctrl, telem=new_state[telemetry.TELEM_KEY]
+        )
+        new_state = dict(new_state, **{telemetry.TELEM_KEY: tel})
+    else:
+        netstate = net.push(netstate, outbox, ctrl)
     return new_state, netstate, fx
 
 

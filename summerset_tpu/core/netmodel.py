@@ -138,6 +138,29 @@ class ControlInputs:
         link[:, src, dst] = False
         return jnp.asarray(link)
 
+    @staticmethod
+    def skew_alive(G: int, R: int, ticks: int, rates: dict,
+                   offset: int = 0):
+        """Per-replica clock-skew as duty-cycled ``alive`` masks:
+        ``[T, G, R]`` where replica ``r`` with rate ``rates[r]`` in
+        (0, 1] steps only on ticks where ``floor((t+1)*rate)`` advances —
+        i.e. its tick counter runs at ``rate`` times the cluster's.
+        Deterministic (no RNG) so a fault schedule containing skew stays
+        byte-identical per seed.  This is the adversarial superset of
+        real clock skew under lockstep semantics: a skipped tick freezes
+        the replica's countdowns (its lease/election clocks run slow)
+        AND loses that tick's deliveries, like a late process scheduled
+        around its socket reads.  ``offset`` phases the duty cycle (used
+        by the fault compiler to start a skew window mid-schedule)."""
+        alive = np.ones((ticks, G, R), bool)
+        t = np.arange(offset, offset + ticks, dtype=np.float64)
+        for r, rate in rates.items():
+            if not 0.0 < rate <= 1.0:
+                raise ValueError(f"skew rate must be in (0, 1]: {rate}")
+            on = np.floor((t + 1) * rate) > np.floor(t * rate)
+            alive[:, :, int(r)] &= on[:, None]
+        return jnp.asarray(alive)
+
 
 class NetModel:
     """Delay-line message delivery with loss/partition masking.
@@ -272,8 +295,17 @@ class NetModel:
         netstate: Pytree,
         outbox: Pytree,
         ctrl: Optional[ControlInputs] = None,
+        telem: Optional[Any] = None,
     ) -> Pytree:
-        """Enqueue this tick's outbox with sender-side masking; advance tick."""
+        """Enqueue this tick's outbox with sender-side masking; advance tick.
+
+        With ``telem`` (the ``[G, R, K]`` metric-lane block from
+        ``core/telemetry.py``) the drop/delay lanes are accounted here —
+        where the loss masks and jitter draws actually live — and the
+        updated block is returned alongside: ``(netstate, telem)``.
+        """
+        from . import telemetry as _tm
+
         cfg = self.cfg
         D = cfg.max_delay_ticks
         bufs = netstate["bufs"]
@@ -282,13 +314,30 @@ class NetModel:
 
         flags = outbox["flags"]
         mask = jnp.ones(flags.shape, jnp.bool_)
+        alive_src = None
+        masked_any = cfg.drop_rate > 0.0
         if ctrl is not None and ctrl.alive is not None:
-            mask &= ctrl.alive[:, :, None]  # dead source sends nothing
+            alive_src = ctrl.alive[:, :, None]
+            mask &= alive_src  # dead source sends nothing
+            masked_any = True
         if ctrl is not None and ctrl.link_up is not None:
             mask &= ctrl.link_up
+            masked_any = True
         if cfg.drop_rate > 0.0:
             rng, u = prng.uniform_unit(rng)
             mask &= u >= cfg.drop_rate
+        if telem is not None and masked_any:
+            # a message a live sender emitted but the mask ate is a drop;
+            # a dead sender emitting nothing is pause semantics, and its
+            # lane row must stay frozen.  Skipped entirely (static
+            # condition) when no masks exist this compilation — the
+            # steady bench path pays nothing for the lane.
+            lost = (flags != 0) & ~mask
+            if alive_src is not None:
+                lost &= alive_src
+            telem = _tm.bump(
+                telem, "net_drops", jnp.sum(lost.astype(jnp.int32), axis=2)
+            )
         outbox = dict(outbox, flags=jnp.where(mask, flags, jnp.uint32(0)))
         if self.cfg.pack_lanes:
             outbox = self._pack(outbox)
@@ -309,6 +358,17 @@ class NetModel:
                 )
                 rng = rng.at[:, :, 0].set(rng_nxt)
                 delay = delay + extra
+                if telem is not None:
+                    # total jitter ticks added to ENQUEUED sends: the
+                    # per-source draw happens every tick, but only
+                    # messages actually on the wire carry the delay (an
+                    # idle source must not inflate the lane)
+                    nsent = jnp.sum(
+                        (outbox["flags"] != 0).astype(jnp.int32), axis=2
+                    )
+                    telem = _tm.bump(
+                        telem, "net_delay_ticks", extra * nsent
+                    )
             # Clamp the absolute due tick to be strictly after the source's
             # previous one (FIFO + no slot collisions: an in-flight message
             # is never clobbered by a later send) and within the ring.
@@ -328,10 +388,11 @@ class NetModel:
 
             bufs = {k: enqueue(bufs[k], outbox[k]) for k in outbox}
 
-        return {
+        out = {
             "bufs": bufs,
             "cursor": (cursor + 1) % jnp.int32(max(D, 1)),
             "last_due": last_due,
             "tick": tick + 1,
             "rng": rng,
         }
+        return out if telem is None else (out, telem)

@@ -29,6 +29,8 @@ from typing import Any, Dict, FrozenSet, Tuple
 
 import jax
 
+from . import telemetry
+
 Pytree = Any
 
 
@@ -123,6 +125,60 @@ class ProtocolKernel:
     @property
     def quorum(self) -> int:
         return self.population // 2 + 1
+
+    # -- telemetry SPI -------------------------------------------------------
+    # The engine attaches a [G, R, K] int32 metric-lane block to the state
+    # (core/telemetry.py); each step folds per-tick contributions into it.
+    # Presence of the block is a static condition: states without it (the
+    # profile_tick ablation, hand-built test states) compile a lane-free
+    # variant at zero cost.
+
+    def _telemetry(self, old: Pytree, s: Pytree, c: Any) -> Dict[str, Any]:
+        """Hook: lane name -> [G, R] per-tick increments (bool or int32).
+
+        ``old`` is the pre-step state, ``s`` the post-phase state dict,
+        ``c`` the step's scratch namespace.  The base implementation
+        derives the protocol-generic lanes every kernel has by contract
+        (commit_bar) or by common window shape (win_bal); subclasses
+        extend the dict with their protocol-specific lanes.
+        """
+        import jax.numpy as jnp
+
+        tel = {
+            "commits": jnp.maximum(s["commit_bar"] - old["commit_bar"], 0),
+        }
+        n_new = getattr(c, "n_new", None)
+        if n_new is not None:
+            tel["proposals"] = n_new
+        for key in ("next_slot", "prop_bar"):  # common frontier names
+            if key in s:
+                tel["win_occupancy_hw"] = self._occupancy_span(s, key)
+                break
+        return tel
+
+    def _occupancy_span(self, s, hi_key: str):
+        """Cheap window-occupancy proxy for the high-water lane: the live
+        span ``frontier - exec_bar`` clipped to [0, W] — the number of
+        slots the ring must keep live, i.e. the window-stall pressure.
+        An exact ``count(win_* > 0)`` reduce over [G, R, W] costs ~7% of
+        a steady G=4096 CPU tick on its own (ablation-measured), which
+        would bust the 5% telemetry budget by itself; the span is O(G,R)
+        and is the quantity the propose/append window guards actually
+        gate on."""
+        import jax.numpy as jnp
+
+        span = s[hi_key] - s["exec_bar"]
+        if "vote_bar" in s and hi_key != "vote_bar":
+            span = jnp.maximum(span, s["vote_bar"] - s["exec_bar"])
+        return jnp.clip(span, 0, self.window)
+
+    def _accumulate_telemetry(self, old: Pytree, s: Pytree, c: Any) -> None:
+        """Fold this tick's lane contributions into ``s['telem']`` (no-op
+        when the state carries no lane block)."""
+        if telemetry.TELEM_KEY in s:
+            s[telemetry.TELEM_KEY] = telemetry.accumulate(
+                s[telemetry.TELEM_KEY], self._telemetry(old, s, c)
+            )
 
     # -- SPI -----------------------------------------------------------------
     def init_state(self, seed: int = 0) -> Pytree:

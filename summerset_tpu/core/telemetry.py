@@ -1,0 +1,139 @@
+"""In-kernel metric lanes: a small int32 telemetry block in the scan carry.
+
+The serving story so far measured everything from the *client* side
+(percentiles in TPUTLAT/HOSTBENCH) or from readiness lines parsed out of
+stdout; which component saturates first — the question compartmentalized
+SMR work starts from (arxiv 2012.15762) — was unanswerable for the device
+plane.  This module gives every kernel a fixed set of per-replica metric
+lanes accumulated *inside* the jitted tick, so a ``lax.scan`` over
+thousands of ticks lands with its own measurement attached: no host
+round-trips, no tracing, just one extra ``[G, R, K]`` int32 leaf in the
+state pytree riding the scan carry.
+
+Mechanics:
+
+- ``attach(state, G, R)`` adds the ``telem`` leaf; ``Engine.init`` does
+  this by default.  A state *without* the leaf compiles a telemetry-free
+  kernel variant (the ablation: ``state.pop("telem")`` after init) —
+  presence is a static Python condition, so the off-variant carries
+  literally zero lane cost.
+- Kernels contribute via the ``ProtocolKernel._telemetry`` SPI hook
+  (``core/protocol.py``): a dict of lane-name -> ``[G, R]`` increments,
+  folded in by ``accumulate`` — counters add, high-water lanes max.
+- The network model adds the ``net_drops`` / ``net_delay_ticks`` lanes at
+  ``push`` time (``core/netmodel.py``), where the loss masks and jitter
+  draws actually live.
+- Observability is NOT protocol state: the model-check explorer excludes
+  the lane block from its dedup hash (``models/explore.py``), and nothing
+  durable references it.
+
+Host replicas scrape row ``[:, me]`` of the block — each server's
+``metrics_dump`` snapshot carries its own ``[G, K]`` lane matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+# lane order is the wire format of the scraped [G, K] block: append-only
+# (scrapers index by name through LANE_IDX, but committed artifacts keep
+# meaning across PRs only if existing indices never move)
+COUNTER_LANES = (
+    "commits",          # commit_bar advance (slots committed)
+    "proposals",        # new slots proposed/accepted into the log
+    "elections",        # campaigns started (own ballot/term raised)
+    "ballots_adopted",  # foreign ballot/term adoptions
+    "heartbeats",       # accepted leader heartbeats / appends
+    "grants",           # lease grants held (lease-plane protocols)
+    "net_drops",        # messages masked at the netmodel egress
+    "net_delay_ticks",  # total jitter ticks added to enqueued sends
+)
+MAXGAUGE_LANES = (
+    "win_occupancy_hw",  # high-water voted-window occupancy (slots)
+)
+LANES = COUNTER_LANES + MAXGAUGE_LANES
+K = len(LANES)
+LANE_IDX: Dict[str, int] = {n: i for i, n in enumerate(LANES)}
+_MAX_SET = frozenset(MAXGAUGE_LANES)
+
+TELEM_KEY = "telem"
+
+
+def zero_block(num_groups: int, population: int):
+    """Fresh ``[G, R, K]`` lane block (every lane zero)."""
+    return jnp.zeros((num_groups, population, K), jnp.int32)
+
+
+def attach(state: Dict[str, Any], num_groups: int, population: int):
+    """Add the lane block to a state pytree (idempotent)."""
+    if TELEM_KEY not in state:
+        state[TELEM_KEY] = zero_block(num_groups, population)
+    return state
+
+
+def accumulate(telem, contrib: Dict[str, Any]):
+    """Fold per-tick contributions into the block.
+
+    ``contrib`` maps lane name -> ``[G, R]`` array (bool or int); counter
+    lanes add, high-water lanes take the running max.  Unknown lane names
+    are a bug in the contributing kernel — fail loudly.
+
+    One stacked add over the counter sub-block and one stacked max over
+    the high-water sub-block (lane order puts counters first): a
+    per-lane ``at[:, :, i].add`` chain re-materializes the whole block
+    once per lane, which alone cost >10% of a steady CPU tick at the
+    bench shape — the two-op form is what keeps the lanes under the 5%
+    ablation budget (ci.sh tier 2d).
+    """
+    for name in contrib:
+        if name not in LANE_IDX:  # undeclared lane = contributor bug
+            raise KeyError(name)
+    G, R, _ = telem.shape
+    zero = jnp.zeros((G, R), jnp.int32)
+
+    def col(name):
+        v = contrib.get(name)
+        if v is None:
+            return zero
+        v = jnp.asarray(v)
+        return v.astype(jnp.int32) if v.dtype != jnp.int32 else v
+
+    nc = len(COUNTER_LANES)
+    if any(n in contrib for n in COUNTER_LANES):
+        add = jnp.stack([col(n) for n in COUNTER_LANES], axis=-1)
+        telem = telem.at[:, :, :nc].add(add)
+    if any(n in contrib for n in MAXGAUGE_LANES):
+        hw = jnp.stack([col(n) for n in MAXGAUGE_LANES], axis=-1)
+        telem = telem.at[:, :, nc:].max(hw)
+    return telem
+
+
+def bump(telem, name: str, v):
+    """Fold one lane (same semantics as :func:`accumulate`)."""
+    v = jnp.asarray(v)
+    if v.dtype != jnp.int32:
+        v = v.astype(jnp.int32)
+    i = LANE_IDX[name]
+    if name in _MAX_SET:
+        return telem.at[:, :, i].max(v)
+    return telem.at[:, :, i].add(v)
+
+
+def snapshot_row(telem, me: int) -> Dict[str, Any]:
+    """Host-side decode of one replica's ``[G, K]`` block: per-lane group
+    totals (sum for counters, max for high-water) plus the raw per-group
+    matrix when small enough to commit into artifacts."""
+    block = np.asarray(telem)[:, me]  # [G, K]
+    lanes = {}
+    for name, i in LANE_IDX.items():
+        col = block[:, i]
+        lanes[name] = int(col.max() if name in _MAX_SET else col.sum())
+    out: Dict[str, Any] = {"lanes": lanes}
+    if block.shape[0] <= 64:
+        out["per_group"] = {
+            name: block[:, i].tolist() for name, i in LANE_IDX.items()
+        }
+    return out

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..utils import safetcp
@@ -28,10 +29,23 @@ class ExternalApi:
         api_addr: Tuple[str, int],
         batch_interval: float = 0.001,
         max_batch_size: int = 5000,
+        registry=None,
     ):
         self.api_addr = api_addr
         self.batch_interval = batch_interval
         self.max_batch_size = max_batch_size
+        # telemetry seam (host/telemetry.MetricsRegistry): request→reply
+        # latency is measured HERE, at the client-facing socket plane —
+        # it covers queueing, consensus, durability, and reply routing,
+        # the server-side mirror of what clients see.  Arrival stamps are
+        # bounded: a request that never draws a reply (redirect storms
+        # aside, a crash) ages out instead of leaking.
+        self.registry = registry
+        if registry is not None:
+            # pre-register so the eviction blind spot is visible (and
+            # zero) in every snapshot, not only after an overload
+            registry.counter_add("api_stamps_evicted", 0)
+        self._arrivals: Dict[Tuple[int, int], float] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server = None
         self._writers: Dict[int, asyncio.StreamWriter] = {}
@@ -100,6 +114,13 @@ class ExternalApi:
 
     # -- event loop side -----------------------------------------------------
     async def _send(self, client: int, reply: ApiReply) -> None:
+        reg = self.registry
+        if reg is not None:
+            t0 = self._arrivals.pop((client, reply.req_id), None)
+            if t0 is not None and reply.kind in ("reply", "conf"):
+                reg.observe_s("api_request_latency_us",
+                              time.monotonic() - t0)
+            reg.counter_add("api_replies_total", kind=reply.kind)
         w = self._writers.get(client)
         if w is None or w.is_closing():
             self._writers.pop(client, None)
@@ -128,6 +149,20 @@ class ExternalApi:
                         writer, ApiReply(kind="leave", req_id=req.req_id)
                     )
                     break
+                if self.registry is not None:
+                    self.registry.counter_add("api_requests_total")
+                    arr = self._arrivals
+                    arr[(int(client), req.req_id)] = time.monotonic()
+                    if len(arr) > 8192:  # age out reply-less stamps
+                        # the oldest stamps are exactly the slowest
+                        # outstanding requests, so their loss skews
+                        # api_request_latency_us optimistic — count the
+                        # evictions so the gap is diagnosable
+                        for k in list(arr)[:4096]:
+                            del arr[k]
+                        self.registry.counter_add(
+                            "api_stamps_evicted", 4096
+                        )
                 with self._lock:
                     self._pending.append((int(client), req))
         except (asyncio.IncompleteReadError, ConnectionError):

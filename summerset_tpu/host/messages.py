@@ -73,6 +73,8 @@ class CtrlMsg:
     #   responders_conf: conf_num, new_conf
     #   reset_state / pause / resume / take_snapshot (+ _reply forms)
     #   snapshot_up_to: new_start
+    #   metrics_dump -> metrics_reply: snapshot (telemetry scrape;
+    #     server.metrics_snapshot() — device lanes + host registry)
     #   leave / leave_reply
     payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -82,7 +84,8 @@ class CtrlRequest:
     """Client -> manager (parity: ``CtrlRequest``, reactor.rs:29-64)."""
 
     kind: str  # query_info | query_conf | reset_servers | pause_servers
-    #            | resume_servers | take_snapshot | inject_faults | leave
+    #            | resume_servers | take_snapshot | inject_faults
+    #            | metrics_dump | leave
     servers: Optional[List[int]] = None  # None = all
     durable: bool = True                 # reset: keep durable files?
     payload: Optional[Dict[str, Any]] = None  # inject_faults: fault spec
@@ -102,3 +105,6 @@ class CtrlReply:
     leader: Optional[int] = None
     conf: Optional[dict] = None
     done: Optional[List[int]] = None
+    # per-server reply payloads gathered by the fan-out (metrics_dump:
+    # sid -> telemetry snapshot); None for ack-only orchestration kinds
+    payloads: Optional[Dict[int, Any]] = None

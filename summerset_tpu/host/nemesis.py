@@ -59,6 +59,8 @@ ALL_CLASSES = (
     "dup",         # per-frame duplication at prob `arg`
     "wal_torn",    # next WAL append tears mid-record; replica crashes
     "wal_fsync",   # next `arg` fsyncs fail; durability gate crashes
+    "clock_skew",  # targets' tick clocks run at rate `arg` < 1 (device:
+                   # duty-cycled alive masks; host: tick_interval / arg)
 )
 
 # classes with no device-plane lowering: frame-level delay/duplication are
@@ -144,6 +146,12 @@ class FaultPlan:
                 arg = round(rng.uniform(0.1, 0.4), 3)
             elif kind == "delay":
                 arg = round(rng.uniform(0.02, 0.2), 3)
+            elif kind == "clock_skew":
+                # tick-rate scale: 0.3 = the victim's clock runs at 30%
+                # of the cluster's (the lease planes are the at-risk
+                # consumer — a slow holder's countdowns outlive the
+                # grantor's real-time intent)
+                arg = round(rng.uniform(0.3, 0.8), 3)
             elif kind == "wal_fsync":
                 arg = float(rng.randint(1, 3))
             if kind in INSTANT:
@@ -188,6 +196,18 @@ class FaultPlan:
                 continue
             if ev.kind in ("crash", "pause"):
                 alive[lo:hi][:, :, list(ev.targets)] = False
+            elif ev.kind == "clock_skew":
+                # duty-cycled alive: the victim steps only on ticks where
+                # its scaled clock advances a whole tick (deterministic —
+                # no RNG — so the compiled masks stay byte-identical).
+                # Under lockstep semantics this is the adversarial
+                # superset of real skew: countdowns crawl AND off-tick
+                # deliveries are lost (see ControlInputs.skew_alive).
+                m = np.asarray(ControlInputs.skew_alive(
+                    G, R, hi - lo, {t: ev.arg for t in ev.targets},
+                    offset=lo,
+                ))
+                alive[lo:hi] &= m
             elif ev.kind == "partition":
                 m = np.asarray(
                     ControlInputs.split_links(G, R, ev.targets)
@@ -272,6 +292,15 @@ class FaultPlan:
                              {"per": {r: spec for r in ts}}))
                 acts.append((end, "net_clear", f"@{end:05d} heal"
                              f" targets={ts}", {"servers": ts}))
+            elif ev.kind == "clock_skew":
+                # host lowering: stretch the victims' tick interval by
+                # 1/rate through the fault_ctl plane; heal restores 1.0
+                acts.append((ev.tick, "skew", ev.render(),
+                             {"servers": ts,
+                              "factor": round(1.0 / ev.arg, 3)}))
+                acts.append((end, "skew", f"@{end:05d} skew heal"
+                             f" targets={ts}",
+                             {"servers": ts, "factor": None}))
             elif ev.kind == "wal_torn":
                 acts.append((ev.tick, "wal", ev.render(),
                              {"servers": ts, "spec": {"torn": 1}}))
@@ -342,6 +371,8 @@ class NemesisRunner:
             self._inject(spec["servers"], {"net": None})
         elif action == "wal":
             self._inject(spec["servers"], {"wal": spec["spec"]})
+        elif action == "skew":
+            self._inject(spec["servers"], {"skew": spec["factor"]})
 
     # ------------------------------------------------------------- play
     def play(self, stop: Optional[threading.Event] = None) -> None:
@@ -373,7 +404,7 @@ class NemesisRunner:
         try:
             self._inject(
                 list(range(self.plan.population)),
-                {"net": None, "wal": None},
+                {"net": None, "wal": None, "skew": None},
             )
         except Exception:
             pass

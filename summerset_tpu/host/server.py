@@ -47,6 +47,7 @@ import jax
 import numpy as np
 import jax.numpy as jnp
 
+from ..core import telemetry as dev_telemetry
 from ..protocols import make_protocol
 from ..utils.errors import SummersetError
 from ..utils.logging import pf_info, pf_logger, pf_warn
@@ -57,10 +58,16 @@ from .messages import ApiReply, ApiRequest, CtrlMsg, ShardPayload
 from .payload import PayloadStore
 from .statemach import CommandResult, StateMachine, apply_command
 from .storage import LogAction, StorageHub
+from .telemetry import MetricsRegistry, SlotTraces
 from .transport import TransportHub
-from ..utils.stopwatch import Stopwatch
 
 logger = pf_logger("server")
+
+# run-loop stage names for the loop_stage_us histograms (one timing
+# system: the old record_breakdown stopwatch dict folded into the
+# metrics registry; the reference leader's bd print, mod.rs:932-943,
+# now reads the same histograms every server exposes via metrics_dump)
+_STAGES = ("intake", "exchange", "step", "log", "apply")
 
 
 _VID_BITS = 40  # vids fit far below 2**40; keys combine (g << 40) | vid
@@ -142,9 +149,21 @@ class ServerReplica:
         # host-side knobs (not kernel config fields)
         self.snapshot_interval = int(cfg.pop("snapshot_interval", 0))
         self.record_breakdown = bool(cfg.pop("record_breakdown", False))
-        self._stopwatch = Stopwatch() if self.record_breakdown else None
         self._bd_last_print = time.monotonic()
         self.near_quorum_reads = bool(cfg.pop("near_quorum_reads", False))
+        # telemetry plane: one registry threaded through every hub seam
+        # (host/telemetry.py); loop-stage histograms are always on — the
+        # record_breakdown knob now only controls the 5s summary print.
+        # trace_sample: every n-th proposed batch gets a slot trace
+        # (arrival → proposed → committed → applied → replied); 0 = off.
+        self.metrics = MetricsRegistry()
+        self.traces = SlotTraces(
+            self.metrics, sample_every=int(cfg.pop("trace_sample", 8))
+        )
+        self._trace_replied: List[Tuple[int, int]] = []
+        # nemesis clock-skew: wall-clock stretch factor on the tick
+        # interval (fault_ctl {"skew": f}); 1.0 = healthy
+        self._tick_scale = 1.0
 
         # control plane first: the manager assigns our id (control.rs:43)
         self.ctrl = ControlHub(manager_addr)
@@ -181,12 +200,16 @@ class ServerReplica:
                 "(see ProtocolKernel.DURABLE_SCALARS)"
             )
         self.state = self.kernel.init_state(seed=0)
+        # device metric lanes ride the jitted step's state (row `me` of
+        # the [G, R, K] block is this server's [G, K] matrix; peers'
+        # rows stay zero — each server scrapes only its own)
+        dev_telemetry.attach(self.state, self.G, self.population)
         self._step = _shared_step(self.kernel)
 
         os.makedirs(backer_dir, exist_ok=True)
         self.wal_path = os.path.join(backer_dir, f"r{self.me}.wal")
         self.snap_path = os.path.join(backer_dir, f"r{self.me}.snap")
-        self.wal = StorageHub(self.wal_path)
+        self.wal = StorageHub(self.wal_path, registry=self.metrics)
         self.statemach = StateMachine()
         self.payloads = PayloadStore(self.G)
         self.applied = [0] * self.G        # exec floor per group (own row)
@@ -325,7 +348,8 @@ class ServerReplica:
         # join after us, so one connect_to_peers snapshot is not enough.
         try:
             self.transport = TransportHub(
-                self.me, self.population, p2p_addr
+                self.me, self.population, p2p_addr,
+                registry=self.metrics,
             )
             join = CtrlMsg("new_server_join", {
                 "protocol": protocol,
@@ -366,7 +390,7 @@ class ServerReplica:
                     if time.monotonic() > deadline:
                         raise
 
-            self.external = ExternalApi(api_addr)
+            self.external = ExternalApi(api_addr, registry=self.metrics)
         except BaseException:
             # failed bring-up must release every port/handle it grabbed:
             # the supervisor retries the constructor, and a leaked p2p
@@ -697,7 +721,7 @@ class ServerReplica:
         compact.stop()
         self.wal.stop()
         os.replace(wtmp, self.wal_path)
-        self.wal = StorageHub(self.wal_path)
+        self.wal = StorageHub(self.wal_path, registry=self.metrics)
         self._logged_vids = new_logged
         self._rebuild_logged_keys()
         self._sig = None  # conservative: next tick re-logs any drift
@@ -879,6 +903,10 @@ class ServerReplica:
                 g, reqs, stride=self.population, residue=self.me
             )
             self.origin.add((g, vid))
+            # slot trace sampling: arrival is intake-stamped (within one
+            # batch interval of the socket arrival; the socket-accurate
+            # end-to-end latency is ExternalApi's api_request_latency_us)
+            self.traces.maybe_start(g, vid, self.tick, time.monotonic())
             n_prop[g] = 1
             vbase[g] = vid
             if self.codewords is not None and not (
@@ -1126,6 +1154,7 @@ class ServerReplica:
                     g, take, stride=K * R, residue=b + K * self.me
                 )
                 self.origin.add((g, vid))
+                self.traces.maybe_start(g, vid, self.tick, time.monotonic())
                 self._ep_prop_vids[g, i] = vid
                 piggy[(g, vid)] = take
             n_prop[g] = len(take_buckets)
@@ -1235,9 +1264,15 @@ class ServerReplica:
                 time.sleep(self.tick_interval)
                 continue
 
-            sw = self._stopwatch
-            if sw is not None:
-                sw.record_now(self.tick, 0, t0)
+            stage_t = t0  # run-loop stage clock (loop_stage_us histograms)
+
+            def _stage(name: str) -> None:
+                nonlocal stage_t
+                now = time.monotonic()
+                self.metrics.observe(
+                    "loop_stage_us", int((now - stage_t) * 1e6), stage=name
+                )
+                stage_t = now
 
             # 1. client intake -> payload ids (one ReqBatch per group/tick)
             if self._adaptive is not None:
@@ -1254,12 +1289,13 @@ class ServerReplica:
                     self.G, self._batch_bytes
                 )
             n_prop, vbase, piggy = self._intake()
-            if sw is not None:
-                sw.record_now(self.tick, 1)
+            _stage("intake")
 
             # 2. exchange tick frames and step the kernel
             frames = self._slice_outbox(last_out)
-            deadline = t0 + self.tick_interval
+            # _tick_scale > 1 is the nemesis clock-skew fault: this
+            # replica's tick clock runs slow relative to its peers
+            deadline = t0 + self.tick_interval * self._tick_scale
             piggy.update(self._pending_serve)
             self._pending_serve = {}
             payload_msg: Dict[str, Any] = {
@@ -1395,38 +1431,46 @@ class ServerReplica:
                 inputs["spr_override"] = jnp.asarray(
                     self._spr_tick, jnp.int32
                 )
-            if sw is not None:
-                sw.record_now(self.tick, 2)  # frame exchange + inbox
+            _stage("exchange")  # frame exchange + inbox assembly
             self.state, last_out, fx = self._step(
                 self.state, inbox, inputs
             )
-            if sw is not None:
-                sw.record_now(self.tick, 3)  # kernel step
+            _stage("step")  # kernel step
 
             # 3. durability before the acks in last_out leave (top of next
             # iteration); then apply newly committed slots + leadership
             self._log_votes()
-            if sw is not None:
-                sw.record_now(self.tick, 4)  # durable log
+            _stage("log")  # durable acceptor log
             self._apply_committed(fx)
             self._flush_durability()
             self._qread_expire()
             self._conf_progress()
             self._leader_edges(fx)
-            if sw is not None:
-                sw.record_now(self.tick, 5)  # apply + reply
+            _stage("apply")  # apply + reply
+            if self.record_breakdown:
                 now = time.monotonic()
                 if now - self._bd_last_print >= 5.0:
-                    # intake / exchange / step / log / apply stage
-                    # means+stdevs in us (parity: the reference leader
-                    # prints bd stats every 5s, multipaxos/mod.rs:932-943)
-                    stats = sw.summarize(5)
-                    names = ("intake", "exchange", "step", "log", "apply")
-                    pf_info(logger, "breakdown " + " ".join(
-                        f"{n}={m:.0f}±{s:.0f}us"
-                        for n, (m, s) in zip(names, stats)
-                    ))
-                    sw.remove_all()
+                    # stage p50/p99 over the LAST window only (parity:
+                    # the reference leader prints bd stats every 5s and
+                    # resets, multipaxos/mod.rs:932-943 — a lifetime
+                    # quantile would pin to history and hide a fresh
+                    # stall); the cumulative histograms still ride every
+                    # metrics_dump scrape untouched
+                    parts = []
+                    prev = getattr(self, "_bd_prev", {})
+                    nxt = {}
+                    for n in _STAGES:
+                        h = self.metrics.hist("loop_stage_us", stage=n)
+                        if h is None:
+                            continue
+                        win = h.since(prev.get(n))
+                        nxt[n] = h.copy()
+                        parts.append(
+                            f"{n}={win.quantile(0.5):.0f}us(p99 "
+                            f"{win.quantile(0.99):.0f})"
+                        )
+                    self._bd_prev = nxt
+                    pf_info(logger, "breakdown " + " ".join(parts))
                     self._bd_last_print = now
             self.tick += 1
             if (
@@ -1440,7 +1484,16 @@ class ServerReplica:
                     "snapshot_up_to", {"new_start": list(self.applied)}
                 ))
 
-            rem = deadline - time.monotonic()
+            now = time.monotonic()
+            rem = deadline - now
+            if self._tick_scale > 1.0:
+                # a compute-bound loop never reaches the deadline sleep,
+                # so stretching the deadline alone cannot slow the tick
+                # clock; pad by the scaled ACTUAL loop time so the
+                # victim's period is ~scale x its natural period either
+                # way (verified live: tick-advance ratio tracks the
+                # injected factor)
+                rem = max(rem, (self._tick_scale - 1.0) * (now - t0))
             if rem > 0:
                 time.sleep(rem)
 
@@ -1585,6 +1638,7 @@ class ServerReplica:
             ))
             self._wal_dirty = True
             if batch is not None:
+                self.traces.mark_committed(g, vid, self.tick)
                 mine = (g, vid) in self.origin
                 for client, req in batch:
                     res = apply_command(self.statemach._kv, req.cmd)
@@ -1592,6 +1646,12 @@ class ServerReplica:
                         self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,
                         )))
+                self.metrics.counter_add(
+                    "commits_applied_total", len(batch)
+                )
+                self.traces.mark_applied(g, vid, self.tick)
+                if mine:
+                    self._trace_replied.append((g, vid))
         return apply_fn
 
     def _apply_committed_epaxos(self) -> None:
@@ -1671,6 +1731,10 @@ class ServerReplica:
                 return
             is_marker = bool(marker[pos[0]])
             vid = 0 if is_marker else int(win_val[pos[0]])
+            if vid != 0:
+                # host-side commit observation: the slot passed under the
+                # commit bar this tick (ticks_to_commit distribution)
+                self.traces.mark_committed(g, vid, self.tick)
             batch = self._resolve_payload(g, vid)
             if vid != 0 and batch is None:
                 self.missing.add((g, vid))
@@ -1693,6 +1757,12 @@ class ServerReplica:
                         self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,
                         )))
+                self.metrics.counter_add(
+                    "commits_applied_total", len(batch)
+                )
+                self.traces.mark_applied(g, vid, self.tick)
+                if mine:
+                    self._trace_replied.append((g, vid))
             self.applied[g] = slot + 1
 
     def _flush_durability(self) -> None:
@@ -1714,6 +1784,11 @@ class ServerReplica:
         for client, reply in self._reply_queue:
             self._reply(client, reply)
         self._reply_queue.clear()
+        if self._trace_replied:
+            now = time.monotonic()
+            for g, vid in self._trace_replied:
+                self.traces.mark_replied(g, vid, now)
+            self._trace_replied.clear()
 
     def _leader_edges(self, fx) -> None:
         ex = self._last_extra
@@ -1794,7 +1869,20 @@ class ServerReplica:
                 self.transport.set_faults(p.get("net"), seed=seed)
             if "wal" in p:
                 self.wal.set_faults(p.get("wal"), seed=seed)
+            if "skew" in p:
+                # clock-skew: stretch this replica's tick interval by the
+                # given factor (None / 1.0 heals).  The device-plane
+                # analog is the duty-cycled alive mask compiled by
+                # FaultPlan (netmodel.ControlInputs.skew_alive).
+                f = p.get("skew")
+                self._tick_scale = float(f) if f else 1.0
             self.ctrl.send_ctrl(CtrlMsg("fault_reply"))
+        elif msg.kind == "metrics_dump":
+            # ctrl-plane scrape: one deterministic snapshot combining the
+            # device metric lanes, the host registry, and sampled traces
+            self.ctrl.send_ctrl(CtrlMsg(
+                "metrics_reply", {"snapshot": self.metrics_snapshot()}
+            ))
         elif msg.kind == "take_snapshot":
             self._take_snapshot()
             self.ctrl.send_ctrl(CtrlMsg("snapshot_reply"))
@@ -1804,6 +1892,33 @@ class ServerReplica:
         elif msg.kind == "leave":
             return False
         return None
+
+    def metrics_snapshot(self) -> dict:
+        """The ``metrics_dump`` scrape payload: device metric lanes (this
+        replica's [G, K] block decoded per lane), the host registry
+        (counters/gauges/histograms incl. fsync latency, request latency,
+        loop stages, ticks-to-commit), and the last sampled slot traces.
+        Everything is plain ints/lists — JSON-able, so bench/soak scripts
+        attach it verbatim to their committed artifacts."""
+        # payload-plane egress gauges are maintained as plain lists on
+        # the hot path; fold them in at scrape time
+        for dst in range(self.population):
+            if dst == self.me:
+                continue
+            self.metrics.gauge_set("pp_bytes", self.pp_bytes[dst], peer=dst)
+            self.metrics.gauge_set("pp_items", self.pp_items[dst], peer=dst)
+            self.metrics.gauge_set("cw_bytes", self.cw_bytes[dst], peer=dst)
+        return {
+            "me": self.me,
+            "protocol": self.protocol,
+            "tick": self.tick,
+            "applied": list(self.applied),
+            "device": dev_telemetry.snapshot_row(
+                self.state[dev_telemetry.TELEM_KEY], self.me
+            ),
+            "host": self.metrics.snapshot(),
+            "traces": self.traces.sampled(),
+        }
 
     def debug_state(self) -> dict:
         """One-line snapshot for wedge diagnosis (VERDICT r2 #1)."""

@@ -31,6 +31,7 @@ import pickle
 import queue
 import struct
 import threading
+import time
 from typing import Any, Optional, Tuple
 
 from ..native import load_wal
@@ -198,7 +199,8 @@ class StorageHub:
     thread owns the file, like the reference's spawned logger task.
     """
 
-    def __init__(self, path: str, prefer_native: bool = True):
+    def __init__(self, path: str, prefer_native: bool = True,
+                 registry=None):
         lib = load_wal() if prefer_native else None
         self.backend = _NativeWal(lib, path) if lib else _PyWal(path)
         self.native = lib is not None and prefer_native
@@ -206,6 +208,11 @@ class StorageHub:
         self._out: queue.Queue = queue.Queue()
         self._stop_lock = threading.Lock()
         self._stopped = False
+        # telemetry seam (host/telemetry.MetricsRegistry): fsync latency
+        # is THE durability cost — one sync point covers every append
+        # since the last (group commit), so batch size rides along
+        self.registry = registry
+        self._since_sync = 0
         # disk fault injection (host/nemesis.py): a mutable spec consulted
         # by the logger thread before each action.  None = no faults.
         self._faults: Optional[dict] = None
@@ -294,6 +301,19 @@ class StorageHub:
             f["fsync_fail"] -= 1
             raise OSError("injected: fsync failed (EIO)")
 
+    def _sync_point(self, fn):
+        """Run a durability point, timing it and closing out the group-
+        commit batch opened by the appends since the last sync."""
+        reg = self.registry
+        if reg is None:
+            return fn()
+        t0 = time.monotonic()
+        res = fn()
+        reg.observe_s("wal_fsync_us", time.monotonic() - t0)
+        reg.observe("wal_group_commit_batch", self._since_sync)
+        self._since_sync = 0
+        return res
+
     def _handle(self, a: LogAction) -> LogResult:
         self._inject_fault(a)
         b = self.backend
@@ -306,7 +326,16 @@ class StorageHub:
             return LogResult("read", entry=pickle.loads(body),
                              end_offset=end)
         if a.kind == "append":
-            end = b.append(pickle.dumps(a.entry), a.sync)
+            if self.registry is not None:
+                self.registry.counter_add("wal_appends_total")
+                self._since_sync += 1
+            if a.sync:
+                # serialize OUTSIDE the timed region: wal_fsync_us must
+                # measure durability (write + fsync), not pickling CPU
+                data = pickle.dumps(a.entry)
+                end = self._sync_point(lambda: b.append(data, True))
+            else:
+                end = b.append(pickle.dumps(a.entry), False)
             return LogResult("append", end_offset=end)
         if a.kind == "write":
             if a.offset > b.size:
@@ -323,7 +352,7 @@ class StorageHub:
             # group commit: fsync once after a batch of sync=False
             # appends (the reference batches WAL writes per batch too —
             # one durability point per ReqBatch, not per entry)
-            b.sync()
+            self._sync_point(b.sync)
             return LogResult("sync", now_size=b.size)
         raise SummersetError(f"unknown log action kind {a.kind}")
 

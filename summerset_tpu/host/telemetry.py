@@ -1,0 +1,276 @@
+"""Host metrics registry: counters, gauges, exponential-bucket histograms.
+
+The host half of the telemetry plane (the device half is
+``core/telemetry.py``'s in-kernel metric lanes).  One ``MetricsRegistry``
+per server process, threaded through every hub seam:
+
+- ``ExternalApi``   — request→reply latency, request/reply counts;
+- ``TransportHub``  — frames/bytes per peer (both directions), reconnects;
+- ``StorageHub``    — fsync latency, group-commit batch size, appends;
+- ``ServerReplica`` — run-loop stage breakdown (intake/exchange/step/log/
+  apply — the one timing system; the old ad-hoc ``record_breakdown``
+  stopwatch dict is gone), payload-plane egress gauges, and sampled
+  per-request slot traces whose ticks-to-commit distribution finally
+  measures the host-plane latency story server-side.
+
+Everything is pull-based: hub writes are lock-guarded increments; the
+``metrics_dump`` ctrl scrape (``host/server.py`` ``metrics_snapshot``)
+serializes one deterministic, JSON-able snapshot.
+
+Histogram shape: power-of-two buckets over non-negative integer samples
+(microseconds for latencies, counts for sizes): bucket ``i`` holds
+samples with ``bit_length == i`` (0 goes to bucket 0), i.e. bounds
+1, 2, 4, ... — 64 buckets cover anything an int64 can hold.  Snapshots
+emit buckets sparsely ({index: count}) plus count/sum/min/max and
+bucket-interpolated p50/p99, so committed artifacts stay small.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_NB = 64
+
+
+def _key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Histogram:
+    """Exponential (power-of-two) bucket histogram over integer samples."""
+
+    __slots__ = ("count", "total", "vmin", "vmax", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0
+        self.vmin: Optional[int] = None
+        self.vmax = 0
+        self.buckets = [0] * _NB
+
+    def observe(self, value) -> None:
+        v = max(0, int(value))
+        self.count += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        self.buckets[min(v.bit_length(), _NB - 1)] += 1
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile (0 <= q <= 1), clamped to the
+        observed [min, max] (interpolation inside the top bucket would
+        otherwise overshoot the largest sample actually seen)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * (self.count - 1)
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            if n == 0:
+                continue
+            if seen + n > rank:
+                lo = 0 if i == 0 else 1 << (i - 1)
+                hi = (1 << i) - 1
+                frac = (rank - seen) / n
+                v = lo + frac * (hi - lo)
+                return min(max(v, float(self.vmin or 0)), float(self.vmax))
+            seen += n
+        return float(self.vmax)
+
+    def since(self, prev: Optional["Histogram"]) -> "Histogram":
+        """Windowed view: a histogram of only the samples recorded after
+        ``prev`` was captured (for periodic prints that must reflect
+        RECENT behavior — lifetime-cumulative quantiles pin to history
+        and hide a fresh regression).  min/max are not delta-decodable
+        from counts, so the window inherits the cumulative ones."""
+        if prev is None:
+            return self
+        out = Histogram()
+        out.count = self.count - prev.count
+        out.total = self.total - prev.total
+        out.vmin = self.vmin
+        out.vmax = self.vmax
+        out.buckets = [
+            a - b for a, b in zip(self.buckets, prev.buckets)
+        ]
+        return out
+
+    def copy(self) -> "Histogram":
+        out = Histogram()
+        out.count = self.count
+        out.total = self.total
+        out.vmin = self.vmin
+        out.vmax = self.vmax
+        out.buckets = list(self.buckets)
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin or 0,
+            "max": self.vmax,
+            "p50": round(self.quantile(0.50), 1),
+            "p99": round(self.quantile(0.99), 1),
+            "buckets": {
+                i: n for i, n in enumerate(self.buckets) if n
+            },
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe named metrics; snapshot order is deterministic."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    # -- write side (hub seams) ---------------------------------------------
+    def counter_add(self, name: str, inc: int = 1, **labels) -> None:
+        k = _key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0) + int(inc)
+
+    def gauge_set(self, name: str, value, **labels) -> None:
+        with self._lock:
+            self._gauges[_key(name, labels)] = value
+
+    def observe(self, name: str, value, **labels) -> None:
+        """Record one histogram sample (integer units: us / bytes / n)."""
+        k = _key(name, labels)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = Histogram()
+            h.observe(value)
+
+    def observe_s(self, name: str, seconds: float, **labels) -> None:
+        """Record a latency sample given in seconds (stored as us)."""
+        self.observe(name, int(seconds * 1e6), **labels)
+
+    # -- read side -----------------------------------------------------------
+    def hist(self, name: str, **labels) -> Optional[Histogram]:
+        return self._hists.get(_key(name, labels))
+
+    def counter_value(self, name: str, **labels) -> int:
+        return self._counters.get(_key(name, labels), 0)
+
+    def names(self) -> set:
+        """Base metric names present (label suffixes stripped)."""
+        with self._lock:
+            keys = (
+                list(self._counters) + list(self._gauges) + list(self._hists)
+            )
+        return {k.split("{", 1)[0] for k in keys}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Deterministic JSON-able dump: same recorded ops -> same dict."""
+        with self._lock:
+            return {
+                "counters": {
+                    k: self._counters[k] for k in sorted(self._counters)
+                },
+                "gauges": {
+                    k: self._gauges[k] for k in sorted(self._gauges)
+                },
+                "histograms": {
+                    k: self._hists[k].snapshot()
+                    for k in sorted(self._hists)
+                },
+            }
+
+
+class SlotTraces:
+    """Sampled per-request slot traces: arrival → proposed tick →
+    committed tick → applied tick → replied, for the host serving path.
+
+    ``sample_every = n`` traces every n-th proposed batch per group (1 =
+    everything, 0 = off).  Completed traces feed the ``ticks_to_commit``
+    and ``ticks_to_apply`` histograms in the registry — the distribution
+    behind the host-plane latency cliff that client-side percentiles
+    could only hint at — and the last few full traces ride the scrape for
+    eyeballing.
+    """
+
+    KEEP = 32
+
+    def __init__(self, registry: MetricsRegistry, sample_every: int = 8):
+        self.registry = registry
+        self.sample_every = max(0, int(sample_every))
+        self._n = 0
+        self._open: Dict[Tuple[int, int], Dict[str, Any]] = {}
+        self._done: list = []
+        self._lock = threading.Lock()
+
+    def maybe_start(self, g: int, vid: int, tick: int,
+                    arrival_s: float) -> None:
+        if self.sample_every == 0:
+            return
+        with self._lock:
+            self._n += 1
+            if self._n % self.sample_every:
+                return
+            if len(self._open) >= 512:  # lost traces must not accumulate
+                self._open.clear()
+            self._open[(g, vid)] = {
+                "g": g, "vid": vid, "t_arrival_s": arrival_s,
+                "tick_proposed": tick,
+            }
+
+    def mark_committed(self, g: int, vid: int, tick: int) -> None:
+        tr = self._open.get((g, vid))
+        if tr is not None and "tick_committed" not in tr:
+            tr["tick_committed"] = tick
+            self.registry.observe(
+                "ticks_to_commit", tick - tr["tick_proposed"]
+            )
+
+    def mark_applied(self, g: int, vid: int, tick: int) -> None:
+        tr = self._open.get((g, vid))
+        if tr is not None and "tick_applied" not in tr:
+            tr["tick_applied"] = tick
+            self.registry.observe(
+                "ticks_to_apply", tick - tr["tick_proposed"]
+            )
+
+    def mark_replied(self, g: int, vid: int, now_s: float) -> None:
+        tr = self._open.pop((g, vid), None)
+        if tr is None:
+            return
+        tr["latency_ms"] = round((now_s - tr.pop("t_arrival_s")) * 1e3, 3)
+        with self._lock:
+            self._done.append(tr)
+            del self._done[: -self.KEEP]
+
+    def sampled(self) -> list:
+        with self._lock:
+            return list(self._done)
+
+
+# canonical metric names every live server must expose once it has
+# served traffic — the tier-2d smoke gate fails if one goes missing
+# (renames must update this tuple AND the README Telemetry table)
+DECLARED = (
+    "api_request_latency_us",
+    "api_requests_total",
+    "api_replies_total",
+    "api_stamps_evicted",
+    "transport_frames_sent",
+    "transport_bytes_sent",
+    "transport_frames_recv",
+    "transport_bytes_recv",
+    "transport_connects",
+    "wal_fsync_us",
+    "wal_group_commit_batch",
+    "wal_appends_total",
+    "loop_stage_us",
+    "ticks_to_commit",
+    "commits_applied_total",
+    "pp_bytes",
+    "pp_items",
+)

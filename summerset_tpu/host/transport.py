@@ -88,10 +88,16 @@ def hard_close(sock: socket.socket) -> None:
 
 
 class TransportHub:
-    def __init__(self, me: int, population: int, p2p_addr: Tuple[str, int]):
+    def __init__(self, me: int, population: int, p2p_addr: Tuple[str, int],
+                 registry=None):
         self.me = me
         self.population = population
         self.p2p_addr = p2p_addr
+        # telemetry seam (host/telemetry.MetricsRegistry): per-peer frame
+        # and byte counters both directions, plus connect events — a
+        # reconnect storm shows up as transport_connects outrunning the
+        # population
+        self.registry = registry
         self._conns: Dict[int, socket.socket] = {}
         self._wlocks: Dict[int, threading.Lock] = {}
         # live-cluster fault injection (host/nemesis.py): a FrameFaults
@@ -226,6 +232,8 @@ class TransportHub:
         )
         self._conns[peer] = sock
         self._wlocks[peer] = threading.Lock()
+        if self.registry is not None:
+            self.registry.counter_add("transport_connects", peer=peer)
         t = threading.Thread(
             target=self._messenger_recv, args=(peer, sock), daemon=True
         )
@@ -251,9 +259,19 @@ class TransportHub:
             while True:
                 (tick, payload), nbytes = safetcp.recv_msg_sync_len(sock)
                 faults = self._faults
+                if faults is not None and faults.ingress_drop(peer):
+                    # count AFTER the drop decision: a frame the fault
+                    # plane discards was never "received", exactly as
+                    # real packet loss would look in these counters
+                    continue  # deaf to this peer (one partition half)
+                if self.registry is not None:
+                    self.registry.counter_add(
+                        "transport_frames_recv", peer=peer
+                    )
+                    self.registry.counter_add(
+                        "transport_bytes_recv", nbytes, peer=peer
+                    )
                 if faults is not None:
-                    if faults.ingress_drop(peer):
-                        continue  # deaf to this peer (one partition half)
                     d = faults.ingress_delay(peer)
                     if d > 0:
                         # sleeping in the per-peer messenger delays every
@@ -295,9 +313,20 @@ class TransportHub:
                 with self._wlocks[peer]:
                     for _ in range(copies):
                         sock.sendall(buf)
+                # bytes_sent (debug_state + adaptive consumers) and the
+                # registry counter must account identically — update both
+                # here or neither
                 self.bytes_sent[peer] = (
                     self.bytes_sent.get(peer, 0) + copies * len(buf)
                 )
+                if self.registry is not None:
+                    self.registry.counter_add(
+                        "transport_frames_sent", copies, peer=peer
+                    )
+                    self.registry.counter_add(
+                        "transport_bytes_sent", copies * len(buf),
+                        peer=peer,
+                    )
             except OSError:
                 if self._conns.get(peer) is sock:
                     self._conns.pop(peer, None)

@@ -13,7 +13,7 @@ addresses of lower-id peers (the reference's proactive-connect rule,
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from ..host.messages import CtrlMsg, CtrlReply, CtrlRequest
 from ..utils import safetcp
@@ -175,10 +175,12 @@ class ClusterManager:
             )
         elif msg.kind in (
             "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
-            "fault_reply",
+            "fault_reply", "metrics_reply",
         ):
+            # waiters get (sid, payload): orchestration kinds ignore the
+            # payload, gather kinds (metrics_reply) collect it per sid
             for q in self._pending_replies.get(msg.kind, ()):
-                q.put_nowait(conn.sid)
+                q.put_nowait((conn.sid, msg.payload))
         elif msg.kind == "leave":
             await safetcp.send_msg(conn.writer, CtrlMsg("leave_reply"))
 
@@ -220,6 +222,7 @@ class ClusterManager:
         self._pending_replies.setdefault(reply_kind, []).append(q)
         payload = dict(extra or {})
         done = []
+        gathered: Dict[int, Any] = {}
         try:
             want = set()
             for s in targets:
@@ -230,14 +233,19 @@ class ClusterManager:
                     # this target died mid-fan-out; the rest still count
                     pf_warn(logger, f"{kind}: send to {s.sid} failed")
             while want:
-                sid = await asyncio.wait_for(q.get(), timeout=15.0)
+                sid, rp = await asyncio.wait_for(q.get(), timeout=15.0)
                 if sid in want:
                     want.discard(sid)
                     done.append(sid)
+                    gathered[sid] = rp
         except asyncio.TimeoutError:
             pf_warn(logger, f"{kind}: timed out waiting for replies")
         finally:
             self._pending_replies[reply_kind].remove(q)
+        if kind == "metrics_dump":
+            return CtrlReply(kind, done=done, payloads={
+                sid: rp.get("snapshot") for sid, rp in gathered.items()
+            })
         return CtrlReply(kind, done=done)
 
     async def _reset_servers(self, req: CtrlRequest) -> CtrlReply:
@@ -262,7 +270,7 @@ class ClusterManager:
                     CtrlMsg("reset_state", {"durable": req.durable}),
                 )
                 while True:  # drain until THIS sid acks
-                    got = await asyncio.wait_for(
+                    got, _rp = await asyncio.wait_for(
                         q.get(), timeout=self.ack_timeout
                     )
                     if got == sid:
@@ -338,6 +346,12 @@ class ClusterManager:
             # (host/nemesis.py composes these into seeded schedules)
             return await self._fanout_wait(
                 "fault_ctl", "fault_reply", req, extra=req.payload
+            )
+        if req.kind == "metrics_dump":
+            # telemetry scrape: gather each live server's snapshot
+            # (device metric lanes + host registry + sampled traces)
+            return await self._fanout_wait(
+                "metrics_dump", "metrics_reply", req
             )
         return CtrlReply("unknown")
 

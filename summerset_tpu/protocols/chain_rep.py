@@ -15,6 +15,7 @@ ACK messages (the reference's reply propagation).
 from __future__ import annotations
 
 import dataclasses
+from types import SimpleNamespace
 from typing import Any, Tuple
 
 import jax.numpy as jnp
@@ -194,6 +195,9 @@ class ChainRepKernel(ProtocolKernel):
         out["bw_val"] = s["win_val"]
         out["flags"] = oflags
 
+        self._accumulate_telemetry(
+            state, s, SimpleNamespace(n_new=n_new)
+        )
         fx = StepEffects(
             commit_bar=s["commit_bar"],
             exec_bar=s["exec_bar"],

@@ -287,6 +287,7 @@ class EPaxosKernel(ProtocolKernel):
         self._propose(s, c)
         self._advance_commit_rows(s, c)
         self._execute(s, c)
+        self._accumulate_telemetry(state, s, c)
         out = self._build_outbox(s, c)
         fx = self._effects(s, c)
         return s, out, fx
@@ -1252,6 +1253,32 @@ class EPaxosKernel(ProtocolKernel):
             "ro_noop": jnp.where(rec_on[..., None], o_noop, False),
             "ro_deps": jnp.where(rec_on[..., None, None], o_deps, 0),
         }
+
+    # ----------------------------------------------------------- telemetry
+    def _telemetry(self, old, s, c) -> dict:
+        """Metric lanes (core/telemetry.py SPI): the 2-D instance space
+        has no ballot/window analog of the slot protocols, so commits are
+        the committed-row delta, occupancy is the replica's OWN proposal
+        row, and recovery drives count as elections."""
+        G, R = self.G, self.R
+        tel = {
+            "commits": jnp.maximum(
+                jnp.sum(s["cmt_row"], axis=2)
+                - jnp.sum(old["cmt_row"], axis=2),
+                0,
+            ),
+            "proposals": c.n_new,
+            # a recovery takeover is the leaderless analog of a campaign
+            "elections": (s["rec_row"] >= 0) & (old["rec_row"] < 0),
+        }
+        # own-row live span (cheap proxy, see _occupancy_span): columns
+        # minted but not yet executed on this replica's own proposal row
+        idx = jnp.arange(R)
+        exec_own = s["exec_row"][:, idx, idx]
+        tel["win_occupancy_hw"] = jnp.clip(
+            s["own_next"] - exec_own, 0, self.window
+        )
+        return tel
 
     # ------------------------------------------------------------- effects
     def _effects(self, s, c):

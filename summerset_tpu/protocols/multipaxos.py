@@ -313,6 +313,9 @@ class MultiPaxosKernel(ProtocolKernel):
         self._leader_propose(s, c)
         self._advance_bars(s, c)
         out = self._build_outbox(s, c)
+        # after the outbox: send-side hooks (_extra_sends) mutate state
+        # too — lease grants live there — and telemetry reads old-vs-new
+        self._accumulate_telemetry(state, s, c)
         fx = self._effects(s, c)
         return s, out, fx
 
@@ -926,6 +929,21 @@ class MultiPaxosKernel(ProtocolKernel):
         out["bw_val"] = s["win_val"]
         out["flags"] = self._extra_sends(s, c, out, oflags)
         return out
+
+    def _telemetry(self, old, s, c) -> dict:
+        """Metric-lane contributions (core/telemetry.py SPI): ballots are
+        ``(round << 8) | id``, so a bal_max raise whose low byte equals
+        the raiser's own id is a campaign it started; any other raise is
+        a foreign adoption."""
+        tel = super()._telemetry(old, s, c)
+        raised = s["bal_max"] > old["bal_max"]
+        own = (s["bal_max"] & 255) == c.rid
+        tel["elections"] = raised & own
+        tel["ballots_adopted"] = raised & ~own
+        tel["heartbeats"] = c.hb_ok
+        # proposals (c.n_new) and win_occupancy_hw (next_slot span) are
+        # already set by the base hook
+        return tel
 
     def _effects_extra(self, s, c) -> dict:
         """Hook: protocol-specific effects fields."""

@@ -388,6 +388,16 @@ class QuorumLeasesKernel(MultiPaxosKernel):
         out["bw_cfg"] = s["win_cfg"]
         return oflags
 
+    def _telemetry(self, old, s, c) -> dict:
+        """Metric lanes (core/telemetry.py SPI): a grantor-side countdown
+        raised above its old value is a lease grant/refresh issued this
+        tick."""
+        tel = super()._telemetry(old, s, c)
+        tel["grants"] = jnp.sum(
+            (s["ql_out"] > old["ql_out"]).astype(jnp.int32), axis=2
+        )
+        return tel
+
     def _effects_extra(self, s, c):
         cfg = self.config
         R = self.R

@@ -259,6 +259,7 @@ class RaftKernel(ProtocolKernel):
         self._try_win(s, c)
         self._leader_append(s, c)
         self._advance_bars(s, c)
+        self._accumulate_telemetry(state, s, c)
         out = self._build_outbox(s, c)
         fx = self._effects(s, c)
         return s, out, fx
@@ -333,6 +334,7 @@ class RaftKernel(ProtocolKernel):
         s["cand_term"] = jnp.where(a_ok, -1, s["cand_term"])
         s["leader"] = jnp.where(a_ok, a_src, s["leader"])
         s["hb_cnt"] = jnp.where(a_ok, c.reload, s["hb_cnt"])
+        c.ae_ok = a_ok  # telemetry: accepted leader appends/heartbeats
 
         a_lo = take_src(inbox["ae_lo"], a_src)
         a_hi = take_src(inbox["ae_hi"], a_src)
@@ -723,6 +725,21 @@ class RaftKernel(ProtocolKernel):
         out["bw_val"] = s["win_val"]
         out["flags"] = self._extra_sends(s, c, out, oflags)
         return out
+
+    def _telemetry(self, old, s, c) -> dict:
+        """Metric lanes (core/telemetry.py SPI): a term raise with
+        ``voted_for == self`` is a campaign this replica started (the
+        election path votes for itself at explode); any other raise is a
+        foreign term adoption."""
+        tel = super()._telemetry(old, s, c)
+        raised = s["term"] > old["term"]
+        own = s["voted_for"] == c.rid
+        tel["elections"] = raised & own
+        tel["ballots_adopted"] = raised & ~own
+        tel["heartbeats"] = c.ae_ok
+        tel["proposals"] = c.n_new
+        tel["win_occupancy_hw"] = self._occupancy_span(s, "log_end")
+        return tel
 
     def _effects_extra(self, s, c) -> dict:
         return {}

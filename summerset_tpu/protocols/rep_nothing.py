@@ -10,6 +10,7 @@ stream lands on replica 0, the "serving node").
 from __future__ import annotations
 
 import dataclasses
+from types import SimpleNamespace
 from typing import Any, Tuple
 
 import jax.numpy as jnp
@@ -84,6 +85,9 @@ class RepNothingKernel(ProtocolKernel):
         s["commit_bar"] = s["dur_bar"]
         s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
 
+        self._accumulate_telemetry(
+            state, s, SimpleNamespace(n_new=n_new)
+        )
         fx = StepEffects(
             commit_bar=s["commit_bar"],
             exec_bar=s["exec_bar"],

@@ -16,6 +16,7 @@ MultiPaxos accept stream, minus ballots): the serving node keeps a
 from __future__ import annotations
 
 import dataclasses
+from types import SimpleNamespace
 from typing import Any, Tuple
 
 import jax.numpy as jnp
@@ -208,6 +209,9 @@ class SimplePushKernel(ProtocolKernel):
         out["bw_val"] = s["win_val"]
         out["flags"] = oflags
 
+        self._accumulate_telemetry(
+            state, s, SimpleNamespace(n_new=n_new)
+        )
         fx = StepEffects(
             commit_bar=s["commit_bar"],
             exec_bar=s["exec_bar"],

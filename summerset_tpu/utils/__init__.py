@@ -1,7 +1,7 @@
 """Leaf utility layer — no dependencies on other summerset_tpu modules.
 
 Mirrors the reference's ``src/utils/`` public surface (SURVEY.md §2.1):
-Bitmap, SummersetError, Timer, RespondersConf/KeyRangeMap, Stopwatch,
+Bitmap, SummersetError, Timer, RespondersConf/KeyRangeMap,
 LinearRegressor/PerfModel, QdiscInfo, safe TCP framing, config parsing and
 the ``pf_*`` logging helpers.
 """
@@ -11,7 +11,6 @@ from .bitmap import Bitmap
 from .config import config_field, parsed_config
 from .keyrange import KeyRangeMap, RespondersConf
 from .linreg import LinearRegressor, PerfModel
-from .stopwatch import Stopwatch
 from .timer import Timer
 from .qdisc import QdiscInfo
 
@@ -25,7 +24,6 @@ __all__ = [
     "RespondersConf",
     "LinearRegressor",
     "PerfModel",
-    "Stopwatch",
     "Timer",
     "QdiscInfo",
 ]

@@ -61,7 +61,7 @@ async def _ack_and_rejoin(man, sid, old_conn, delay=0.02):
         await asyncio.sleep(0.005)
     await asyncio.sleep(delay)
     for q in man._pending_replies.get("reset_reply", ()):
-        q.put_nowait(sid)
+        q.put_nowait((sid, {}))  # (sid, reply payload) — clusman protocol
     await asyncio.sleep(delay)
     if man.servers.get(sid) is old_conn:
         del man.servers[sid]
@@ -191,7 +191,7 @@ class TestResetServers:
             async def ack_only():
                 await asyncio.sleep(0.05)
                 for q in man._pending_replies.get("reset_reply", ()):
-                    q.put_nowait(0)
+                    q.put_nowait((0, {}))
 
             asyncio.ensure_future(ack_only())
             rep = await man._reset_servers(
@@ -216,8 +216,8 @@ class TestFanout:
             async def acks():
                 await asyncio.sleep(0.05)
                 for q in man._pending_replies.get("pause_reply", ()):
-                    q.put_nowait(0)
-                    q.put_nowait(1)
+                    q.put_nowait((0, {}))
+                    q.put_nowait((1, {}))
 
             asyncio.ensure_future(acks())
             r1, r2 = await asyncio.gather(

@@ -41,6 +41,31 @@ def test_exhaustive_depth6(protocol):
     assert r.max_committed_slots > 0
 
 
+def test_exhaustive_crossword_depth2():
+    """Crossword under exhaustion, quick tier: the coded kernel with
+    diagonal shard slicing (spr pinned — assignment_adaptive off — so
+    the enumerated fault alphabet is the only nondeterminism source).
+    The committed MODELCHECK.json row runs the same preset at depth 5."""
+    r = explore("crossword", depth=2,
+                config_overrides={"fault_tolerance": 0,
+                                  "assignment_adaptive": False})
+    assert not r.violations, r.violations
+    assert r.max_committed_slots > 0
+
+
+@pytest.mark.slow
+def test_exhaustive_crossword_depth5():
+    """The MODELCHECK.json crossword row, reproduced: depth 5 covers an
+    election + window-wrap + reconstruction round under every schedule;
+    depth 6 exceeds the tier budget (largest per-node state of the
+    family — per-slot shard tallies)."""
+    r = explore("crossword", depth=5,
+                config_overrides={"fault_tolerance": 0,
+                                  "assignment_adaptive": False})
+    assert not r.violations, r.violations
+    assert r.max_committed_slots > 0
+
+
 @pytest.mark.slow
 def test_exhaustive_rspaxos_depth6():
     """RSPaxos under exhaustion — the kernel whose lagging-exec step-up

@@ -137,6 +137,61 @@ class TestDeviceCompile:
             assert m["alive"].all() and m["link_up"].all()
 
 
+class TestClockSkew:
+    def test_duty_cycle_matches_rate_and_is_deterministic(self):
+        from summerset_tpu.core.netmodel import ControlInputs
+
+        for rate in (0.3, 0.5, 0.75, 1.0):
+            a = np.asarray(ControlInputs.skew_alive(2, 3, 200, {1: rate}))
+            b = np.asarray(ControlInputs.skew_alive(2, 3, 200, {1: rate}))
+            assert (a == b).all()
+            # victim steps at ~rate; everyone else every tick
+            frac = a[:, 0, 1].mean()
+            assert abs(frac - rate) < 0.02, (rate, frac)
+            assert a[:, :, [0, 2]].all()
+        # offset phases continuously: [lo, hi) window == slice of full
+        full = np.asarray(ControlInputs.skew_alive(1, 3, 100, {2: 0.4}))
+        win = np.asarray(
+            ControlInputs.skew_alive(1, 3, 30, {2: 0.4}, offset=50)
+        )
+        assert (win == full[50:80]).all()
+
+    def test_compile_device_lowers_skew(self):
+        from summerset_tpu.host.nemesis import FaultEvent
+
+        p = FaultPlan(
+            seed=0, population=3, ticks=40,
+            events=(FaultEvent(10, "clock_skew", (1,), 20, 0.5),),
+        )
+        m = p.compile_device(2)
+        alive = np.asarray(m["alive"])
+        assert alive[:10].all() and alive[30:].all()  # healthy outside
+        frac = alive[10:30, :, 1].mean()
+        assert 0.4 <= frac <= 0.6, frac
+        assert alive[10:30, :, [0, 2]].all()  # only the victim skews
+
+    def test_host_actions_emit_skew_and_heal(self):
+        from summerset_tpu.host.nemesis import FaultEvent
+
+        p = FaultPlan(
+            seed=0, population=3, ticks=40,
+            events=(FaultEvent(5, "clock_skew", (2,), 10, 0.4),),
+        )
+        acts = [a for a in p.host_actions() if a[1] == "skew"]
+        assert len(acts) == 2
+        (t0, _, _, s0), (t1, _, _, s1) = acts
+        assert (t0, t1) == (5, 15)
+        assert s0 == {"servers": [2], "factor": 2.5}
+        assert s1["factor"] is None  # heal restores the tick clock
+
+    def test_generated_plans_include_skew_deterministically(self):
+        a = FaultPlan.generate(11, 5, 300, classes=("clock_skew",))
+        b = FaultPlan.generate(11, 5, 300, classes=("clock_skew",))
+        assert a.timeline() == b.timeline()
+        assert all(e.kind == "clock_skew" for e in a.events)
+        assert all(0.3 <= e.arg <= 0.8 for e in a.events)
+
+
 class TestHostActions:
     def test_duration_events_emit_heals(self):
         p = FaultPlan.generate(3, 5, 200, classes=ALL_CLASSES)

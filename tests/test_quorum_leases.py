@@ -169,6 +169,60 @@ class TestPartitionSafety:
         check_agreement(st, G, R, W)
 
 
+class TestClockSkew:
+    def test_local_reads_stay_safe_under_skew(self):
+        """Nemesis clock-skew regression (ROADMAP open item): one
+        responder's tick clock runs at half rate (duty-cycled alive —
+        its lease countdowns crawl, exactly the dangerous direction:
+        the holder believes its lease longer than the grantors do).
+
+        Safety invariant checked at EVERY collected tick: whenever the
+        skewed responder could serve ALL buckets locally (lease held +
+        fully quiescent), no replica anywhere has committed a slot the
+        responder has not executed — the write barrier (every leased
+        write needs the responder's applied ack) must hold under skew,
+        or a local read would return a stale value.  Liveness: commits
+        still advance, and agreement holds at the end."""
+        G, R, W, P = 2, 5, 48, 2
+        k = make_kernel(G, R, W, P, lease_len=12, lease_margin=4,
+                        num_key_buckets=8,
+                        hear_timeout_lo=40, hear_timeout_hi=70)
+        eng = Engine(k, seed=5)
+        state, ns = eng.init()
+        conf = 0b00110  # responders {1, 2}
+        state, ns, _ = run_with_conf(eng, state, ns, 30, P, conf)
+        pre = int(np.asarray(state["commit_bar"]).max())
+
+        T = 160
+        skew = ControlInputs.skew_alive(G, R, T, {2: 0.5})
+        t = jnp.arange(T, dtype=jnp.int32)
+        seq = {
+            "n_proposals": jnp.full((T, G), P, jnp.int32),
+            "value_base": jnp.broadcast_to(
+                ((1000 + t) * P)[:, None], (T, G)
+            ),
+            "conf_target": jnp.full((T, G), conf, jnp.int32),
+            "alive": skew,
+        }
+        state, ns, fx = eng.run_ticks(state, ns, seq, collect=True)
+        st = {k_: np.asarray(v) for k_, v in state.items()}
+        lease = np.asarray(fx.extra["lease_held"])        # [T, G, R]
+        nloc = np.asarray(fx.extra["n_local_buckets"])    # [T, G, R]
+        cb = np.asarray(fx.commit_bar)                    # [T, G, R]
+        eb = np.asarray(fx.exec_bar)
+        servable = lease[:, :, 2] & (nloc[:, :, 2] == 8)
+        stale = servable & (cb.max(axis=2) > eb[:, :, 2])
+        assert not stale.any(), (
+            "skewed responder could serve a local read while lagging "
+            f"committed state at ticks {np.nonzero(stale.any(axis=1))[0]}"
+        )
+        # liveness under skew: the write plane keeps committing
+        assert int(st["commit_bar"].max()) > pre + 20, (
+            pre, st["commit_bar"],
+        )
+        check_agreement(st, G, R, W)
+
+
 class TestLeaderLease:
     def test_leader_reads_and_stability(self):
         G, R, W, P = 2, 5, 32, 2

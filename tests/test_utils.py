@@ -13,7 +13,6 @@ from summerset_tpu.utils import (
     PerfModel,
     QdiscInfo,
     RespondersConf,
-    Stopwatch,
     SummersetError,
     Timer,
     parsed_config,
@@ -214,22 +213,6 @@ class TestTimer:
 
         asyncio.run(run())
         assert fired == [1]
-
-
-# ------------------------------------------------------------- stopwatch ----
-class TestStopwatch:
-    def test_summarize(self):
-        sw = Stopwatch()
-        for rec in range(3):
-            sw.record_now(rec, 0, ts=0.0)
-            sw.record_now(rec, 1, ts=0.001 * (rec + 1))
-            sw.record_now(rec, 2, ts=0.001 * (rec + 1) + 0.002)
-        stats = sw.summarize(2)
-        assert math.isclose(stats[0][0], 2000.0, rel_tol=1e-6)  # mean of 1/2/3 ms
-        assert math.isclose(stats[1][0], 2000.0, rel_tol=1e-6)
-        assert stats[1][1] == pytest.approx(0.0, abs=1e-6)
-        sw.remove_all()
-        assert not sw.has_record(0)
 
 
 # ---------------------------------------------------------------- linreg ----
