@@ -1,0 +1,252 @@
+"""Telemetry plane tests: the host metrics registry (counter / gauge /
+histogram bucket math, snapshot determinism), sampled slot traces, and
+the in-kernel device metric lanes (core/telemetry.py) — accumulation
+semantics, netmodel drop accounting, freeze behavior, and the lane-free
+ablation variant.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from summerset_tpu.core import Engine
+from summerset_tpu.core import telemetry as dev
+from summerset_tpu.core.netmodel import ControlInputs
+from summerset_tpu.host.telemetry import (
+    DECLARED,
+    Histogram,
+    MetricsRegistry,
+    SlotTraces,
+)
+from summerset_tpu.protocols import make_protocol
+from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
+
+
+# ------------------------------------------------------------- registry ----
+class TestHistogram:
+    def test_bucket_math(self):
+        h = Histogram()
+        for v in (0, 1, 2, 3, 4, 7, 8, 1023, 1024):
+            h.observe(v)
+        assert h.count == 9
+        assert h.total == 0 + 1 + 2 + 3 + 4 + 7 + 8 + 1023 + 1024
+        assert h.vmin == 0 and h.vmax == 1024
+        # power-of-two buckets by bit_length: 0->b0, 1->b1, 2,3->b2,
+        # 4..7->b3, 8->b4, 1023->b10, 1024->b11
+        assert h.buckets[0] == 1
+        assert h.buckets[1] == 1
+        assert h.buckets[2] == 2
+        assert h.buckets[3] == 2
+        assert h.buckets[4] == 1
+        assert h.buckets[10] == 1
+        assert h.buckets[11] == 1
+
+    def test_quantiles_monotone_and_bounded(self):
+        h = Histogram()
+        for v in range(1, 1000):
+            h.observe(v)
+        q = [h.quantile(x) for x in (0.0, 0.25, 0.5, 0.9, 0.99, 1.0)]
+        assert q == sorted(q)
+        assert q[-1] <= h.vmax
+        # p50 of 1..999 sits in the right bucket neighborhood
+        assert 256 <= h.quantile(0.5) <= 1023
+
+    def test_negative_clamped(self):
+        h = Histogram()
+        h.observe(-5)
+        assert h.vmin == 0 and h.buckets[0] == 1
+
+    def test_windowed_since_reflects_recent_samples_only(self):
+        h = Histogram()
+        for _ in range(1000):
+            h.observe(10)       # long healthy history
+        prev = h.copy()
+        for _ in range(50):
+            h.observe(100000)   # fresh regression
+        win = h.since(prev)
+        assert win.count == 50
+        # lifetime p50 stays pinned at history; the window sees the stall
+        assert h.quantile(0.5) < 20
+        assert win.quantile(0.5) > 10000
+        assert h.since(None) is h
+
+    def test_snapshot_sparse_buckets(self):
+        h = Histogram()
+        h.observe(1 << 20)
+        snap = h.snapshot()
+        assert snap["buckets"] == {21: 1}
+        assert snap["count"] == 1 and snap["sum"] == 1 << 20
+
+
+class TestRegistry:
+    def _fill(self, reg):
+        reg.counter_add("reqs")
+        reg.counter_add("reqs", 4)
+        reg.counter_add("frames", 2, peer=1)
+        reg.counter_add("frames", 3, peer=0)
+        reg.gauge_set("depth", 7.5)
+        for v in (10, 20, 400):
+            reg.observe("lat_us", v, stage="step")
+        reg.observe_s("lat_s", 0.001)
+
+    def test_counters_and_labels(self):
+        reg = MetricsRegistry()
+        self._fill(reg)
+        assert reg.counter_value("reqs") == 5
+        assert reg.counter_value("frames", peer=1) == 2
+        assert reg.counter_value("frames", peer=0) == 3
+        assert reg.counter_value("missing") == 0
+        assert reg.hist("lat_us", stage="step").count == 3
+        assert reg.hist("lat_s").total == 1000
+
+    def test_names_strip_labels(self):
+        reg = MetricsRegistry()
+        self._fill(reg)
+        assert reg.names() == {
+            "reqs", "frames", "depth", "lat_us", "lat_s"
+        }
+
+    def test_snapshot_deterministic(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        self._fill(a)
+        self._fill(b)
+        # identical recorded ops -> byte-identical serialized snapshot
+        assert json.dumps(a.snapshot()) == json.dumps(b.snapshot())
+
+    def test_declared_names_are_unique(self):
+        assert len(DECLARED) == len(set(DECLARED))
+
+
+class TestSlotTraces:
+    def test_trace_lifecycle_feeds_histograms(self):
+        reg = MetricsRegistry()
+        tr = SlotTraces(reg, sample_every=1)
+        tr.maybe_start(0, 5, tick=10, arrival_s=1.0)
+        tr.mark_committed(0, 5, tick=14)
+        tr.mark_committed(0, 5, tick=15)  # idempotent: first wins
+        tr.mark_applied(0, 5, tick=14)
+        tr.mark_replied(0, 5, now_s=1.5)
+        h = reg.hist("ticks_to_commit")
+        assert h.count == 1 and h.total == 4
+        done = tr.sampled()
+        assert len(done) == 1
+        assert done[0]["tick_committed"] == 14
+        assert done[0]["latency_ms"] == pytest.approx(500.0)
+
+    def test_sampling_rate(self):
+        reg = MetricsRegistry()
+        tr = SlotTraces(reg, sample_every=4)
+        for vid in range(1, 17):
+            tr.maybe_start(0, vid, tick=0, arrival_s=0.0)
+        assert len(tr._open) == 4  # every 4th
+        tr0 = SlotTraces(reg, sample_every=0)
+        tr0.maybe_start(0, 1, tick=0, arrival_s=0.0)
+        assert not tr0._open
+
+    def test_unknown_marks_are_noops(self):
+        reg = MetricsRegistry()
+        tr = SlotTraces(reg, sample_every=1)
+        tr.mark_committed(3, 9, tick=1)
+        tr.mark_replied(3, 9, now_s=1.0)
+        assert reg.hist("ticks_to_commit") is None
+
+
+# ----------------------------------------------------------- device lanes --
+class TestDeviceLanes:
+    def test_accumulate_counters_add_and_gauges_max(self):
+        t = dev.zero_block(2, 3)
+        one = jnp.ones((2, 3), jnp.int32)
+        t = dev.accumulate(t, {"commits": one * 2, "win_occupancy_hw": one * 5})
+        t = dev.accumulate(t, {"commits": one, "win_occupancy_hw": one * 3})
+        blk = np.asarray(t)
+        assert (blk[:, :, dev.LANE_IDX["commits"]] == 3).all()
+        assert (blk[:, :, dev.LANE_IDX["win_occupancy_hw"]] == 5).all()
+
+    def test_unknown_lane_rejected(self):
+        t = dev.zero_block(1, 1)
+        with pytest.raises(KeyError):
+            dev.accumulate(t, {"not_a_lane": jnp.ones((1, 1), jnp.int32)})
+
+    def test_bool_contributions_coerce(self):
+        t = dev.zero_block(1, 2)
+        t = dev.bump(t, "heartbeats", jnp.array([[True, False]]))
+        assert np.asarray(t)[0, :, dev.LANE_IDX["heartbeats"]].tolist() \
+            == [1, 0]
+
+    def _engine(self, G=2, R=3, W=16):
+        cfg = ReplicaConfigMultiPaxos(max_proposals_per_tick=2)
+        return Engine(make_protocol("multipaxos", G, R, W, cfg))
+
+    def _seq(self, T, G, P=2, **extra):
+        t = jnp.arange(T, dtype=jnp.int32)
+        seq = {
+            "n_proposals": jnp.full((T, G), P, jnp.int32),
+            "value_base": jnp.broadcast_to(((t) * P)[:, None], (T, G)),
+        }
+        seq.update(extra)
+        return seq
+
+    def test_lanes_track_commits_and_occupancy(self):
+        eng = self._engine()
+        state, ns = eng.init()
+        assert "telem" in state
+        state, ns, _ = eng.run_ticks(state, ns, self._seq(30, 2))
+        blk = np.asarray(state["telem"])
+        cb = np.asarray(state["commit_bar"])
+        # the commits lane is exactly the committed-slot count (from 0)
+        assert (blk[:, :, dev.LANE_IDX["commits"]] == cb).all()
+        # occupancy high-water is bounded by the window
+        assert (blk[:, :, dev.LANE_IDX["win_occupancy_hw"]] <= 16).all()
+        # leader proposed; followers heard heartbeats
+        assert blk[:, 0, dev.LANE_IDX["proposals"]].sum() > 0
+        assert blk[:, 1:, dev.LANE_IDX["heartbeats"]].sum() > 0
+
+    def test_net_drop_lane_counts_masked_sends(self):
+        eng = self._engine(G=1)
+        state, ns = eng.init()
+        T = 16
+        link = ControlInputs.one_way_down(1, 3, 0, 1)
+        seq = self._seq(
+            T, 1,
+            alive=jnp.broadcast_to(jnp.ones((1, 3), jnp.bool_), (T, 1, 3)),
+            link_up=jnp.broadcast_to(link, (T, 1, 3, 3)),
+        )
+        state, ns, _ = eng.run_ticks(state, ns, seq)
+        blk = np.asarray(state["telem"])
+        # src 0 loses its 0->1 sends; a dead link is a drop, every tick
+        assert blk[0, 0, dev.LANE_IDX["net_drops"]] > 0
+        assert blk[0, 2, dev.LANE_IDX["net_drops"]] == 0
+
+    def test_paused_replica_lanes_freeze(self):
+        eng = self._engine(G=1)
+        state, ns = eng.init()
+        T = 16
+        alive = jnp.ones((1, 3), jnp.bool_).at[:, 2].set(False)
+        seq = self._seq(
+            T, 1,
+            alive=jnp.broadcast_to(alive, (T, 1, 3)),
+            link_up=jnp.broadcast_to(
+                ControlInputs.links_all_up(1, 3), (T, 1, 3, 3)
+            ),
+        )
+        state, ns, _ = eng.run_ticks(state, ns, seq)
+        assert np.asarray(state["telem"])[0, 2].sum() == 0
+
+    def test_ablation_variant_runs_without_lanes(self):
+        eng = self._engine(G=1)
+        state, ns = eng.init()
+        state.pop("telem")
+        state, ns, _ = eng.run_ticks(state, ns, self._seq(10, 1))
+        assert "telem" not in state
+        assert int(np.asarray(state["commit_bar"]).max()) > 0
+
+    def test_snapshot_row_decodes_block(self):
+        t = dev.zero_block(2, 3)
+        t = t.at[:, 1, dev.LANE_IDX["commits"]].set(jnp.int32(7))
+        t = t.at[0, 1, dev.LANE_IDX["win_occupancy_hw"]].set(jnp.int32(9))
+        snap = dev.snapshot_row(t, 1)
+        assert snap["lanes"]["commits"] == 14          # counters sum over G
+        assert snap["lanes"]["win_occupancy_hw"] == 9  # high-water maxes
+        assert snap["per_group"]["commits"] == [7, 7]
