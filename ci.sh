@@ -22,6 +22,12 @@
 #         cost >5% of a steady tick (ablation) or a declared metric
 #         name is missing from a live cluster's metrics_dump scrape;
 #         regenerates TELEMETRY.json as a side effect.
+# Tier 2e: graftlint — the kernel-contract verifier (C1-C9), the
+#         flags-taint pass (T1/T9), and the host-plane concurrency
+#         lint (H101-H104) against the committed LINT.json baseline:
+#         fails on any new finding OR on baseline drift (regenerate
+#         with scripts/graftlint.py and commit the diff), then runs
+#         the linter's own fast test suite.
 # Tier 3 (--full): every slow-marked fault-scenario kernel test and the
 #         randomized property sweep.
 set -e
@@ -43,6 +49,10 @@ python scripts/nemesis_soak.py --matrix
 
 echo "=== tier 2d: telemetry plane (lane overhead + scrape smoke) ==="
 python scripts/telemetry_smoke.py
+
+echo "=== tier 2e: graftlint (kernel contract + flags-taint + host lint) ==="
+python scripts/graftlint.py --check
+python -m pytest tests/test_graftlint.py -q -m "not slow"
 
 if [ "$1" = "--full" ]; then
   echo "=== tier 3: full superset (slow tests included) ==="

@@ -1,0 +1,177 @@
+#!/usr/bin/env python3
+"""graftlint: kernel-contract verifier + host concurrency lint (CI tier 2e).
+
+Runs the three static passes of ``summerset_tpu/analysis`` over the
+whole repo and writes the deterministic ``LINT.json`` baseline:
+
+1. contract  — every registered protocol kernel against the
+               machine-readable ``KERNEL_CONTRACT`` rules (C1–C9);
+2. taint     — the flags-taint dataflow pass (T1, stale-suppression T9);
+3. host      — the AST concurrency lint over host/manager/utils
+               (H101–H104, inline ``# graftlint: disable=... -- reason``
+               suppressions).
+
+Usage:
+    python scripts/graftlint.py                # run all, write LINT.json
+    python scripts/graftlint.py --check        # CI: fail on findings OR
+                                               # drift vs committed LINT.json
+    python scripts/graftlint.py --only taint --kernel Raft -v
+
+Exit status: 0 = clean (and, with --check, baseline matches); 1 = any
+finding, pass error, or baseline drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from summerset_tpu import protocols  # noqa: E402
+from summerset_tpu.analysis import (  # noqa: E402
+    assemble_report,
+    dumps_report,
+    lint_host,
+    verify_kernel,
+    verify_kernel_taint,
+)
+
+PKG_ROOT = os.path.join(REPO, "summerset_tpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "LINT.json"))
+    ap.add_argument("--check", action="store_true",
+                    help="compare against the committed baseline instead "
+                         "of rewriting it; fail on findings or drift")
+    ap.add_argument("--only", action="append",
+                    choices=("contract", "taint", "host"),
+                    help="run a subset of passes (console only; LINT.json "
+                         "is neither written nor checked)")
+    ap.add_argument("--kernel", action="append",
+                    help="restrict kernel passes to these protocol names")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    passes = set(args.only or ("contract", "taint", "host"))
+    partial = bool(args.only) or bool(args.kernel)
+    if args.check and partial:
+        ap.error("--check needs the full run: it compares the whole "
+                 "LINT.json baseline, so it cannot be combined with "
+                 "--only/--kernel")
+    names = protocols.protocol_names()
+    if args.kernel:
+        want = {k.lower() for k in args.kernel}
+        unknown = want - set(names)
+        if unknown:
+            ap.error(f"unknown kernels {sorted(unknown)}; have {names}")
+        names = [n for n in names if n in want]
+
+    kernels = {}
+    n_findings = 0
+    for lname in names:
+        kres = {}
+        if "contract" in passes:
+            kres["contract"] = verify_kernel(protocols.make_protocol,
+                                             lname)
+        if "taint" in passes:
+            kres["taint"] = verify_kernel_taint(protocols.make_protocol,
+                                                lname)
+        if not kres:
+            continue
+        # report under the registered display name, not the lowered key
+        disp = protocols.protocol_display_name(lname)
+        kernels[disp] = kres
+        for pname, pres in sorted(kres.items()):
+            status = "pass" if pres.ok else "FAIL"
+            supp = f" ({len(pres.suppressed)} suppressed)" \
+                if pres.suppressed else ""
+            print(f"{disp:>14s} {pname:<9s} {status}{supp}")
+            for f in pres.findings:
+                n_findings += 1
+                print(f"    {f.render()}")
+            if pres.error:
+                n_findings += 1
+                print(f"    ERROR {pres.error}")
+            if args.verbose:
+                for f, reason in pres.suppressed:
+                    print(f"    suppressed {f.render()}\n"
+                          f"        reason: {reason}")
+
+    if "host" in passes:
+        host, n_files = lint_host(PKG_ROOT)
+        status = "pass" if host.ok else "FAIL"
+        print(f"{'host-plane':>14s} astlint   {status} "
+              f"({n_files} files, {len(host.suppressed)} suppressed)")
+        for f in host.findings:
+            n_findings += 1
+            print(f"    {f.render()}")
+        if args.verbose:
+            for f, reason in host.suppressed:
+                print(f"    suppressed {f.render()}\n"
+                      f"        reason: {reason}")
+    else:
+        host, n_files = None, 0
+
+    if partial:
+        print(f"graftlint (partial): {n_findings} finding(s)")
+        return 1 if n_findings else 0
+
+    doc = assemble_report(kernels, host, n_files)
+    text = dumps_report(doc)
+    if args.check:
+        try:
+            with open(args.out, "r") as f:
+                committed = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"graftlint --check: cannot read baseline "
+                  f"{args.out}: {e}")
+            return 1
+        if committed != doc:
+            print(f"graftlint --check: DRIFT against {args.out} — "
+                  "regenerate with scripts/graftlint.py and commit the "
+                  "diff with the change that caused it")
+            _print_drift(committed, doc)
+            return 1
+        print(f"graftlint --check: baseline matches ({args.out})")
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    clean = doc["summary"]["clean"]
+    print(f"graftlint: {'CLEAN' if clean else 'FINDINGS'} "
+          f"({doc['summary']['kernels_verified']} kernels, "
+          f"{n_findings} finding(s))")
+    return 0 if clean else 1
+
+
+def _print_drift(old, new, path="") -> None:
+    """Shallow recursive diff, enough to locate the drifting key."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        for k in sorted(set(old) | set(new)):
+            if k not in old:
+                print(f"  + {path}/{k}")
+            elif k not in new:
+                print(f"  - {path}/{k}")
+            elif old[k] != new[k]:
+                _print_drift(old[k], new[k], f"{path}/{k}")
+    elif isinstance(old, list) and isinstance(new, list):
+        print(f"  ~ {path}: list differs "
+              f"({len(old)} -> {len(new)} entries)")
+    else:
+        print(f"  ~ {path}: {old!r} -> {new!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,12 +48,12 @@ set_cpu_devices(8)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
 # the acceptance matrix: 3 seeds x the leader-log / term-vote / coded
-# protocol families, under crash + partition + disk schedules
+# protocol families, under crash + partition + disk + clock schedules
 MATRIX_PROTOCOLS = ("MultiPaxos", "Raft", "RSPaxos")
 MATRIX_SEEDS = (1, 2, 3)
 SOAK_CLASSES = (
     "crash", "partition", "isolate", "one_way", "drop", "pause",
-    "wal_torn", "wal_fsync",
+    "wal_torn", "wal_fsync", "clock_skew",
 )
 
 

@@ -1,0 +1,35 @@
+"""graftlint: static verification of the kernel SPI contract + host lint.
+
+Three passes, one committed baseline (``LINT.json``), one CI tier
+(``ci.sh`` tier 2e → ``scripts/graftlint.py --check``):
+
+- :mod:`.contract` — the kernel-contract verifier: every registered
+  :class:`~summerset_tpu.core.protocol.ProtocolKernel` is traced at a
+  small static geometry and checked against the machine-readable
+  ``KERNEL_CONTRACT`` rules (state/outbox geometry and dtypes, durable
+  declarations, jaxpr purity, scan-carry stability, telemetry write
+  path).
+- :mod:`.taint` — the flags-taint pass: a dataflow walk over the step
+  jaxpr proving every inbox read that lands in state passed a
+  ``flags``-derived gate; intentional flows are declared per kernel in
+  ``TAINT_ALLOW``.
+- :mod:`.hostlint` — AST concurrency lint over ``host/``, ``manager/``,
+  ``utils/``: lock-held blocking calls, non-daemon threads, wallclock /
+  unseeded RNG in seeded-determinism scopes, fsync outside StorageHub.
+
+The paper-side motivation (PAPERS.md): protocol-parallel optimization
+porting (arxiv 1905.10786) only works when the shared substrate contract
+is *checkable*, and compartmentalized SMR (arxiv 2012.15762) multiplies
+the number of independently evolving components that can silently break
+it.
+"""
+
+from .contract import verify_kernel  # noqa: F401
+from .hostlint import lint_host  # noqa: F401
+from .report import (  # noqa: F401
+    Finding,
+    PassResult,
+    assemble_report,
+    dumps_report,
+)
+from .taint import verify_kernel_taint  # noqa: F401

@@ -1,0 +1,521 @@
+"""Kernel-contract verifier: static checks over traced protocol kernels.
+
+The SPI contract in ``core/protocol.py`` (``KERNEL_CONTRACT`` rules
+C1–C9) used to live only in the module docstring, silently trusted by
+every registered kernel and every plane stacked on top (engine freeze
+masks, netmodel delivery, WAL durable records, telemetry lanes).  This
+module checks it mechanically: each registered kernel is constructed at
+a small static geometry, its ``init_state``/``zero_outbox`` pytrees are
+inspected directly, and ``step`` is traced with ``jax.make_jaxpr`` /
+``jax.eval_shape`` — no compilation, no device execution, so the whole
+pass runs in seconds on a cold cache.
+
+Checks are deliberately expressed against what the runtime actually
+relies on (netmodel transposes axis 1/2 of non-broadcast lanes, the
+engine freeze mask reshapes on leading ``[G, R]``, the WAL logs
+``DURABLE_*`` rows, ``lax.scan`` carries the state structure) rather
+than the looser prose they replaced.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import inspect
+import os
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import telemetry
+from ..core.protocol import KERNEL_CONTRACT, ProtocolKernel
+from .report import Finding, PassResult
+
+#: rule code -> short name, straight from the SPI's own table.  Both
+#: kernel passes (contract + taint) mint findings through
+#: :func:`rule_finding`, so a check can only emit codes the
+#: ``KERNEL_CONTRACT`` table declares — the table IS consumed, not
+#: parallel documentation that could drift from the checks.
+CONTRACT_RULES: Dict[str, str] = {
+    code: slug for code, slug, _ in KERNEL_CONTRACT
+}
+
+
+def rule_finding(code: str, where: str, scope: str, message: str,
+                 line: int = 0) -> Finding:
+    if code not in CONTRACT_RULES:
+        raise KeyError(
+            f"finding code {code!r} is not declared in "
+            "core.protocol.KERNEL_CONTRACT — add the rule to the table "
+            "before emitting it"
+        )
+    return Finding(code, where, scope, message, line=line)
+
+# geometry small enough that tracing EPaxos's [G, R, R, W, R] lanes stays
+# cheap, large enough that G/R/W are mutually distinct (shape checks
+# can't pass by coincidence: 2 != 3 != 8)
+VERIFY_G, VERIFY_R, VERIFY_W = 2, 3, 8
+PROP_WIDTH = 4  # [G, P] input lanes ("gp" shape code)
+
+# primitives that must never appear in a protocol step/init jaxpr: host
+# round-trips and XLA's stateful (nondeterministic) RNG would both break
+# the lockstep replay/model-check/nemesis determinism contracts
+FORBIDDEN_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+    "rng_uniform",
+})
+
+_INPUT_SHAPES = {
+    "g": lambda G, R: (G,),
+    "gr": lambda G, R: (G, R),
+    "grr": lambda G, R: (G, R, R),
+    "gp": lambda G, R: (G, PROP_WIDTH),
+}
+
+
+def build_kernel(make_protocol, name: str, variant: str = "device",
+                 G: int = VERIFY_G, R: int = VERIFY_R,
+                 W: int = VERIFY_W) -> ProtocolKernel:
+    """Construct a kernel at verification geometry.
+
+    ``variant="device"`` is the defaults path; ``variant="host"`` flips
+    the host-serving knobs the config exposes (``exec_follows_commit``
+    off, leader leases on) so the serving-mode branches trace too.
+    """
+    probe = make_protocol(name, G, R, 64)
+    cfg = getattr(probe, "config", None)
+    if not dataclasses.is_dataclass(cfg):
+        return make_protocol(name, G, R, W)
+    overrides: Dict[str, Any] = {}
+    if hasattr(cfg, "max_proposals_per_tick"):
+        overrides["max_proposals_per_tick"] = min(
+            cfg.max_proposals_per_tick, W // 2
+        )
+    if variant == "host":
+        if hasattr(cfg, "exec_follows_commit"):
+            overrides["exec_follows_commit"] = False
+        if hasattr(cfg, "leader_leases"):
+            # QL/Bodega carry their own (always-on) lease planes and
+            # refuse the base MultiPaxos flag — fall back without it
+            try:
+                return make_protocol(
+                    name, G, R, W,
+                    dataclasses.replace(
+                        cfg, leader_leases=True, **overrides
+                    ),
+                )
+            except ValueError:
+                pass
+    cfg = dataclasses.replace(cfg, **overrides)
+    return make_protocol(name, G, R, W, cfg)
+
+
+def host_variant_differs(kernel: ProtocolKernel) -> bool:
+    cfg = getattr(kernel, "config", None)
+    return hasattr(cfg, "exec_follows_commit") or hasattr(
+        cfg, "leader_leases"
+    )
+
+
+def build_inputs(kernel: ProtocolKernel) -> Dict[str, Any]:
+    """The step() input superset for this kernel: the base lanes every
+    kernel consumes plus its declared ``EXTRA_INPUTS`` — providing the
+    optional lanes makes the optional paths (conf planes, spr overrides,
+    host-mode proposal lists) part of the traced surface."""
+    G, R = kernel.G, kernel.R
+    i32 = jnp.int32
+    inputs: Dict[str, Any] = {
+        "n_proposals": jnp.ones((G,), i32),
+        "value_base": jnp.ones((G,), i32),
+        "exec_floor": jnp.zeros((G, R), i32),
+    }
+    for name, code in kernel.EXTRA_INPUTS:
+        if code not in _INPUT_SHAPES:
+            raise ValueError(
+                f"{type(kernel).__name__}.EXTRA_INPUTS: unknown shape "
+                f"code {code!r} for {name!r}"
+            )
+        inputs[name] = jnp.zeros(_INPUT_SHAPES[code](G, R), i32)
+    return inputs
+
+
+# both passes (contract + taint) and both config variants trace the
+# same step surface; keyed on (class, geometry, config repr) so one
+# graftlint run — or one pytest session — traces each surface once
+_TRACE_CACHE: Dict[Tuple, Tuple] = {}
+
+
+def trace_step(kernel: ProtocolKernel):
+    """(closed_jaxpr, in_paths, out_paths, out_shapes, state) for one
+    abstract step.
+
+    ``in_paths``/``out_paths`` name every flattened invar/outvar as
+    ``(tree_index, leaf_name)`` — tree index 0/1/2 = state/inbox/inputs
+    on the way in, state/outbox/effects on the way out.  ``state`` is the
+    telemetry-attached input state the trace ran against."""
+    key = (type(kernel), kernel.G, kernel.R, kernel.W,
+           repr(getattr(kernel, "config", None)))
+    hit = _TRACE_CACHE.get(key)
+    if hit is None:
+        hit = _TRACE_CACHE[key] = _trace_step(kernel)
+    return hit
+
+
+def _trace_step(kernel: ProtocolKernel):
+    state = telemetry.attach(
+        kernel.init_state(seed=0), kernel.G, kernel.R
+    )
+    inbox = kernel.zero_outbox()  # pair lanes are [G,R,R]: transpose-free
+    inputs = build_inputs(kernel)
+
+    def step_fn(st, ib, ins):
+        return kernel.step(st, ib, ins)
+
+    closed = jax.make_jaxpr(step_fn)(state, inbox, inputs)
+    in_leaves = jax.tree_util.tree_flatten_with_path(
+        (state, inbox, inputs)
+    )[0]
+    out_shape = jax.eval_shape(step_fn, state, inbox, inputs)
+    out_leaves = jax.tree_util.tree_flatten_with_path(out_shape)[0]
+
+    def name_of(path) -> Tuple[int, str]:
+        idx = path[0].idx
+        keys = []
+        for p in path[1:]:
+            if isinstance(p, jax.tree_util.DictKey):
+                keys.append(p.key)
+            elif isinstance(p, jax.tree_util.GetAttrKey):
+                # StepEffects is a registered dataclass: its leaves path
+                # through GetAttrKey (commit_bar / exec_bar / extra[...])
+                keys.append(p.name)
+        return idx, keys[-1] if keys else jax.tree_util.keystr(path[1:])
+
+    in_paths = [name_of(p) for p, _ in in_leaves]
+    out_paths = [name_of(p) for p, _ in out_leaves]
+    out_shapes = [sd for _, sd in out_leaves]
+    return closed, in_paths, out_paths, out_shapes, state
+
+
+# --------------------------------------------------------------- checks --
+def _is_int_like(dtype) -> bool:
+    return (
+        jnp.issubdtype(dtype, jnp.integer)
+        or jnp.issubdtype(dtype, jnp.bool_)
+    )
+
+
+def _check_state(kernel, state, out: List[Finding]) -> None:
+    name = kernel.name
+    G, R = kernel.G, kernel.R
+    for bar in ("commit_bar", "exec_bar"):
+        leaf = state.get(bar)
+        if leaf is None:
+            out.append(rule_finding(
+                "C1", name, bar, f"required state leaf {bar!r} missing"
+            ))
+        elif leaf.shape != (G, R) or leaf.dtype != jnp.int32:
+            out.append(rule_finding(
+                "C1", name, bar,
+                f"{bar} must be int32 [G, R]; got "
+                f"{leaf.dtype} {leaf.shape}",
+            ))
+    for key, leaf in state.items():
+        if leaf.ndim < 2 or leaf.shape[:2] != (G, R):
+            out.append(rule_finding(
+                "C1", name, key,
+                f"state leaf {key!r} must lead with [G, R]=({G}, {R}); "
+                f"got shape {leaf.shape}",
+            ))
+        if not _is_int_like(leaf.dtype):
+            out.append(rule_finding(
+                "C2", name, key,
+                f"state leaf {key!r} has non-integer dtype {leaf.dtype} "
+                "(protocol state is integer/bool only)",
+            ))
+
+
+def _check_outbox(kernel, outbox, out: List[Finding]) -> None:
+    name = kernel.name
+    G, R = kernel.G, kernel.R
+    bl = kernel.broadcast_lanes
+    flags = outbox.get("flags")
+    if flags is None:
+        out.append(rule_finding(
+            "C3", name, "flags",
+            "outbox must contain the uint32 [G, R, R] 'flags' pair-field "
+            "(the netmodel's masking lane)",
+        ))
+    else:
+        if flags.dtype != jnp.uint32 or flags.shape != (G, R, R):
+            out.append(rule_finding(
+                "C3", name, "flags",
+                f"flags must be uint32 [G, R, R]; got {flags.dtype} "
+                f"{flags.shape}",
+            ))
+        if "flags" in bl:
+            out.append(rule_finding(
+                "C3", name, "flags",
+                "flags must be a per-pair field, not a broadcast lane",
+            ))
+    for lane in sorted(bl):
+        if lane not in outbox:
+            out.append(rule_finding(
+                "C3", name, lane,
+                f"broadcast_lanes entry {lane!r} is not an outbox leaf",
+            ))
+    for key, leaf in outbox.items():
+        if not _is_int_like(leaf.dtype):
+            out.append(rule_finding(
+                "C4", name, key,
+                f"outbox leaf {key!r} has non-integer dtype {leaf.dtype}",
+            ))
+        if key in bl:
+            if leaf.ndim < 2 or leaf.shape[:2] != (G, R):
+                out.append(rule_finding(
+                    "C3", name, key,
+                    f"broadcast lane {key!r} must lead with "
+                    f"[G, R_src]; got shape {leaf.shape}",
+                ))
+        elif leaf.ndim < 3 or leaf.shape[:3] != (G, R, R):
+            out.append(rule_finding(
+                "C3", name, key,
+                f"outbox leaf {key!r} must be per-pair "
+                f"[G, R_src, R_dst, ...] or declared in broadcast_lanes; "
+                f"got shape {leaf.shape}",
+            ))
+
+
+def _check_durable(kernel, state, out: List[Finding]) -> None:
+    name = kernel.name
+    G, R = kernel.G, kernel.R
+    scalars, windows = kernel.DURABLE_SCALARS, kernel.DURABLE_WINDOWS
+    if scalars is None or windows is None:
+        out.append(rule_finding(
+            "C5", name, "DURABLE",
+            "kernel declares no durable acceptor contract "
+            "(DURABLE_SCALARS/DURABLE_WINDOWS is None); the host refuses "
+            "to serve it",
+        ))
+        return
+    for k in scalars:
+        leaf = state.get(k)
+        if leaf is None:
+            out.append(rule_finding(
+                "C5", name, k,
+                f"DURABLE_SCALARS entry {k!r} is not a state leaf",
+            ))
+        elif leaf.shape != (G, R):
+            out.append(rule_finding(
+                "C5", name, k,
+                f"DURABLE_SCALARS entry {k!r} must be [G, R]; got "
+                f"{leaf.shape}",
+            ))
+    for k in windows:
+        leaf = state.get(k)
+        if leaf is None:
+            out.append(rule_finding(
+                "C5", name, k,
+                f"DURABLE_WINDOWS entry {k!r} is not a state leaf",
+            ))
+        elif leaf.ndim < 3 or leaf.shape[:2] != (G, R):
+            out.append(rule_finding(
+                "C5", name, k,
+                f"DURABLE_WINDOWS entry {k!r} must lead with [G, R] and "
+                f"carry a window axis; got {leaf.shape}",
+            ))
+    if kernel.VALUE_WINDOW not in windows:
+        out.append(rule_finding(
+            "C5", name, kernel.VALUE_WINDOW,
+            f"VALUE_WINDOW {kernel.VALUE_WINDOW!r} must be one of "
+            "DURABLE_WINDOWS (the WAL logs payload ids from it)",
+        ))
+
+
+def _walk_jaxprs(closed):
+    """Yield every (sub-)jaxpr reachable from a ClosedJaxpr."""
+    seen = set()
+    stack = [closed.jaxpr]
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        yield j
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (tuple, list)) else (v,)
+                for item in vs:
+                    inner = getattr(item, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        stack.append(inner)
+                    elif hasattr(item, "eqns"):
+                        stack.append(item)
+
+
+def _check_purity(kernel, closed, what: str, out: List[Finding]) -> None:
+    name = kernel.name
+    if closed.effects:
+        out.append(rule_finding(
+            "C6", name, what,
+            f"{what} jaxpr carries effects {sorted(map(str, closed.effects))}"
+            " (host I/O or ordered side effects inside the kernel)",
+        ))
+    hit = set()
+    for j in _walk_jaxprs(closed):
+        for eqn in j.eqns:
+            pname = eqn.primitive.name
+            if pname in FORBIDDEN_PRIMITIVES and pname not in hit:
+                hit.add(pname)
+                out.append(rule_finding(
+                    "C6", name, f"{what}:{pname}",
+                    f"forbidden primitive {pname!r} in the {what} jaxpr",
+                ))
+
+
+def _check_int_discipline(kernel, closed, out: List[Finding]) -> None:
+    name = kernel.name
+    hit = set()
+    for j in _walk_jaxprs(closed):
+        for eqn in j.eqns:
+            for v in eqn.outvars:
+                dt = getattr(v.aval, "dtype", None)
+                if dt is not None and jnp.issubdtype(dt, jnp.floating):
+                    key = eqn.primitive.name
+                    if key not in hit:
+                        hit.add(key)
+                        out.append(rule_finding(
+                            "C8", name, f"step:{key}",
+                            f"floating-point intermediate ({dt}) produced "
+                            f"by {key!r} in the step jaxpr — protocol "
+                            "lanes are integer-only",
+                        ))
+    return
+
+
+def _check_carry(kernel, state, out_paths, out_shapes,
+                 out: List[Finding]) -> None:
+    """C7: step's state output must be a structurally identical carry."""
+    name = kernel.name
+    in_leaves = {
+        k: (v.shape, jnp.dtype(v.dtype)) for k, v in state.items()
+    }
+    out_leaves = {}
+    for (idx, leaf), sd in zip(out_paths, out_shapes):
+        if idx == 0:
+            out_leaves[leaf] = (sd.shape, jnp.dtype(sd.dtype))
+    for k in sorted(set(in_leaves) | set(out_leaves)):
+        if k not in out_leaves:
+            out.append(rule_finding(
+                "C7", name, k, f"state leaf {k!r} dropped by step()"
+            ))
+        elif k not in in_leaves:
+            out.append(rule_finding(
+                "C7", name, k, f"state leaf {k!r} invented by step()"
+            ))
+        elif in_leaves[k] != out_leaves[k]:
+            out.append(rule_finding(
+                "C7", name, k,
+                f"state leaf {k!r} changes shape/dtype across step(): "
+                f"{in_leaves[k]} -> {out_leaves[k]} (breaks the "
+                "lax.scan carry)",
+            ))
+
+
+# ------------------------------------------------- telemetry write path --
+class _TelemWriteScan(ast.NodeVisitor):
+    """Flag direct references to the telemetry lane block in a protocol
+    module: kernels must contribute via the ``_telemetry`` hook dict and
+    let ``core/telemetry.accumulate`` fold it (one stacked add/max), not
+    scatter into ``s["telem"]`` per lane."""
+
+    def __init__(self):
+        self.hits: List[Tuple[int, str]] = []
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if node.value == telemetry.TELEM_KEY:
+            self.hits.append((node.lineno, "literal 'telem' subscript"))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in ("accumulate", "bump", "TELEM_KEY"):
+            base = getattr(node.value, "id", None)
+            if base == "telemetry":
+                self.hits.append(
+                    (node.lineno, f"direct telemetry.{node.attr} call")
+                )
+        self.generic_visit(node)
+
+
+def _check_telemetry_path(kernel, out: List[Finding]) -> None:
+    """C9 over the kernel's defining module(s) (its MRO inside
+    ``summerset_tpu/protocols``); ``core/protocol.py`` and
+    ``core/telemetry.py`` themselves ARE the sanctioned path."""
+    name = kernel.name
+    seen_files = set()
+    for cls in type(kernel).__mro__:
+        mod = inspect.getmodule(cls)
+        fn = getattr(mod, "__file__", None)
+        if not fn or os.sep + "protocols" + os.sep not in fn:
+            continue
+        if fn in seen_files:
+            continue
+        seen_files.add(fn)
+        with open(fn, "r") as f:
+            tree = ast.parse(f.read(), filename=fn)
+        scan = _TelemWriteScan()
+        scan.visit(tree)
+        for line, what in scan.hits:
+            out.append(rule_finding(
+                "C9", name,
+                f"{os.path.basename(fn)}:{what}",
+                f"telemetry lane block touched directly ({what}) — "
+                "contribute lanes via the _telemetry hook so the one "
+                "stacked accumulate stays the only write path",
+                line=line,
+            ))
+
+
+# ------------------------------------------------------------ entrypoint --
+def verify_kernel(make_protocol, name: str) -> PassResult:
+    """Run every contract check for one registered kernel (both config
+    variants when they differ); findings are deduplicated by fingerprint."""
+    res = PassResult()
+    seen = set()
+
+    def emit(findings: List[Finding]) -> None:
+        for f in findings:
+            # key on message too: the fingerprint identifies a *site*
+            # (stable across variants), but one site can carry distinct
+            # violations (e.g. flags mis-typed AND broadcast-declared)
+            # that must all surface in one run
+            key = (f.fingerprint, f.message)
+            if key not in seen:
+                seen.add(key)
+                res.findings.append(f)
+
+    try:
+        kernel = build_kernel(make_protocol, name)
+        variants = [kernel]
+        if host_variant_differs(kernel):
+            variants.append(build_kernel(make_protocol, name, "host"))
+        for k in variants:
+            found: List[Finding] = []
+            plain_state = k.init_state(seed=0)
+            _check_state(k, plain_state, found)
+            _check_outbox(k, k.zero_outbox(), found)
+            _check_durable(k, plain_state, found)
+            # init_state runs eagerly on the host exactly once (concrete
+            # Python like int() is fine there) — only step(), the
+            # scanned/jitted hot path, is traced for purity
+            closed, _, out_paths, out_shapes, state = trace_step(k)
+            _check_purity(k, closed, "step", found)
+            _check_int_discipline(k, closed, found)
+            _check_carry(k, state, out_paths, out_shapes, found)
+            emit(found)
+        tel_found: List[Finding] = []
+        _check_telemetry_path(kernel, tel_found)
+        emit(tel_found)
+    except Exception as e:  # a crash in tracing is itself a violation
+        res.error = f"{type(e).__name__}: {e}"
+    return res

@@ -1,0 +1,326 @@
+"""Flags-taint pass: prove every inbox read is gated on ``flags``.
+
+The netmodel's whole masking design (``core/netmodel.py``) rests on one
+consumer-side obligation: data lanes of a dead / partitioned / dropped
+link still carry bytes — only the uint32 ``flags`` pair-field is zeroed
+— so a kernel that folds an inbox lane into its state without first
+passing it through a ``flags``-derived gate consumes garbage exactly
+when the network misbehaves.  A violation is invisible to happy-path
+tests and only a lucky nemesis seed would catch it; this pass proves the
+property statically instead.
+
+Mechanics: an abstract interpretation of the traced step jaxpr.  Each
+variable carries
+
+- ``sources`` — the set of inbox leaf names whose values reached it
+  WITHOUT passing a gate, and
+- ``guard``  — whether the value is (transitively) derived from the
+  ``flags`` leaf.
+
+Default transfer: union the sources, OR the guards.  Gates *clear* the
+data operands' sources:
+
+- ``select_n(pred, a, b)`` with a guarded ``pred`` — the classic
+  ``jnp.where(ok, inbox_lane, fallback)`` shape;
+- ``mul``/``and``/``or`` with a guarded operand — mask-multiply and
+  bitmask gating (``flags & BIT``, ``valid & cond``, masked sums).
+
+``cond``/``scan``/``while``/``pjit`` sub-jaxprs are walked recursively
+(loop carries to fixpoint).  A state or effects output whose
+``sources`` is non-empty is an unguarded read: a ``T1`` finding per
+(inbox leaf, sink) flow — effects sinks are named ``effects.<leaf>``
+(the host serves them to clients, so garbage there is as consumed as
+garbage in state).  Intentional flows are declared per kernel in
+``ProtocolKernel.TAINT_ALLOW`` with a reason — suppressions are
+explicit, and stale entries (declared but no longer occurring) are
+themselves ``T9`` findings so the allowlist can't rot.
+
+Known limitations (ROADMAP): (1) the gate rules are
+polarity-insensitive — a *flags-derived* predicate clears taint
+regardless of which branch the dead-link (``flags == 0``) case selects,
+so an inverted gate like ``jnp.where(valid, 0, inbox_lane)`` launders
+the lane.  Tracking gate polarity through comparisons / ``~`` / bit ops
+would close this.  (2) state and effects outputs are sinks, but outbox
+leaves are not: an ungated inbox->OUTBOX flow (a relay hop forwarding a
+lane verbatim) is not reported, and the receiver's own flags gate only
+attests its inbound link was alive — not that the relayed bytes were
+valid — so a partitioned link one hop upstream can launder garbage
+through a clean forwarder.  Treating outbox leaves as sinks (with their
+own allow entries for the deliberate relay lanes in the chain/push
+kernels) would close that hop.  Until both land, the pass is a
+high-signal lint over the idiomatic gating patterns, not a verified
+proof.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, FrozenSet, List, Set, Tuple
+
+try:  # jax >= 0.4.33 public spelling
+    from jax.extend.core import Literal as _Literal
+except ImportError:  # pragma: no cover - older jax
+    from jax.core import Literal as _Literal
+
+from .contract import (
+    build_kernel, host_variant_differs, rule_finding, trace_step,
+)
+from .report import PassResult
+
+EMPTY: FrozenSet[str] = frozenset()
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    sources: FrozenSet[str] = EMPTY
+    guard: bool = False
+
+
+CLEAN = Taint()
+GUARD = Taint(EMPTY, True)
+
+# primitives whose first operand selects among the rest
+_SELECT_PRIMS = frozenset({"select_n"})
+# commutative mask applications: a guarded operand gates the other(s).
+# ``or`` is deliberately NOT here — ``x | mask`` passes ``x`` through
+# when the mask is zero, which is exactly the dead-link case.
+_MASK_PRIMS = frozenset({"mul", "and"})
+
+# loop-carry fixpoints converge because each round joins the carry with
+# its previous value (nondecreasing in a finite lattice); this cap only
+# backstops analysis bugs, and hitting it is itself reported as a pass
+# error rather than silently returning an under-approximation
+_FIXPOINT_CAP = 10_000
+
+
+def _join(*ts: Taint) -> Taint:
+    src: Set[str] = set()
+    guard = False
+    for t in ts:
+        src |= t.sources
+        guard |= t.guard
+    return Taint(frozenset(src), guard)
+
+
+def _sub_jaxpr(obj):
+    """Normalize params entries to a (jaxpr, consts) pair if jaxpr-like."""
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner, tuple(getattr(obj, "consts", ()) or ())
+    if hasattr(obj, "eqns"):
+        return obj, ()
+    return None
+
+
+class _Walker:
+    """One abstract-interpretation pass over a jaxpr forest."""
+
+    def __init__(self):
+        self.depth = 0
+
+    def run(self, jaxpr, in_taints: List[Taint],
+            const_taints: List[Taint] | None = None) -> List[Taint]:
+        env: Dict[Any, Taint] = {}
+
+        def read(v) -> Taint:
+            if isinstance(v, _Literal):
+                return CLEAN
+            return env.get(v, CLEAN)
+
+        def write(v, t: Taint) -> None:
+            env[v] = t
+
+        consts = const_taints or [CLEAN] * len(jaxpr.constvars)
+        for v, t in zip(jaxpr.constvars, consts):
+            write(v, t)
+        for v, t in zip(jaxpr.invars, in_taints):
+            write(v, t)
+
+        for eqn in jaxpr.eqns:
+            ins = [read(v) for v in eqn.invars]
+            name = eqn.primitive.name
+            outs = self._transfer(name, eqn, ins)
+            for v, t in zip(eqn.outvars, outs):
+                write(v, t)
+        return [read(v) for v in jaxpr.outvars]
+
+    # ------------------------------------------------------- transfer --
+    def _transfer(self, name: str, eqn, ins: List[Taint]) -> List[Taint]:
+        n_out = len(eqn.outvars)
+        if name in _SELECT_PRIMS and ins:
+            pred, cases = ins[0], ins[1:]
+            if pred.guard:
+                out = Taint(pred.sources, True)
+            else:
+                out = _join(pred, *cases)
+            return [out] * n_out
+        if name in _MASK_PRIMS and len(ins) >= 2:
+            # an operand is gated when some OTHER operand is
+            # flags-derived: `mask & data` clears data's sources, and
+            # `gate & tainted_cmp` (both guarded) clears both — but a
+            # guarded-and-tainted value combined with a clean one keeps
+            # its taint (no new gate was applied to it)
+            src: Set[str] = set()
+            for i, t in enumerate(ins):
+                if any(o.guard for j, o in enumerate(ins) if j != i):
+                    continue
+                src |= t.sources
+            return [
+                Taint(frozenset(src), any(t.guard for t in ins))
+            ] * n_out
+        sub = self._sub_transfer(name, eqn, ins)
+        if sub is not None:
+            return sub
+        return [_join(*ins)] * n_out if ins else [CLEAN] * n_out
+
+    def _sub_transfer(self, name: str, eqn, ins):
+        params = eqn.params
+        if name == "cond":
+            branches = params["branches"]
+            ops = ins[1:]
+            outs = None
+            for br in branches:
+                pair = _sub_jaxpr(br)
+                if pair is None:
+                    continue
+                j, _ = pair
+                res = self.run(j, list(ops))
+                outs = res if outs is None else [
+                    _join(a, b) for a, b in zip(outs, res)
+                ]
+            if outs is None:
+                return None
+            # the predicate flows into every output (it chose them)
+            return [_join(ins[0], t) for t in outs]
+        if name == "while":
+            cj = _sub_jaxpr(params["cond_jaxpr"])
+            bj = _sub_jaxpr(params["body_jaxpr"])
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            carry = list(ins[cn + bn:])
+            cond_consts = ins[:cn]
+            body_consts = ins[cn:cn + bn]
+            # run to an actual fixpoint: carry is joined with its
+            # previous value each round, so it is nondecreasing in a
+            # finite lattice and must converge (the cap only guards
+            # against analysis bugs, not correctness)
+            for _ in range(_FIXPOINT_CAP):
+                nxt = self.run(bj[0], body_consts + carry)
+                nxt = [_join(a, b) for a, b in zip(nxt, carry)]
+                if nxt == carry:
+                    break
+                carry = nxt
+            else:
+                raise RuntimeError(
+                    "taint while-loop fixpoint did not converge"
+                )
+            # the loop bound chooses the carried values (iteration count
+            # is an implicit flow): join the cond predicate's taint into
+            # every output, the same rule as the cond primitive
+            if cj is not None:
+                pred = self.run(cj[0], cond_consts + carry)
+                pt = _join(*pred) if pred else CLEAN
+                carry = [_join(pt, t) for t in carry]
+            return carry
+        if name == "scan":
+            pair = _sub_jaxpr(params["jaxpr"])
+            if pair is None:
+                return None
+            j, _ = pair
+            nc, ncar = params["num_consts"], params["num_carry"]
+            consts, carry, xs = ins[:nc], ins[nc:nc + ncar], ins[nc + ncar:]
+            ys_acc = None
+            for _ in range(_FIXPOINT_CAP):
+                res = self.run(j, consts + carry + xs)
+                new_carry = [
+                    _join(a, b) for a, b in zip(res[:ncar], carry)
+                ]
+                ys = res[ncar:]
+                ys_acc = ys if ys_acc is None else [
+                    _join(a, b) for a, b in zip(ys_acc, ys)
+                ]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            else:
+                raise RuntimeError(
+                    "taint scan fixpoint did not converge"
+                )
+            return carry + (ys_acc or [])
+        # generic call-like primitives: pjit, closed_call, custom_jvp/vjp,
+        # remat — look for a single sub-jaxpr param and inline it
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            if key in params:
+                pair = _sub_jaxpr(params[key])
+                if pair is not None:
+                    return self.run(pair[0], list(ins))
+        return None
+
+
+def analyze_kernel_flows(kernel) -> Set[Tuple[str, str]]:
+    """All ungated (inbox_leaf -> state_leaf) flows in one traced step."""
+    closed, in_paths, out_paths, _, _ = trace_step(kernel)
+    in_taints: List[Taint] = []
+    for idx, leaf in in_paths:
+        if idx == 1:  # inbox tree
+            if leaf == "flags":
+                in_taints.append(GUARD)
+            else:
+                in_taints.append(Taint(frozenset({leaf}), False))
+        else:
+            in_taints.append(CLEAN)
+    out_taints = _Walker().run(
+        closed.jaxpr, in_taints, [CLEAN] * len(closed.jaxpr.constvars)
+    )
+    flows: Set[Tuple[str, str]] = set()
+    for (idx, leaf), taint in zip(out_paths, out_taints):
+        if idx == 0:
+            dst = leaf
+        elif idx == 2:
+            # effects are what the host serves to clients (read results,
+            # lease status): garbage there is as consumed as garbage in
+            # state.  Prefixed so an effects sink can't collide with the
+            # state leaf of the same name in scopes / TAINT_ALLOW.
+            dst = f"effects.{leaf}"
+        else:  # outbox relay hops: see the limitation in the docstring
+            continue
+        for src in taint.sources:
+            flows.add((src, dst))
+    return flows
+
+
+def verify_kernel_taint(make_protocol, name: str) -> PassResult:
+    """T1/T9 findings for one registered kernel (both config variants)."""
+    res = PassResult()
+    try:
+        kernel = build_kernel(make_protocol, name)
+        flows = analyze_kernel_flows(kernel)
+        if host_variant_differs(kernel):
+            flows |= analyze_kernel_flows(
+                build_kernel(make_protocol, name, "host")
+            )
+        allow = {
+            (src, dst): reason
+            for src, dst, reason in kernel.TAINT_ALLOW
+        }
+        for src, dst in sorted(flows):
+            f = rule_finding(
+                "T1", kernel.name, f"{src}->{dst}",
+                f"inbox leaf {src!r} reaches sink {dst!r} without a "
+                "flags-derived gate (garbage consumed on dead or "
+                "partitioned links)",
+            )
+            reason = allow.get((src, dst))
+            if reason is not None:
+                res.suppressed.append((f, reason))
+            else:
+                res.findings.append(f)
+        for (src, dst), _reason in sorted(allow.items()):
+            if (src, dst) not in flows:
+                res.findings.append(rule_finding(
+                    "T9", kernel.name, f"{src}->{dst}",
+                    f"stale TAINT_ALLOW entry: flow {src!r} -> {dst!r} "
+                    "no longer occurs — delete the suppression",
+                ))
+    except Exception as e:
+        res.error = f"{type(e).__name__}: {e}"
+    return res

@@ -7,19 +7,12 @@ defines pure functions over batched state — ``init_state`` builds the
 struct-of-arrays pytree for ``[num_groups, population]`` replicas, and
 ``step`` advances every replica of every group by one lockstep tick.
 
-Design rules (required for masking / sharding to work uniformly):
-
-- every state leaf has leading dims ``[G, R]`` (group, replica), and the
-  state dict must contain int32 ``commit_bar``/``exec_bar`` leaves (the
-  engine mirrors them into effects when masking paused replicas);
-- every outbox leaf is either a per-directed-pair field ``[G, R_src, R_dst]``
-  (delivered transposed to ``[G, R_dst, R_src]``) or a broadcast window lane
-  ``[G, R_src, W]`` named in ``broadcast_lanes`` (delivered as-is; receivers
-  index axis 1 by sender);
-- the outbox must contain a uint32 ``flags`` per-pair field; the network
-  model zeroes ``flags`` on dead/partitioned/dropped links and consumers
-  must gate every read on it;
-- no data-dependent Python control flow: everything is masked updates.
+The design rules that make masking / sharding / durability / telemetry
+work uniformly are no longer prose: :data:`KERNEL_CONTRACT` below is the
+machine-readable rule table, enforced per registered kernel by the
+``summerset_tpu/analysis`` verifier (``scripts/graftlint.py``, CI tier
+2e, committed baseline ``LINT.json``).  README "Kernel contract" renders
+the same table for humans.
 """
 
 from __future__ import annotations
@@ -32,6 +25,55 @@ import jax
 from . import telemetry
 
 Pytree = Any
+
+#: The kernel SPI contract, numbered and linter-enforced.  Every rule is
+#: stated against what the runtime actually relies on: the engine's
+#: freeze masks reshape on leading ``[G, R]`` (C1), the netmodel
+#: transposes axes 1/2 of every non-broadcast outbox leaf and zeroes
+#: only ``flags`` on dead links (C3, T1), the host WAL logs the declared
+#: durable rows (C5), ``lax.scan`` re-feeds the state structure as its
+#: carry (C7), and the model-check / nemesis replay planes assume the
+#: step is a pure deterministic function (C6, C8).
+KERNEL_CONTRACT: Tuple[Tuple[str, str, str], ...] = (
+    ("C1", "state-geometry",
+     "every state leaf leads with [G, R]; int32 commit_bar / exec_bar "
+     "[G, R] leaves are present (engine freeze masks + effects mirror)"),
+    ("C2", "state-dtype",
+     "protocol state is integer/bool only — no float leaves"),
+    ("C3", "outbox-shape",
+     "the outbox carries a uint32 [G, R, R] 'flags' pair-field; every "
+     "other leaf is per-pair [G, R_src, R_dst, ...] (delivered "
+     "transposed) or declared in broadcast_lanes and leads with "
+     "[G, R_src] (delivered as-is)"),
+    ("C4", "outbox-dtype",
+     "outbox lanes are integer/bool only"),
+    ("C5", "durable-contract",
+     "DURABLE_SCALARS / DURABLE_WINDOWS are declared and resolve to "
+     "state arrays of the declared shapes ([G, R] scalars, [G, R, ...] "
+     "windows); VALUE_WINDOW names one of DURABLE_WINDOWS"),
+    ("C6", "step-purity",
+     "step traces to a jaxpr with no host callbacks, no effects, and "
+     "no nondeterministic primitives (init_state runs eagerly on the "
+     "host exactly once and is exempt)"),
+    ("C7", "carry-stability",
+     "step returns a state pytree structurally identical (keys, shapes, "
+     "dtypes) to its input — the lax.scan carry contract"),
+    ("C8", "int-discipline",
+     "no floating-point intermediate appears in the step jaxpr (no "
+     "silent float32 upcasts in protocol lanes)"),
+    ("C9", "telemetry-path",
+     "the telem lane block is written only via the stacked "
+     "accumulate/bump path in core/telemetry.py, contributed through "
+     "the _telemetry hook"),
+    ("T1", "flags-gating",
+     "every inbox read that lands in a state update or an effects "
+     "output passes a gate (select / mask-multiply) derived — directly "
+     "or transitively — from the netmodel-zeroed flags field; "
+     "intentional exceptions are declared in TAINT_ALLOW with a reason"),
+    ("T9", "suppression-hygiene",
+     "every TAINT_ALLOW entry names a flow that still occurs — a stale "
+     "suppression is itself a finding, so the allowlist cannot rot"),
+)
 
 
 @jax.tree_util.register_dataclass
@@ -59,8 +101,25 @@ class ProtocolKernel:
     """
 
     name: str = "generic"
-    # outbox leaves that are [G, R_src, W] broadcast lanes (not per-pair)
+    # outbox leaves delivered as-is, [G, R_src, ...] (not per-pair)
     broadcast_lanes: FrozenSet[str] = frozenset()
+
+    # -- machine-readable contract metadata (analysis / graftlint) ----------
+    # step() inputs this kernel consumes beyond the base lanes every
+    # kernel gets (n_proposals [G], value_base [G], exec_floor [G, R]),
+    # as (name, shape_code): g=[G], gr=[G, R], grr=[G, R, R],
+    # gp=[G, P] proposal-width lists.  The verifier traces step against
+    # exactly this superset: an undeclared input either KeyErrors the
+    # trace (direct subscript reads) or — for optional `.get()`-style
+    # reads — silently drops that branch from the verified/tainted
+    # surface, so keep the declaration in sync with every input the
+    # kernel can consume.
+    EXTRA_INPUTS: Tuple[Tuple[str, str], ...] = ()
+    # declared-intentional ungated inbox->state flows for the
+    # flags-taint pass, as (inbox_leaf, state_leaf, reason).  The pass
+    # fails on any flow not listed here AND on stale entries that no
+    # longer occur — suppressions are explicit and cannot rot.
+    TAINT_ALLOW: Tuple[Tuple[str, str, str], ...] = ()
 
     # -- durable acceptor contract ------------------------------------------
     # State arrays forming this kernel's per-replica durable acceptor

@@ -60,6 +60,7 @@ class ControlHub:
         self._wlock = threading.Lock()
 
     def send_ctrl(self, msg: CtrlMsg) -> None:
+        # graftlint: disable=H101 -- per-socket writer serialization is this lock's whole job: concurrent send_ctrl callers must not interleave frame bytes on the one manager socket
         with self._wlock:
             safetcp.send_msg_sync(self.sock, msg)
 

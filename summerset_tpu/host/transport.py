@@ -310,6 +310,7 @@ class TransportHub:
                     copies = 2
             buf = safetcp.encode_frame((tick, payload))
             try:
+                # graftlint: disable=H101 -- the per-peer write lock exists to serialize frame writers on one socket; it guards nothing else, so blocking inside it cannot deadlock other state
                 with self._wlocks[peer]:
                     for _ in range(copies):
                         sock.sendall(buf)

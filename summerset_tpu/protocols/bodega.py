@@ -107,6 +107,15 @@ class BodegaKernel(MultiPaxosKernel):
     # it from heartbeats, conflease.rs heard_new_conf)
     DURABLE_WINDOWS = MultiPaxosKernel.DURABLE_WINDOWS + ("win_noop",)
 
+    # host conf-change plane: the announcing replica + leader/responder
+    # targets + optional key bucket (contract metadata, core/protocol.py)
+    EXTRA_INPUTS = MultiPaxosKernel.EXTRA_INPUTS + (
+        ("conf_init", "g"),
+        ("conf_leader_target", "g"),
+        ("conf_resp_target", "g"),
+        ("conf_bucket", "g"),
+    )
+
     def __init__(
         self,
         num_groups: int,
@@ -180,10 +189,17 @@ class BodegaKernel(MultiPaxosKernel):
 
         # epoch gate: defer consensus traffic from senders whose installed
         # conf differs from ours (their per-tick CONF lane is the tag; an
-        # unset CONF bit zeroes cf_bal, which matches only the no-conf
-        # cold-start epoch)
+        # absent CONF bit reads as cf_bal 0, which matches only the
+        # no-conf cold-start epoch).  The cf_bal read must itself be
+        # gated on cf_valid: senders only populate the lane under the
+        # CONF bit, so the gate is semantically free — but without it a
+        # dead link's stale cf_bal garbage flows into the epoch
+        # predicate (flags-taint rule T1, graftlint)
         cf_valid = (flags & CONF) != 0
-        epoch_ok = inbox["cf_bal"] == s["conf_bal"][..., None]
+        epoch_ok = (
+            jnp.where(cf_valid, inbox["cf_bal"], 0)
+            == s["conf_bal"][..., None]
+        )
         c.flags = jnp.where(
             epoch_ok, flags, flags & ~_EPOCH_BITS
         )

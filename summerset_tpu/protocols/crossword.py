@@ -81,6 +81,10 @@ class CrosswordKernel(RSPaxosKernel):
     # coverage tally counts it, crossword/mod.rs:324-396)
     DURABLE_WINDOWS = RSPaxosKernel.DURABLE_WINDOWS + ("win_spr",)
 
+    # host perf-model override of the shards-per-replica choice
+    # (host/adaptive.py; contract metadata, see core/protocol.py)
+    EXTRA_INPUTS = RSPaxosKernel.EXTRA_INPUTS + (("spr_override", "g"),)
+
     def __init__(
         self,
         num_groups: int,

@@ -119,6 +119,15 @@ class EPaxosKernel(ProtocolKernel):
     )
     VALUE_WINDOW = "val2"
 
+    # host-serving inputs (contract metadata; see core/protocol.py):
+    # the proposing replica id, its minted vid list, and the per-row
+    # exec floors from the host Tarjan executor (host/epaxos_exec.py)
+    EXTRA_INPUTS = (
+        ("prop_replica", "g"),
+        ("prop_vids", "gp"),
+        ("exec_floor_rows", "grr"),
+    )
+
     def restore_durable(self, st, g, me, rec, floor):
         i32 = jnp.int32
         st["own_next"] = st["own_next"].at[g, me].set(

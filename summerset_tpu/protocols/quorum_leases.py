@@ -97,6 +97,10 @@ class QuorumLeasesKernel(MultiPaxosKernel):
     )
     DURABLE_WINDOWS = MultiPaxosKernel.DURABLE_WINDOWS + ("win_cfg",)
 
+    # host conf-change plane: the leader's responder-set target
+    # (contract metadata, see core/protocol.py)
+    EXTRA_INPUTS = MultiPaxosKernel.EXTRA_INPUTS + (("conf_target", "g"),)
+
     def restore_durable(self, st, g, me, rec, floor):
         super().restore_durable(st, g, me, rec, floor)
         i32 = jnp.int32

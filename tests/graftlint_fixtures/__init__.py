@@ -1,0 +1,170 @@
+"""Deliberately-broken protocol kernels: graftlint's negative paths.
+
+Each kernel here violates exactly one rule of the machine-readable
+kernel contract (``core/protocol.py KERNEL_CONTRACT``), so the test
+suite can assert the verifier catches each violation with its expected
+finding fingerprint — and nothing else.  None of these are registered
+in the global protocol registry; :func:`make_fixture` is the
+registry-shaped factory the analysis passes take.
+
+``GoodKernel`` is the control: a minimal contract-clean kernel proving
+the fixtures fail for their planted reason, not for boilerplate.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+
+from summerset_tpu.core.protocol import ProtocolKernel, StepEffects
+
+
+class GoodKernel(ProtocolKernel):
+    """Minimal contract-clean kernel: one flags-gated inbox fold."""
+
+    name = "FixtureGood"
+    DURABLE_SCALARS = ("commit_bar",)
+    DURABLE_WINDOWS = ("win_val",)
+    VALUE_WINDOW = "win_val"
+
+    def init_state(self, seed: int = 0):
+        G, R, W = self.G, self.R, self.W
+        i32 = jnp.int32
+        return {
+            "commit_bar": jnp.zeros((G, R), i32),
+            "exec_bar": jnp.zeros((G, R), i32),
+            "win_val": jnp.zeros((G, R, W), i32),
+        }
+
+    def zero_outbox(self):
+        G, R = self.G, self.R
+        return {
+            "flags": jnp.zeros((G, R, R), jnp.uint32),
+            "data": jnp.zeros((G, R, R), jnp.int32),
+        }
+
+    def _fold(self, s, inbox):
+        valid = (inbox["flags"] & jnp.uint32(1)) != 0
+        best = jnp.max(jnp.where(valid, inbox["data"], 0), axis=2)
+        s["commit_bar"] = jnp.maximum(s["commit_bar"], best)
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        s["exec_bar"] = s["commit_bar"]
+        self._accumulate_telemetry(state, s, SimpleNamespace())
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
+class UnflaggedInboxReadKernel(GoodKernel):
+    """T1: folds the inbox data lane into state without a flags gate."""
+
+    name = "FixtureUnflagged"
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        # the violation: raw lane max lands in a state leaf ungated
+        s["shadow"] = jnp.max(inbox["data"], axis=2)
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+    def init_state(self, seed: int = 0):
+        st = super().init_state(seed)
+        st["shadow"] = jnp.zeros((self.G, self.R), jnp.int32)
+        return st
+
+
+class UnflaggedEffectsKernel(GoodKernel):
+    """T1: folds an ungated inbox lane into an effects output (the host
+    serves effects to clients, so they are sinks like state)."""
+
+    name = "FixtureUnflaggedEffects"
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"],
+            extra={"raw_peek": jnp.max(inbox["data"], axis=2)},
+        )
+
+
+class StaleAllowKernel(GoodKernel):
+    """T9: declares a suppression for a flow that never occurs."""
+
+    name = "FixtureStaleAllow"
+    TAINT_ALLOW = (
+        ("data", "commit_bar", "declared but the flow is actually gated"),
+    )
+
+
+class FloatStateKernel(GoodKernel):
+    """C2: a float32 leaf in protocol state."""
+
+    name = "FixtureFloatState"
+
+    def init_state(self, seed: int = 0):
+        st = super().init_state(seed)
+        st["score"] = jnp.zeros((self.G, self.R), jnp.float32)
+        return st
+
+
+class MissingFlagsKernel(GoodKernel):
+    """C3: outbox without the uint32 flags pair-field."""
+
+    name = "FixtureMissingFlags"
+
+    def zero_outbox(self):
+        G, R = self.G, self.R
+        return {"data": jnp.zeros((G, R, R), jnp.int32)}
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
+class UndeclaredBroadcastKernel(GoodKernel):
+    """C3: a [G, R_src, W] window lane not named in broadcast_lanes."""
+
+    name = "FixtureUndeclaredBroadcast"
+
+    def zero_outbox(self):
+        out = super().zero_outbox()
+        out["bw_extra"] = jnp.zeros(
+            (self.G, self.R, self.W), jnp.int32
+        )
+        return out
+
+
+class BogusDurableKernel(GoodKernel):
+    """C5: DURABLE_WINDOWS names an array that is not a state leaf."""
+
+    name = "FixtureBogusDurable"
+    DURABLE_WINDOWS = ("win_val", "win_ghost")
+
+
+FIXTURES = {
+    "fixturegood": GoodKernel,
+    "fixtureunflagged": UnflaggedInboxReadKernel,
+    "fixtureunflaggedeffects": UnflaggedEffectsKernel,
+    "fixturestaleallow": StaleAllowKernel,
+    "fixturefloatstate": FloatStateKernel,
+    "fixturemissingflags": MissingFlagsKernel,
+    "fixtureundeclaredbroadcast": UndeclaredBroadcastKernel,
+    "fixturebogusdurable": BogusDurableKernel,
+}
+
+
+def make_fixture(name: str, *args, **kwargs) -> ProtocolKernel:
+    """Registry-shaped factory over the fixture kernels."""
+    return FIXTURES[name.lower()](*args, **kwargs)

@@ -1,0 +1,379 @@
+"""graftlint test suite: the verifier's negative paths + the clean tree.
+
+Three layers:
+
+1. **Broken-kernel fixtures** (``tests/graftlint_fixtures``): each
+   deliberately violates exactly one contract rule and must produce
+   exactly its expected finding fingerprint — the fingerprints are
+   hardcoded hex literals, so any change to the fingerprint scheme (or
+   to what a rule reports) shows up here before it invalidates the
+   committed LINT.json baseline.
+2. **Host AST lint units**: synthetic sources through ``scan_file``
+   covering each H-rule and the suppression-comment format.
+3. **The acceptance property**: every registered protocol kernel
+   verifies clean (contract + taint), and the host lint over the real
+   tree is finding-free modulo annotated suppressions — the same
+   invariant CI tier 2e pins via ``scripts/graftlint.py --check``.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from graftlint_fixtures import make_fixture  # noqa: E402
+
+from summerset_tpu import protocols  # noqa: E402
+from summerset_tpu.analysis import hostlint  # noqa: E402
+from summerset_tpu.analysis.contract import verify_kernel  # noqa: E402
+from summerset_tpu.analysis.report import (  # noqa: E402
+    Finding,
+    assemble_report,
+    dumps_report,
+)
+from summerset_tpu.analysis.taint import verify_kernel_taint  # noqa: E402
+
+
+# ------------------------------------------------------------- fixtures --
+def _fingerprints(res):
+    assert res.error is None, res.error
+    return sorted(f.fingerprint for f in res.findings)
+
+
+def test_good_fixture_is_clean():
+    assert verify_kernel(make_fixture, "fixturegood").ok
+    assert verify_kernel_taint(make_fixture, "fixturegood").ok
+
+
+@pytest.mark.parametrize(
+    "name,passfn,expected",
+    [
+        # each broken kernel -> exactly its one expected fingerprint
+        ("fixtureunflagged", verify_kernel_taint, ["229c835e7ed6"]),
+        ("fixtureunflaggedeffects", verify_kernel_taint,
+         ["670193535ccb"]),
+        ("fixturestaleallow", verify_kernel_taint, ["c6fab01b5c86"]),
+        ("fixturefloatstate", verify_kernel, ["aec22b6e38a8"]),
+        ("fixturemissingflags", verify_kernel, ["c746d187a51b"]),
+        ("fixtureundeclaredbroadcast", verify_kernel, ["43ec345af97e"]),
+        ("fixturebogusdurable", verify_kernel, ["0438a08b7ffd"]),
+    ],
+)
+def test_broken_fixture_fingerprint(name, passfn, expected):
+    res = passfn(make_fixture, name)
+    assert _fingerprints(res) == expected, [
+        f.render() for f in res.findings
+    ]
+
+
+def test_broken_fixtures_fail_only_their_rule():
+    """The planted violation is the only one: the other pass stays clean."""
+    assert verify_kernel(make_fixture, "fixtureunflagged").ok
+    assert verify_kernel(make_fixture, "fixtureunflaggedeffects").ok
+    assert verify_kernel_taint(make_fixture, "fixturefloatstate").ok
+    assert verify_kernel_taint(make_fixture, "fixturebogusdurable").ok
+
+
+def test_taint_while_cond_is_an_implicit_flow():
+    """A lax.while_loop bound derived from an ungated inbox lane taints
+    the carried state (iteration count is a flow, same as a cond
+    predicate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from summerset_tpu.core.protocol import StepEffects
+
+    from graftlint_fixtures import GoodKernel
+
+    class WhileBound(GoodKernel):
+        name = "FixtureWhileBound"
+
+        def step(self, state, inbox, inputs):
+            s = dict(state)
+            bound = jnp.max(inbox["data"])  # ungated
+
+            def cond(c):
+                return c[0] < bound
+
+            def body(c):
+                return c[0] + 1, c[1] + 1
+
+            _, bumped = jax.lax.while_loop(
+                cond, body,
+                (jnp.zeros((), jnp.int32), s["commit_bar"]),
+            )
+            s["commit_bar"] = bumped
+            s["exec_bar"] = s["commit_bar"]
+            return s, self.zero_outbox(), StepEffects(
+                commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+            )
+
+    res = verify_kernel_taint(
+        lambda _n, *a, **k: WhileBound(*a, **k), "fixturewhilebound"
+    )
+    assert res.error is None, res.error
+    assert ("data", "commit_bar") in {
+        tuple(f.scope.split("->")) for f in res.findings
+    }, [f.render() for f in res.findings]
+
+
+def test_taint_allow_suppresses_with_reason():
+    """An allowlisted flow moves to `suppressed` and carries its reason."""
+
+    from graftlint_fixtures import UnflaggedInboxReadKernel
+
+    class Allowed(UnflaggedInboxReadKernel):
+        name = "FixtureAllowed"
+        TAINT_ALLOW = (
+            ("data", "shadow", "diagnostic mirror, never consumed"),
+        )
+
+    res = verify_kernel_taint(
+        lambda _n, *a, **k: Allowed(*a, **k), "fixtureallowed"
+    )
+    assert res.ok
+    assert [(f.scope, r) for f, r in res.suppressed] == [
+        ("data->shadow", "diagnostic mirror, never consumed")
+    ]
+
+
+# ------------------------------------------------------ host lint units --
+_LOCKED_FSYNC = """
+import os, threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self, f):
+        with self._lock:
+            os.fsync(f.fileno())
+"""
+
+_SUPPRESSED = """
+import os, threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self, f):
+        # graftlint: disable=H104 -- fixture reason
+        with self._lock:  # graftlint: disable=H101 -- fixture reason
+            os.fsync(f.fileno())
+"""
+
+_STACKED_SUPPRESS = """
+import os, threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self, f):
+        with self._lock:
+            # graftlint: disable=H101 -- reason A
+
+            # graftlint: disable=H104 -- reason B
+            os.fsync(f.fileno())
+"""
+
+_NON_LOCK_WITH = """
+import os
+
+class Hub:
+    def flush(self, f, sock, buf):
+        with self._block:
+            sock.sendall(buf)
+        with nonblocking_io():
+            sock.sendall(buf)
+        with self._wlocks[0]:
+            sock.sendall(buf)
+"""
+
+_NON_DAEMON = """
+import threading
+
+def go(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+"""
+
+_SEEDED_SCOPE = """
+import time, random
+
+class FaultPlan:
+    def generate(self):
+        t0 = time.time()
+        rng = random.Random()
+        return t0, rng.random()
+
+class NemesisRunner:
+    def play(self):
+        return time.time()  # pacing: outside the seeded scope
+"""
+
+_SEEDED_SCOPE_SPELLINGS = """
+import time, datetime
+
+class FaultPlan:
+    def generate(self):
+        return (
+            time.time_ns(),
+            datetime.datetime.now(),
+        )
+"""
+
+
+def _scan(tmp_path, src, rel):
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    return hostlint.scan_file(str(p), rel)
+
+
+def test_hostlint_lock_held_fsync(tmp_path):
+    findings, suppressed = _scan(tmp_path, _LOCKED_FSYNC, "host/x.py")
+    codes = sorted(f.code for f in findings)
+    assert codes == ["H101", "H104"]
+    assert not suppressed
+
+
+def test_hostlint_suppression_comment(tmp_path):
+    findings, suppressed = _scan(tmp_path, _SUPPRESSED, "host/x.py")
+    assert not findings
+    assert sorted(f.code for f, _ in suppressed) == ["H101", "H104"]
+    assert all(r == "fixture reason" for _, r in suppressed)
+
+
+def test_hostlint_stacked_standalone_suppressions(tmp_path):
+    """Stacked standalone waivers (even blank-separated) all reach the
+    next statement line instead of the first landing on the second
+    comment and getting dropped."""
+    findings, suppressed = _scan(
+        tmp_path, _STACKED_SUPPRESS, "host/x.py"
+    )
+    assert not findings
+    assert sorted((f.code, r) for f, r in suppressed) == [
+        ("H101", "reason A"), ("H104", "reason B")
+    ]
+
+
+def test_hostlint_fsync_allowed_in_storage_owner(tmp_path):
+    findings, _ = _scan(tmp_path, _LOCKED_FSYNC, "host/storage.py")
+    assert sorted(f.code for f in findings) == ["H101"]  # H104 waived
+
+
+def test_hostlint_scans_subpackages(tmp_path):
+    """A future host/ subpackage cannot silently escape the lint."""
+    sub = tmp_path / "host" / "replication"
+    sub.mkdir(parents=True)
+    (sub / "wal.py").write_text(_LOCKED_FSYNC)
+    res, n_files = hostlint.lint_host(str(tmp_path))
+    assert n_files == 1
+    assert sorted(f.code for f in res.findings) == ["H101", "H104"]
+    assert res.findings[0].where == "host/replication/wal.py"
+
+
+def test_hostlint_lock_name_needs_word_boundary(tmp_path):
+    """'lock' inside another word (`_block`, `nonblocking_io`) is not a
+    lock; `_wlocks[i]` is."""
+    findings, _ = _scan(tmp_path, _NON_LOCK_WITH, "host/x.py")
+    assert [(f.code, f.line) for f in findings] == [("H101", 11)]
+
+
+def test_hostlint_non_daemon_thread(tmp_path):
+    findings, _ = _scan(tmp_path, _NON_DAEMON, "host/x.py")
+    assert [f.code for f in findings] == ["H102"]
+
+
+def test_hostlint_seeded_scope(tmp_path):
+    findings, _ = _scan(tmp_path, _SEEDED_SCOPE, "host/nemesis.py")
+    assert sorted(f.code for f in findings) == ["H103", "H103"]
+    scopes = sorted(f.scope for f in findings)
+    # time.time + unseeded Random inside FaultPlan; NemesisRunner exempt
+    assert scopes == [
+        "FaultPlan.generate:random.Random",
+        "FaultPlan.generate:time.time",
+    ]
+
+
+def test_hostlint_seeded_scope_wallclock_spellings(tmp_path):
+    """`import datetime; datetime.datetime.now()` and `time.time_ns()`
+    are wallclock reads too, not just the from-imported spellings."""
+    findings, _ = _scan(
+        tmp_path, _SEEDED_SCOPE_SPELLINGS, "host/nemesis.py"
+    )
+    assert sorted(f.scope for f in findings) == [
+        "FaultPlan.generate:datetime.datetime.now",
+        "FaultPlan.generate:time.time_ns",
+    ]
+
+
+# --------------------------------------------------- the clean-tree gate --
+# slow: `scripts/graftlint.py --check` (CI tier 2e) already traces every
+# registered kernel and pins the identical invariant in the same tier —
+# running these in the fast pass would pay the full 11-kernel x 2-variant
+# tracing cost a second time in a process that can't share _TRACE_CACHE.
+@pytest.mark.slow
+@pytest.mark.parametrize("name", protocols.protocol_names())
+def test_registered_kernel_contract_clean(name):
+    res = verify_kernel(protocols.make_protocol, name)
+    assert res.ok, [f.render() for f in res.findings] or res.error
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", protocols.protocol_names())
+def test_registered_kernel_taint_clean(name):
+    res = verify_kernel_taint(protocols.make_protocol, name)
+    assert res.ok, [f.render() for f in res.findings] or res.error
+
+
+def test_host_tree_lint_clean():
+    pkg_root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "summerset_tpu",
+    )
+    res, n_files = hostlint.lint_host(pkg_root)
+    assert n_files > 20
+    assert res.ok, [f.render() for f in res.findings]
+    # the three annotated waivers (control/transport writer locks,
+    # snapshot fsync) stay on record in LINT.json
+    assert len(res.suppressed) >= 3
+
+
+def test_report_is_deterministic():
+    host, n = hostlint.lint_host(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "summerset_tpu",
+    ))
+    kres = {"Fixture": {"contract": verify_kernel(
+        make_fixture, "fixturegood"
+    )}}
+    a = dumps_report(assemble_report(kres, host, n))
+    b = dumps_report(assemble_report(kres, host, n))
+    assert a == b
+    assert '"version": 1' in a
+
+
+def test_fingerprint_excludes_line_numbers():
+    f1 = Finding("H104", "host/x.py", "Hub.flush:os.fsync", "m", line=10)
+    f2 = Finding("H104", "host/x.py", "Hub.flush:os.fsync", "m", line=99)
+    assert f1.fingerprint == f2.fingerprint
+
+
+def test_kernel_contract_table_is_authoritative():
+    """Kernel passes mint findings through ``rule_finding``, so a check
+    can only emit codes the SPI's ``KERNEL_CONTRACT`` table declares."""
+    from summerset_tpu.analysis.contract import rule_finding
+    from summerset_tpu.core.protocol import KERNEL_CONTRACT
+
+    codes = [code for code, _, _ in KERNEL_CONTRACT]
+    assert codes == sorted(set(codes)), "table codes unsorted/duplicated"
+    assert codes == [
+        "C1", "C2", "C3", "C4", "C5", "C6", "C7", "C8", "C9", "T1", "T9",
+    ]
+    assert rule_finding("C1", "K", "leaf", "m").code == "C1"
+    with pytest.raises(KeyError):
+        rule_finding("Z1", "K", "leaf", "undeclared rule code")
