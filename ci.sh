@@ -22,12 +22,21 @@
 #         cost >5% of a steady tick (ablation) or a declared metric
 #         name is missing from a live cluster's metrics_dump scrape;
 #         regenerates TELEMETRY.json as a side effect.
-# Tier 2e: graftlint — the kernel-contract verifier (C1-C9), the
+# Tier 2e: graftlint — the kernel-contract verifier (C1-C10), the
 #         flags-taint pass (T1/T9), and the host-plane concurrency
 #         lint (H101-H104) against the committed LINT.json baseline:
 #         fails on any new finding OR on baseline drift (regenerate
 #         with scripts/graftlint.py and commit the diff), then runs
 #         the linter's own fast test suite.
+# Tier 2f: graftscope — the flight recorder + causal tracing plane:
+#         live MultiPaxos cluster under pipelined load with the
+#         recorder on vs off (interleaved A/B windows, adaptively
+#         escalated against fsync noise, fails >5% overhead), then a
+#         flight_dump scrape → merged Chrome-trace
+#         export → schema check + connected api→propose→commit→apply→
+#         reply chain + cross-replica frame tx/rx pairing; regenerates
+#         TRACE.json as a side effect (open the full trace in
+#         chrome://tracing via scripts/trace_smoke.py --trace-out).
 # Tier 3 (--full): every slow-marked fault-scenario kernel test and the
 #         randomized property sweep.
 set -e
@@ -53,6 +62,9 @@ python scripts/telemetry_smoke.py
 echo "=== tier 2e: graftlint (kernel contract + flags-taint + host lint) ==="
 python scripts/graftlint.py --check
 python -m pytest tests/test_graftlint.py -q -m "not slow"
+
+echo "=== tier 2f: graftscope (recorder overhead + causal-trace smoke) ==="
+python scripts/trace_smoke.py
 
 if [ "$1" = "--full" ]; then
   echo "=== tier 3: full superset (slow tests included) ==="

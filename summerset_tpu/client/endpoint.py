@@ -18,6 +18,26 @@ from ..utils import safetcp
 from ..utils.errors import SummersetError
 
 
+def _ctrl_scrape(manager_addr: Tuple[str, int], req: "CtrlRequest",
+                 timeout: float) -> Optional[dict]:
+    """Best-effort one-shot gather through the manager ctrl plane: send
+    ``req``, return ``{server id (str): payload}`` sorted by id, or
+    ``None`` when the manager is unreachable/mid-fault — the shared
+    plumbing under every ``*_dump`` scrape helper."""
+    try:
+        stub = ClientCtrlStub(manager_addr)
+        try:
+            rep = stub.request(req, timeout=timeout)
+        finally:
+            stub.close()
+    except Exception:
+        return None
+    return {
+        str(sid): payload
+        for sid, payload in sorted((rep.payloads or {}).items())
+    }
+
+
 def scrape_metrics(manager_addr: Tuple[str, int],
                    timeout: float = 30.0, compact: bool = False) -> dict:
     """One-shot telemetry scrape: ``metrics_dump`` through the manager,
@@ -31,18 +51,11 @@ def scrape_metrics(manager_addr: Tuple[str, int],
     # only the NETWORK half is best-effort: a snapshot-schema mismatch in
     # the trimming below must raise loudly, not silently commit
     # server_metrics: {} into bench artifacts while CI stays green
-    try:
-        stub = ClientCtrlStub(manager_addr)
-        try:
-            rep = stub.request(CtrlRequest("metrics_dump"), timeout=timeout)
-        finally:
-            stub.close()
-    except Exception:
+    out = _ctrl_scrape(
+        manager_addr, CtrlRequest("metrics_dump"), timeout
+    )
+    if out is None:
         return {}
-    out = {
-        str(sid): snap
-        for sid, snap in sorted((rep.payloads or {}).items())
-    }
     if compact:
         keep = ("ticks_to_commit", "api_request_latency_us",
                 "wal_fsync_us", "wal_group_commit_batch")
@@ -59,6 +72,29 @@ def scrape_metrics(manager_addr: Tuple[str, int],
             for sid, snap in out.items()
         }
     return out
+
+
+def scrape_flight(manager_addr: Tuple[str, int],
+                  last_n: Optional[int] = None,
+                  timeout: float = 30.0) -> dict:
+    """One-shot graftscope scrape: ``flight_dump`` through the manager,
+    returning ``{server id (str): flight dump}`` — each replica's typed
+    event ring (``server.flight_snapshot``), trimmed to the ``last_n``
+    newest events per replica when given.  Best-effort like
+    :func:`scrape_metrics`: an unreachable manager yields ``{}`` so a
+    failing soak's bundle writer never dies on its own diagnostics."""
+    out = _ctrl_scrape(
+        manager_addr,
+        CtrlRequest(
+            "flight_dump",
+            payload=(
+                {"last_n": int(last_n)}
+                if last_n is not None else None
+            ),
+        ),
+        timeout,
+    )
+    return {} if out is None else out
 
 
 class ClientCtrlStub:

@@ -38,6 +38,12 @@ KERNEL_CONTRACT: Tuple[Tuple[str, str, str], ...] = (
     ("C1", "state-geometry",
      "every state leaf leads with [G, R]; int32 commit_bar / exec_bar "
      "[G, R] leaves are present (engine freeze masks + effects mirror)"),
+    ("C10", "input-declarations",
+     "every step-input name the kernel reads — including optional "
+     ".get()-style reads, which a trace cannot KeyError on — is a base "
+     "input (n_proposals/value_base/exec_floor) or declared in "
+     "EXTRA_INPUTS, so the verified/tainted surface covers every lane "
+     "the kernel can consume"),
     ("C2", "state-dtype",
      "protocol state is integer/bool only — no float leaves"),
     ("C3", "outbox-shape",
@@ -112,8 +118,9 @@ class ProtocolKernel:
     # exactly this superset: an undeclared input either KeyErrors the
     # trace (direct subscript reads) or — for optional `.get()`-style
     # reads — silently drops that branch from the verified/tainted
-    # surface, so keep the declaration in sync with every input the
-    # kernel can consume.
+    # surface.  The declaration is no longer honor-system: rule C10
+    # AST-cross-checks every input-name literal the kernel's class
+    # bodies read against this table.
     EXTRA_INPUTS: Tuple[Tuple[str, str], ...] = ()
     # declared-intentional ungated inbox->state flows for the
     # flags-taint pass, as (inbox_leaf, state_leaf, reason).  The pass

@@ -30,10 +30,15 @@ class ExternalApi:
         batch_interval: float = 0.001,
         max_batch_size: int = 5000,
         registry=None,
+        flight=None,
     ):
         self.api_addr = api_addr
         self.batch_interval = batch_interval
         self.max_batch_size = max_batch_size
+        # graftscope seam (host/tracing.FlightRecorder): api_ingress /
+        # api_reply events keyed by (client, req_id) — the request-span
+        # endpoints the trace exporter joins to the propose/commit chain
+        self.flight = flight
         # telemetry seam (host/telemetry.MetricsRegistry): request→reply
         # latency is measured HERE, at the client-facing socket plane —
         # it covers queueing, consensus, durability, and reply routing,
@@ -121,6 +126,11 @@ class ExternalApi:
                 reg.observe_s("api_request_latency_us",
                               time.monotonic() - t0)
             reg.counter_add("api_replies_total", kind=reply.kind)
+        if self.flight is not None:
+            self.flight.record(
+                "api_reply", client=client, req_id=reply.req_id,
+                kind=reply.kind,
+            )
         w = self._writers.get(client)
         if w is None or w.is_closing():
             self._writers.pop(client, None)
@@ -149,6 +159,11 @@ class ExternalApi:
                         writer, ApiReply(kind="leave", req_id=req.req_id)
                     )
                     break
+                if self.flight is not None:
+                    self.flight.record(
+                        "api_ingress", client=int(client),
+                        req_id=req.req_id, kind=req.kind,
+                    )
                 if self.registry is not None:
                     self.registry.counter_add("api_requests_total")
                     arr = self._arrivals

@@ -75,6 +75,9 @@ class CtrlMsg:
     #   snapshot_up_to: new_start
     #   metrics_dump -> metrics_reply: snapshot (telemetry scrape;
     #     server.metrics_snapshot() — device lanes + host registry)
+    #   flight_dump -> flight_reply: flight (graftscope scrape;
+    #     server.flight_snapshot() — the typed-event ring + drop
+    #     accounting; request payload may carry {"last_n": n})
     #   leave / leave_reply
     payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -85,12 +88,14 @@ class CtrlRequest:
 
     kind: str  # query_info | query_conf | reset_servers | pause_servers
     #            | resume_servers | take_snapshot | inject_faults
-    #            | metrics_dump | leave
+    #            | metrics_dump | flight_dump | leave
     servers: Optional[List[int]] = None  # None = all
     durable: bool = True                 # reset: keep durable files?
     payload: Optional[Dict[str, Any]] = None  # inject_faults: fault spec
     #   {"net": FrameFaults spec | None, "wal": wal spec | None, "seed": n}
-    #   relayed verbatim to each target server as a ``fault_ctl`` CtrlMsg
+    #   relayed verbatim to each target server as a ``fault_ctl`` CtrlMsg;
+    #   flight_dump: {"last_n": n} trims each replica's dump to its n
+    #   newest events
 
 
 @dataclasses.dataclass(frozen=True)

@@ -397,6 +397,24 @@ class NemesisRunner:
             if self._on_action is not None:
                 self._on_action(tick, desc)
 
+    def flight_tails(self, last_n: int = 256) -> Dict[str, Any]:
+        """Per-replica flight-recorder tails (graftscope) for failure
+        repro bundles: what each survivor was doing in its final ticks,
+        alongside the seed + history the bundle already carries.  Must
+        run while the cluster is still up (the soak calls it before
+        teardown); best-effort — diagnostics never mask the verdict."""
+        try:
+            rep = self._request(CtrlRequest(
+                "flight_dump", payload={"last_n": int(last_n)},
+            ), timeout=30.0)
+            return {
+                str(sid): dump
+                for sid, dump in sorted((rep.payloads or {}).items())
+            }
+        except Exception as e:
+            pf_warn(logger, f"flight scrape failed: {e}")
+            return {}
+
     def heal_all(self) -> None:
         """Belt-and-braces final heal: clear every injector and resume
         everyone, so the recovery assertion never races a leftover

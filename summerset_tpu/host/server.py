@@ -59,6 +59,7 @@ from .payload import PayloadStore
 from .statemach import CommandResult, StateMachine, apply_command
 from .storage import LogAction, StorageHub
 from .telemetry import MetricsRegistry, SlotTraces
+from .tracing import FlightRecorder
 from .transport import TransportHub
 
 logger = pf_logger("server")
@@ -157,8 +158,17 @@ class ServerReplica:
         # trace_sample: every n-th proposed batch gets a slot trace
         # (arrival → proposed → committed → applied → replied); 0 = off.
         self.metrics = MetricsRegistry()
+        # graftscope flight recorder (host/tracing.py): a per-server ring
+        # of typed monotonic-stamped events threaded through every hub
+        # seam; flight_record=0 compiles the recorder-off variant the
+        # tier-2f overhead gate compares against
+        self.flight = FlightRecorder(
+            capacity=int(cfg.pop("flight_capacity", 8192)),
+            enabled=bool(cfg.pop("flight_record", True)),
+        )
         self.traces = SlotTraces(
-            self.metrics, sample_every=int(cfg.pop("trace_sample", 8))
+            self.metrics, sample_every=int(cfg.pop("trace_sample", 8)),
+            flight=self.flight,
         )
         self._trace_replied: List[Tuple[int, int]] = []
         # nemesis clock-skew: wall-clock stretch factor on the tick
@@ -169,6 +179,7 @@ class ServerReplica:
         self.ctrl = ControlHub(manager_addr)
         self.me = self.ctrl.me
         self.population = self.ctrl.population
+        self.flight.me = self.me
 
         # protocol kernel over [G, R]; host applier drives the exec bar
         kercfg_cls = type(
@@ -209,7 +220,15 @@ class ServerReplica:
         os.makedirs(backer_dir, exist_ok=True)
         self.wal_path = os.path.join(backer_dir, f"r{self.me}.wal")
         self.snap_path = os.path.join(backer_dir, f"r{self.me}.snap")
-        self.wal = StorageHub(self.wal_path, registry=self.metrics)
+        # checked BEFORE the StorageHub open creates the wal file: this
+        # is what distinguishes a crash-restart (durable state found)
+        # from a first boot in the flight recorder's restart event
+        self._cold_boot = not (
+            os.path.exists(self.wal_path) or os.path.exists(self.snap_path)
+        )
+        self.wal = StorageHub(
+            self.wal_path, registry=self.metrics, flight=self.flight
+        )
         self.statemach = StateMachine()
         self.payloads = PayloadStore(self.G)
         self.applied = [0] * self.G        # exec floor per group (own row)
@@ -341,6 +360,14 @@ class ServerReplica:
 
         self._recover_from_snapshot()
         self._recover_from_wal()
+        # flight event: bring-up recovery done.  cold=False (durable
+        # state predated this boot) is the restarted-replica marker the
+        # crash reports / repro bundles look for; cold=True is a first
+        # boot on an empty backer.
+        self.flight.record(
+            "restart", cold=self._cold_boot, wal_size=self.wal.size,
+            applied=int(sum(self.applied)),
+        )
 
         # p2p mesh join (multipaxos/mod.rs:717-737): proactively connect to
         # lower-id peers, accept from higher ids.  The join is re-sent until
@@ -349,7 +376,7 @@ class ServerReplica:
         try:
             self.transport = TransportHub(
                 self.me, self.population, p2p_addr,
-                registry=self.metrics,
+                registry=self.metrics, flight=self.flight,
             )
             join = CtrlMsg("new_server_join", {
                 "protocol": protocol,
@@ -390,7 +417,9 @@ class ServerReplica:
                     if time.monotonic() > deadline:
                         raise
 
-            self.external = ExternalApi(api_addr, registry=self.metrics)
+            self.external = ExternalApi(
+                api_addr, registry=self.metrics, flight=self.flight,
+            )
         except BaseException:
             # failed bring-up must release every port/handle it grabbed:
             # the supervisor retries the constructor, and a leaked p2p
@@ -906,8 +935,13 @@ class ServerReplica:
             self.origin.add((g, vid))
             # slot trace sampling: arrival is intake-stamped (within one
             # batch interval of the socket arrival; the socket-accurate
-            # end-to-end latency is ExternalApi's api_request_latency_us)
-            self.traces.maybe_start(g, vid, self.tick, time.monotonic())
+            # end-to-end latency is ExternalApi's api_request_latency_us).
+            # The batch's first (client, req_id) is the representative
+            # that joins the request span to the slot span at export.
+            self.traces.maybe_start(
+                g, vid, self.tick, time.monotonic(),
+                client=reqs[0][0], req_id=reqs[0][1].req_id,
+            )
             n_prop[g] = 1
             vbase[g] = vid
             if self.codewords is not None and not (
@@ -1155,7 +1189,10 @@ class ServerReplica:
                     g, take, stride=K * R, residue=b + K * self.me
                 )
                 self.origin.add((g, vid))
-                self.traces.maybe_start(g, vid, self.tick, time.monotonic())
+                self.traces.maybe_start(
+                    g, vid, self.tick, time.monotonic(),
+                    client=take[0][0], req_id=take[0][1].req_id,
+                )
                 self._ep_prop_vids[g, i] = vid
                 piggy[(g, vid)] = take
             n_prop[g] = len(take_buckets)
@@ -1266,13 +1303,14 @@ class ServerReplica:
                 continue
 
             stage_t = t0  # run-loop stage clock (loop_stage_us histograms)
+            stage_us: Dict[str, int] = {}  # this tick's stage durations
 
             def _stage(name: str) -> None:
                 nonlocal stage_t
                 now = time.monotonic()
-                self.metrics.observe(
-                    "loop_stage_us", int((now - stage_t) * 1e6), stage=name
-                )
+                d = int((now - stage_t) * 1e6)
+                self.metrics.observe("loop_stage_us", d, stage=name)
+                stage_us[name] = d
                 stage_t = now
 
             # 1. client intake -> payload ids (one ReqBatch per group/tick)
@@ -1448,6 +1486,10 @@ class ServerReplica:
             self._conf_progress()
             self._leader_edges(fx)
             _stage("apply")  # apply + reply
+            # per-tick flight event: the loop_stage_us stopwatches become
+            # child spans of this tick at export (the `step` stage is the
+            # device scan, so device and host tracks share one timeline)
+            self.flight.record("tick", tick=self.tick, **stage_us)
             if self.record_breakdown:
                 now = time.monotonic()
                 if now - self._bd_last_print >= 5.0:
@@ -1640,6 +1682,10 @@ class ServerReplica:
             self._wal_dirty = True
             if batch is not None:
                 self.traces.mark_committed(g, vid, self.tick)
+                self.flight.record(
+                    "commit", g=g, vid=vid, row=row, col=col,
+                    tick=self.tick,
+                )
                 mine = (g, vid) in self.origin
                 for client, req in batch:
                     res = apply_command(self.statemach._kv, req.cmd)
@@ -1651,6 +1697,10 @@ class ServerReplica:
                     "commits_applied_total", len(batch)
                 )
                 self.traces.mark_applied(g, vid, self.tick)
+                self.flight.record(
+                    "apply", g=g, vid=vid, row=row, col=col,
+                    tick=self.tick,
+                )
                 if mine:
                     self._trace_replied.append((g, vid))
         return apply_fn
@@ -1734,8 +1784,13 @@ class ServerReplica:
             vid = 0 if is_marker else int(win_val[pos[0]])
             if vid != 0:
                 # host-side commit observation: the slot passed under the
-                # commit bar this tick (ticks_to_commit distribution)
+                # commit bar this tick (ticks_to_commit distribution +
+                # the flight recorder's commit event — on EVERY replica,
+                # so follower timelines carry the bar too)
                 self.traces.mark_committed(g, vid, self.tick)
+                self.flight.record(
+                    "commit", g=g, vid=vid, slot=slot, tick=self.tick
+                )
             batch = self._resolve_payload(g, vid)
             if vid != 0 and batch is None:
                 self.missing.add((g, vid))
@@ -1762,6 +1817,9 @@ class ServerReplica:
                     "commits_applied_total", len(batch)
                 )
                 self.traces.mark_applied(g, vid, self.tick)
+                self.flight.record(
+                    "apply", g=g, vid=vid, slot=slot, tick=self.tick
+                )
                 if mine:
                     self._trace_replied.append((g, vid))
             self.applied[g] = slot + 1
@@ -1877,6 +1935,16 @@ class ServerReplica:
                 # FaultPlan (netmodel.ControlInputs.skew_alive).
                 f = p.get("skew")
                 self._tick_scale = float(f) if f else 1.0
+            self.flight.record(
+                "fault_ctl", tick=self.tick,
+                planes=",".join(sorted(
+                    k for k in ("net", "wal", "skew") if k in p
+                )),
+                heal=all(
+                    p.get(k) in (None, 1.0)
+                    for k in ("net", "wal", "skew") if k in p
+                ),
+            )
             self.ctrl.send_ctrl(CtrlMsg("fault_reply"))
         elif msg.kind == "metrics_dump":
             # ctrl-plane scrape: one deterministic snapshot combining the
@@ -1884,6 +1952,14 @@ class ServerReplica:
             self.ctrl.send_ctrl(CtrlMsg(
                 "metrics_reply", {"snapshot": self.metrics_snapshot()}
             ))
+        elif msg.kind == "flight_dump":
+            # graftscope scrape: this replica's flight-recorder ring
+            # (modeled on metrics_dump; trace_export merges the fan-out)
+            self.ctrl.send_ctrl(CtrlMsg("flight_reply", {
+                "flight": self.flight_snapshot(
+                    last_n=(msg.payload or {}).get("last_n")
+                ),
+            }))
         elif msg.kind == "take_snapshot":
             self._take_snapshot()
             self.ctrl.send_ctrl(CtrlMsg("snapshot_reply"))
@@ -1920,6 +1996,23 @@ class ServerReplica:
             "host": self.metrics.snapshot(),
             "traces": self.traces.sampled(),
         }
+
+    def flight_snapshot(self, last_n: Optional[int] = None) -> dict:
+        """The ``flight_dump`` scrape payload: the recorder ring (typed
+        events, drop accounting) plus the identity/progress header and
+        the device metric-lane totals — the anchor that lets the
+        exporter line the device track up against the host tracks on
+        one timeline."""
+        out = self.flight.dump(last_n=last_n)
+        out.update({
+            "protocol": self.protocol,
+            "tick": self.tick,
+            "applied": list(self.applied),
+            "device_lanes": dev_telemetry.snapshot_row(
+                self.state[dev_telemetry.TELEM_KEY], self.me
+            )["lanes"],
+        })
+        return out
 
     def debug_state(self) -> dict:
         """One-line snapshot for wedge diagnosis (VERDICT r2 #1)."""

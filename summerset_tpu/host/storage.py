@@ -200,7 +200,7 @@ class StorageHub:
     """
 
     def __init__(self, path: str, prefer_native: bool = True,
-                 registry=None):
+                 registry=None, flight=None):
         lib = load_wal() if prefer_native else None
         self.backend = _NativeWal(lib, path) if lib else _PyWal(path)
         self.native = lib is not None and prefer_native
@@ -212,6 +212,10 @@ class StorageHub:
         # is THE durability cost — one sync point covers every append
         # since the last (group commit), so batch size rides along
         self.registry = registry
+        # graftscope seam (host/tracing.FlightRecorder): wal_append /
+        # wal_fsync events on the logger thread — the storage track of
+        # the exported timeline (fsync spans carry batch + duration)
+        self.flight = flight
         self._since_sync = 0
         # disk fault injection (host/nemesis.py): a mutable spec consulted
         # by the logger thread before each action.  None = no faults.
@@ -305,12 +309,19 @@ class StorageHub:
         """Run a durability point, timing it and closing out the group-
         commit batch opened by the appends since the last sync."""
         reg = self.registry
-        if reg is None:
+        if reg is None and self.flight is None:
             return fn()
         t0 = time.monotonic()
         res = fn()
-        reg.observe_s("wal_fsync_us", time.monotonic() - t0)
-        reg.observe("wal_group_commit_batch", self._since_sync)
+        dur = time.monotonic() - t0
+        if reg is not None:
+            reg.observe_s("wal_fsync_us", dur)
+            reg.observe("wal_group_commit_batch", self._since_sync)
+        if self.flight is not None:
+            self.flight.record(
+                "wal_fsync", dur_us=int(dur * 1e6),
+                batch=self._since_sync,
+            )
         self._since_sync = 0
         return res
 
@@ -328,7 +339,10 @@ class StorageHub:
         if a.kind == "append":
             if self.registry is not None:
                 self.registry.counter_add("wal_appends_total")
+            if self.registry is not None or self.flight is not None:
                 self._since_sync += 1
+            if self.flight is not None:
+                self.flight.record("wal_append", sync=bool(a.sync))
             if a.sync:
                 # serialize OUTSIDE the timed region: wal_fsync_us must
                 # measure durability (write + fsync), not pickling CPU

@@ -186,7 +186,7 @@ class MetricsRegistry:
 
 
 class SlotTraces:
-    """Sampled per-request slot traces: arrival → proposed tick →
+    """Sampled per-request slot spans: arrival → proposed tick →
     committed tick → applied tick → replied, for the host serving path.
 
     ``sample_every = n`` traces every n-th proposed batch per group (1 =
@@ -195,20 +195,37 @@ class SlotTraces:
     behind the host-plane latency cliff that client-side percentiles
     could only hint at — and the last few full traces ride the scrape for
     eyeballing.
+
+    Span building (graftscope): each sampled trace is keyed by its
+    ``(g, vid)`` slot identity and carries the representative
+    ``(client, req_id)`` of its batch — the junction that connects the
+    api-plane ingress/reply events to the slot's propose/commit/apply
+    events at export time.  When a ``flight``
+    recorder is attached, ``maybe_start`` logs the ``propose`` event
+    with both identities so ``scripts/trace_export.py`` can stitch the
+    full chain api-arrival → propose → commit → apply → reply.
+
+    Locking: EVERY ``_open`` access holds ``_lock`` — ``maybe_start``
+    can ``clear()`` the map under the lock while the mark_* paths run on
+    the replica thread, so a lock-free ``get`` could double-observe a
+    histogram sample or mutate a dict that was already evicted.
     """
 
     KEEP = 32
 
-    def __init__(self, registry: MetricsRegistry, sample_every: int = 8):
+    def __init__(self, registry: MetricsRegistry, sample_every: int = 8,
+                 flight=None):
         self.registry = registry
         self.sample_every = max(0, int(sample_every))
+        self.flight = flight  # optional tracing.FlightRecorder
         self._n = 0
         self._open: Dict[Tuple[int, int], Dict[str, Any]] = {}
         self._done: list = []
         self._lock = threading.Lock()
 
     def maybe_start(self, g: int, vid: int, tick: int,
-                    arrival_s: float) -> None:
+                    arrival_s: float, client: Optional[int] = None,
+                    req_id: Optional[int] = None) -> None:
         if self.sample_every == 0:
             return
         with self._lock:
@@ -220,30 +237,40 @@ class SlotTraces:
             self._open[(g, vid)] = {
                 "g": g, "vid": vid, "t_arrival_s": arrival_s,
                 "tick_proposed": tick,
+                "client": client, "req_id": req_id,
             }
+        if self.flight is not None:
+            self.flight.record(
+                "propose", g=g, vid=vid, tick=tick,
+                client=client, req_id=req_id,
+            )
 
     def mark_committed(self, g: int, vid: int, tick: int) -> None:
-        tr = self._open.get((g, vid))
-        if tr is not None and "tick_committed" not in tr:
+        with self._lock:
+            tr = self._open.get((g, vid))
+            if tr is None or "tick_committed" in tr:
+                return
             tr["tick_committed"] = tick
-            self.registry.observe(
-                "ticks_to_commit", tick - tr["tick_proposed"]
-            )
+            delta = tick - tr["tick_proposed"]
+        self.registry.observe("ticks_to_commit", delta)
 
     def mark_applied(self, g: int, vid: int, tick: int) -> None:
-        tr = self._open.get((g, vid))
-        if tr is not None and "tick_applied" not in tr:
+        with self._lock:
+            tr = self._open.get((g, vid))
+            if tr is None or "tick_applied" in tr:
+                return
             tr["tick_applied"] = tick
-            self.registry.observe(
-                "ticks_to_apply", tick - tr["tick_proposed"]
-            )
+            delta = tick - tr["tick_proposed"]
+        self.registry.observe("ticks_to_apply", delta)
 
     def mark_replied(self, g: int, vid: int, now_s: float) -> None:
-        tr = self._open.pop((g, vid), None)
-        if tr is None:
-            return
-        tr["latency_ms"] = round((now_s - tr.pop("t_arrival_s")) * 1e3, 3)
         with self._lock:
+            tr = self._open.pop((g, vid), None)
+            if tr is None:
+                return
+            tr["latency_ms"] = round(
+                (now_s - tr.pop("t_arrival_s")) * 1e3, 3
+            )
             self._done.append(tr)
             del self._done[: -self.KEEP]
 

@@ -1,0 +1,155 @@
+"""graftscope flight recorder: a fixed-size ring of typed, monotonic-
+stamped events per server — the causal-trace complement of the
+aggregate telemetry plane (``host/telemetry.py``).
+
+Where the metrics registry answers "how slow, on average, is each
+seam", the flight recorder answers "what exactly was this replica doing
+in its final ticks" and "where did THIS request spend its time": every
+hub seam logs a compact event into one per-server ring buffer —
+
+- ``api_ingress`` / ``api_reply`` — client plane (client, req_id);
+- ``propose``                    — a sampled batch entered the log
+                                   ((g, vid) plus the representative
+                                   (client, req_id) that connects the
+                                   request span to the slot span);
+- ``tick``                       — one run-loop iteration with its
+                                   stage durations (the loop_stage_us
+                                   stopwatches as child spans; the
+                                   ``step`` stage is the device scan);
+- ``frame_tx`` / ``frame_rx``    — transport frames with (peer, seq):
+                                   ``seq`` is the sender's tick number,
+                                   which already rides the wire, so tx
+                                   and rx pair at export time across two
+                                   servers' dumps with no wire change;
+- ``wal_append`` / ``wal_fsync`` — storage plane (fsync carries the
+                                   group-commit batch size + duration);
+- ``commit`` / ``apply``         — a slot passed the commit bar / was
+                                   applied, on every replica (not just
+                                   the proposer);
+- ``fault_ctl`` / ``crash`` / ``restart`` — nemesis actions, supervisor-
+                                   observed crashes, and recovery.
+
+The ring is lock-cheap: one mutex guarding a bounded ``deque`` append
+(the write path is an int stamp + tuple append, ~1us); overflow drops
+the OLDEST events and the drop count is part of every dump, so a
+truncated view is always visible as truncated.  Stamps are
+``time.monotonic()`` microseconds — never wallclock, which can jump and
+reorder spans (graftlint H103 enforces this for the whole module).
+
+Dumps travel the ctrl plane: ``CtrlRequest("flight_dump")`` fans out and
+gathers ``{sid: dump}`` exactly like ``metrics_dump``; NemesisRunner
+failure repro bundles and the test_cluster supervisor's crash reports
+attach the last-N tails automatically.  ``scripts/trace_export.py``
+merges per-server dumps into one Chrome-trace/Perfetto timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: the event taxonomy (dump consumers index by these names; appending is
+#: fine, renames invalidate committed TRACE.json artifacts)
+EVENT_TYPES = (
+    "api_ingress",   # client request hit the api plane (client, req_id)
+    "api_reply",     # reply left the api plane (client, req_id, kind)
+    "propose",       # sampled batch proposed (g, vid, tick, client, req_id)
+    "tick",          # run-loop iteration (tick, per-stage durations us)
+    "frame_tx",      # p2p frame sent (peer=dst, seq=sender tick, nbytes)
+    "frame_rx",      # p2p frame received (peer=src, seq=sender tick, nbytes)
+    "wal_append",    # WAL record appended (sync flag)
+    "wal_fsync",     # group-commit durability point (dur_us, batch)
+    "commit",        # slot passed the commit bar (g, vid, slot, tick)
+    "apply",         # slot applied to the KV (g, vid, slot, tick)
+    "fault_ctl",     # nemesis fault_ctl received (planes touched)
+    "crash",         # supervisor-observed crash (error)
+    "restart",       # bring-up recovery completed (wal records, applied
+                     # floor; cold=True means first boot, empty backer)
+)
+_EVENT_SET = frozenset(EVENT_TYPES)
+
+SCHEMA_VERSION = 1
+
+
+class FlightRecorder:
+    """Fixed-size, lock-cheap ring of typed monotonic-stamped events.
+
+    ``enabled=False`` turns every ``record`` into one attribute read —
+    the recorder-off variant the tier-2f overhead gate compares against.
+    ``capacity`` bounds memory AND dump size; overflow drops oldest.
+    """
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True,
+                 me: int = -1):
+        self.capacity = max(16, int(capacity))
+        self.enabled = bool(enabled)
+        self.me = me
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=self.capacity)
+        self._seq = 0  # events ever recorded (>= len(_buf))
+        # incarnation floor: a crash-restarted server gets a FRESH
+        # recorder (and restarts its tick counter, reusing wire seqs),
+        # so the exporter uses this birth stamp to refuse pairing the
+        # new incarnation's frames against a peer's stale rx events
+        self._t_start_us = int(time.monotonic() * 1e6)
+
+    # -- write side (every hub seam; hot-path safe) -------------------------
+    def record(self, etype: str, **fields: Any) -> None:
+        """Append one event.  ``etype`` must be a declared
+        :data:`EVENT_TYPES` name — an undeclared type is a contributor
+        bug and fails loudly, same policy as the device metric lanes."""
+        if not self.enabled:
+            return
+        if etype not in _EVENT_SET:
+            raise KeyError(etype)
+        with self._lock:
+            # stamp INSIDE the lock: a pre-lock stamp lets a preempted
+            # writer append behind a later-stamped peer, breaking the
+            # ring's oldest-first stamp order that dumps/tails rely on
+            t_us = int(time.monotonic() * 1e6)
+            self._buf.append((self._seq, t_us, etype, fields))
+            self._seq += 1
+
+    # -- read side -----------------------------------------------------------
+    def dump(self, last_n: Optional[int] = None) -> Dict[str, Any]:
+        """JSON-able snapshot: the retained events (oldest first, trimmed
+        to ``last_n`` newest when given) plus the drop accounting that
+        makes truncation visible."""
+        with self._lock:
+            events = list(self._buf)
+            total = self._seq
+        if last_n is not None:
+            n = int(last_n)
+            # n <= 0 means "metadata only" (events[-0:] would be ALL)
+            events = events[-n:] if n > 0 else []
+        return {
+            "v": SCHEMA_VERSION,
+            "me": self.me,
+            "t_start_us": self._t_start_us,
+            "count": total,
+            "dropped": total - len(events),
+            "t_dump_us": int(time.monotonic() * 1e6),
+            # "n" is the ring's own event counter ("seq" stays free for
+            # the frame events' wire sequence field)
+            "events": [
+                {"n": seq, "t_us": t_us, "type": etype, **fields}
+                for seq, t_us, etype, fields in events
+            ],
+        }
+
+    def tail(self, n: int = 64) -> List[str]:
+        """The last ``n`` events rendered one per line — the
+        crash-report attachment format (test_cluster supervisor)."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            events = list(self._buf)[-n:]
+        return [
+            f"#{seq} t={t_us}us {etype} " + " ".join(
+                f"{k}={fields[k]}" for k in sorted(fields)
+            )
+            for seq, t_us, etype, fields in events
+        ]

@@ -89,7 +89,7 @@ def hard_close(sock: socket.socket) -> None:
 
 class TransportHub:
     def __init__(self, me: int, population: int, p2p_addr: Tuple[str, int],
-                 registry=None):
+                 registry=None, flight=None):
         self.me = me
         self.population = population
         self.p2p_addr = p2p_addr
@@ -98,6 +98,11 @@ class TransportHub:
         # reconnect storm shows up as transport_connects outrunning the
         # population
         self.registry = registry
+        # graftscope seam (host/tracing.FlightRecorder): frame_tx /
+        # frame_rx events with (peer, seq) where seq is the SENDER's tick
+        # number — it already rides the wire in every frame, so tx and rx
+        # pair across two servers' dumps with no wire-format change
+        self.flight = flight
         self._conns: Dict[int, socket.socket] = {}
         self._wlocks: Dict[int, threading.Lock] = {}
         # live-cluster fault injection (host/nemesis.py): a FrameFaults
@@ -277,6 +282,15 @@ class TransportHub:
                         # sleeping in the per-peer messenger delays every
                         # later frame too — a slow link, never reordering
                         time.sleep(d)
+                if self.flight is not None:
+                    # post-drop AND post-delay, like the counters: the
+                    # event marks DELIVERY to the replica, so a delayed
+                    # link shows its injected latency in the exported
+                    # tx→rx arrows instead of a fictitious instant hop
+                    self.flight.record(
+                        "frame_rx", peer=peer, seq=int(tick),
+                        nbytes=nbytes,
+                    )
                 self._rq[peer].put((tick, payload))
                 # per-peer delivery sample for the adaptive perf model
                 # (send-stamped frames; monotonic is machine-wide, so the
@@ -327,6 +341,14 @@ class TransportHub:
                     self.registry.counter_add(
                         "transport_bytes_sent", copies * len(buf),
                         peer=peer,
+                    )
+                if self.flight is not None:
+                    # recorded after the sendall (outside the write
+                    # lock): an egress-dropped or failed frame was never
+                    # on the wire, so it must not mint a tx event
+                    self.flight.record(
+                        "frame_tx", peer=peer, seq=int(tick),
+                        nbytes=copies * len(buf),
                     )
             except OSError:
                 if self._conns.get(peer) is sock:

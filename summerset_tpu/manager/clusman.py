@@ -175,7 +175,7 @@ class ClusterManager:
             )
         elif msg.kind in (
             "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
-            "fault_reply", "metrics_reply",
+            "fault_reply", "metrics_reply", "flight_reply",
         ):
             # waiters get (sid, payload): orchestration kinds ignore the
             # payload, gather kinds (metrics_reply) collect it per sid
@@ -242,9 +242,13 @@ class ClusterManager:
             pf_warn(logger, f"{kind}: timed out waiting for replies")
         finally:
             self._pending_replies[reply_kind].remove(q)
-        if kind == "metrics_dump":
+        # gather kinds return per-sid payloads; orchestration kinds ack
+        gather_key = {
+            "metrics_dump": "snapshot", "flight_dump": "flight",
+        }.get(kind)
+        if gather_key is not None:
             return CtrlReply(kind, done=done, payloads={
-                sid: rp.get("snapshot") for sid, rp in gathered.items()
+                sid: rp.get(gather_key) for sid, rp in gathered.items()
             })
         return CtrlReply(kind, done=done)
 
@@ -352,6 +356,12 @@ class ClusterManager:
             # (device metric lanes + host registry + sampled traces)
             return await self._fanout_wait(
                 "metrics_dump", "metrics_reply", req
+            )
+        if req.kind == "flight_dump":
+            # graftscope scrape: gather each live server's flight-
+            # recorder ring (payload relays e.g. {"last_n": n})
+            return await self._fanout_wait(
+                "flight_dump", "flight_reply", req, extra=req.payload
             )
         return CtrlReply("unknown")
 

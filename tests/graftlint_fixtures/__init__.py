@@ -153,6 +153,26 @@ class BogusDurableKernel(GoodKernel):
     DURABLE_WINDOWS = ("win_val", "win_ghost")
 
 
+class UndeclaredInputKernel(GoodKernel):
+    """C10: an optional ``.get()``-style step-input read that
+    EXTRA_INPUTS never declares — the honor-system gap: the trace sees
+    no such input, so the branch silently drops from the verified
+    surface instead of KeyError-ing like a direct subscript would."""
+
+    name = "FixtureUndeclaredInput"
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        ghost = inputs.get("ghost_lane")  # the violation: undeclared
+        if ghost is not None:
+            s["commit_bar"] = s["commit_bar"] + ghost[:, None]
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
 FIXTURES = {
     "fixturegood": GoodKernel,
     "fixtureunflagged": UnflaggedInboxReadKernel,
@@ -162,6 +182,7 @@ FIXTURES = {
     "fixturemissingflags": MissingFlagsKernel,
     "fixtureundeclaredbroadcast": UndeclaredBroadcastKernel,
     "fixturebogusdurable": BogusDurableKernel,
+    "fixtureundeclaredinput": UndeclaredInputKernel,
 }
 
 

@@ -49,6 +49,10 @@ class Cluster:
         self._threads = []
         self._man_loop = None
         self._stopping = False
+        # supervisor crash reports: {me, error, flight_tail} per crash —
+        # the flight-recorder tail says what the replica was doing in
+        # its final ticks, not just which exception killed it
+        self.crash_reports = []
 
         man = ClusterManager(
             protocol, ("127.0.0.1", self.srv_port),
@@ -117,8 +121,24 @@ class Cluster:
             try:
                 restart = rep.run()
             except Exception as e:
-                print(f"replica {rep.me} crashed: {e!r}; restarting",
-                      flush=True)
+                try:
+                    # stamp the crash into the ring first, so the tail
+                    # (and any later flight_dump of a kept recorder)
+                    # carries the terminal marker itself
+                    rep.flight.record("crash", error=repr(e))
+                    tail = rep.flight.tail(48)
+                except Exception:
+                    tail = []
+                self.crash_reports.append({
+                    "me": rep.me, "error": repr(e), "flight_tail": tail,
+                })
+                print(
+                    f"replica {rep.me} crashed: {e!r}; restarting\n"
+                    "  last flight events:\n" + "\n".join(
+                        f"    {line}" for line in tail[-12:]
+                    ),
+                    flush=True,
+                )
                 restart = True
             rep.shutdown()
             self.replicas.pop(rep.me, None)
@@ -406,6 +426,78 @@ class TestClusterTesterSuite:
                 assert s["host"]["counters"].get(
                     "commits_applied_total", 0
                 ) > 0, (sid, s["host"]["counters"])
+
+    def test_flight_dump_scrape_with_restarted_replica(self, cluster):
+        """graftscope end-to-end: a live cluster answers the
+        ``flight_dump`` ctrl scrape from every replica — INCLUDING one
+        that was crash-restarted mid-test (its fresh recorder carries
+        the ``restart`` recovery marker) — and the merged dumps pair at
+        least one transport frame's tx/rx across two replicas and
+        export to a schema-valid Chrome trace with a connected
+        api→propose→commit→apply→reply chain.  Runs only for the
+        MultiPaxos param: the Raft cluster exercises the identical
+        host-plane code paths, and the extra reset would spend tier-1
+        budget re-proving it."""
+        if cluster.protocol != "MultiPaxos":
+            pytest.skip("host-plane path identical; save the reset cost")
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)), "..", "scripts",
+        ))
+        import trace_export
+
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import (
+            GenericEndpoint, scrape_flight,
+        )
+        from summerset_tpu.host.messages import CtrlRequest
+
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        # crash-restart one replica so its dump is a post-recovery ring
+        victim = sorted(cluster.replicas)[0]
+        ep.ctrl.request(
+            CtrlRequest("reset_servers", servers=[victim], durable=True),
+            timeout=180,
+        )
+        time.sleep(1.0)
+        ep.reconnect()
+        drv = DriverClosedLoop(ep)
+        # trace_sample defaults to 8: enough writes that at least one
+        # batch lands a sampled propose event on some replica
+        for i in range(20):
+            drv.checked_put(f"fltk{i}", f"v{i}")
+        time.sleep(0.5)  # let followers apply + fsync the tail
+        for _ in range(4):
+            dumps = scrape_flight(cluster.manager_addr)
+            if len(dumps) == 3:
+                break
+            time.sleep(2.0)
+        ep.leave()
+        assert len(dumps) == 3, dumps.keys()
+        for sid, d in dumps.items():
+            assert d["count"] >= len(d["events"]) > 0, (sid, d["count"])
+            assert d["dropped"] == d["count"] - len(d["events"])
+        # the restarted victim's ring began at recovery: a NON-cold
+        # restart marker (durable state predated the boot) — every
+        # replica records a cold restart at first bring-up, so the bare
+        # event type would not prove the reset actually happened
+        assert any(
+            ev["type"] == "restart" and ev.get("cold") is False
+            for ev in dumps[str(victim)]["events"]
+        ), [ev for ev in dumps[str(victim)]["events"]
+            if ev["type"] == "restart"]
+        # tx/rx pairing across two different replicas' dumps
+        pairs = trace_export.paired_frames(dumps)
+        assert pairs and any(p["src"] != p["dst"] for p in pairs)
+        # merged export is schema-valid and carries a connected chain
+        doc = trace_export.export_chrome(dumps)
+        assert trace_export.validate_chrome(doc) == []
+        assert trace_export.find_request_chains(dumps), (
+            "no connected request chain in the merged dumps"
+        )
 
     def test_conf_rejected_without_conf_plane(self, cluster):
         """No request kind is ever silently dropped: a conf request to a

@@ -59,6 +59,7 @@ def test_good_fixture_is_clean():
         ("fixturemissingflags", verify_kernel, ["c746d187a51b"]),
         ("fixtureundeclaredbroadcast", verify_kernel, ["43ec345af97e"]),
         ("fixturebogusdurable", verify_kernel, ["0438a08b7ffd"]),
+        ("fixtureundeclaredinput", verify_kernel, ["fb44c6558984"]),
     ],
 )
 def test_broken_fixture_fingerprint(name, passfn, expected):
@@ -74,6 +75,7 @@ def test_broken_fixtures_fail_only_their_rule():
     assert verify_kernel(make_fixture, "fixtureunflaggedeffects").ok
     assert verify_kernel_taint(make_fixture, "fixturefloatstate").ok
     assert verify_kernel_taint(make_fixture, "fixturebogusdurable").ok
+    assert verify_kernel_taint(make_fixture, "fixtureundeclaredinput").ok
 
 
 def test_taint_while_cond_is_an_implicit_flow():
@@ -226,6 +228,21 @@ class FaultPlan:
         )
 """
 
+_MONO_SCOPE = """
+import time
+
+class FlightRecorder:
+    def record(self):
+        return time.monotonic()   # the sanctioned stamp family
+
+    def bad_stamp(self):
+        return time.time()        # wallclock in the recorder: fires
+
+
+def module_level_helper():
+    return time.time()            # "*" scope covers the whole module
+"""
+
 
 def _scan(tmp_path, src, rel):
     p = tmp_path / "mod.py"
@@ -297,6 +314,28 @@ def test_hostlint_seeded_scope(tmp_path):
         "FaultPlan.generate:random.Random",
         "FaultPlan.generate:time.time",
     ]
+
+
+def test_hostlint_monotonic_scope_allows_monotonic_flags_wallclock(
+    tmp_path,
+):
+    """The tracing plane's H103 coverage is a SCOPED allow, not a
+    blanket waiver: time.monotonic() in host/tracing.py is clean, but
+    time.time() there still fires — for the whole module ("*" scope),
+    functions included."""
+    findings, suppressed = _scan(tmp_path, _MONO_SCOPE, "host/tracing.py")
+    assert not suppressed
+    assert sorted((f.code, f.scope) for f in findings) == [
+        ("H103", "FlightRecorder.bad_stamp:time.time"),
+        ("H103", "module_level_helper:time.time"),
+    ]
+
+
+def test_hostlint_monotonic_scope_is_module_keyed(tmp_path):
+    """The same source outside the tracing module keeps today's
+    behavior: no monotonic-scope rule applies."""
+    findings, _ = _scan(tmp_path, _MONO_SCOPE, "host/other.py")
+    assert findings == []
 
 
 def test_hostlint_seeded_scope_wallclock_spellings(tmp_path):
@@ -372,7 +411,8 @@ def test_kernel_contract_table_is_authoritative():
     codes = [code for code, _, _ in KERNEL_CONTRACT]
     assert codes == sorted(set(codes)), "table codes unsorted/duplicated"
     assert codes == [
-        "C1", "C2", "C3", "C4", "C5", "C6", "C7", "C8", "C9", "T1", "T9",
+        "C1", "C10", "C2", "C3", "C4", "C5", "C6", "C7", "C8", "C9",
+        "T1", "T9",
     ]
     assert rule_finding("C1", "K", "leaf", "m").code == "C1"
     with pytest.raises(KeyError):

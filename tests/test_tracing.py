@@ -1,0 +1,443 @@
+"""graftscope tests: the flight-recorder ring (overflow + drop
+accounting, taxonomy enforcement, enabled gating), the Chrome-trace
+exporter (tx/rx pairing, clock alignment, request-chain stitching,
+schema validation incl. its negative paths), and the nemesis repro
+bundle carrying per-replica flight tails.
+"""
+
+import os
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts",
+))
+
+import trace_export  # noqa: E402
+
+from summerset_tpu.host.telemetry import MetricsRegistry, SlotTraces  # noqa: E402
+from summerset_tpu.host.tracing import (  # noqa: E402
+    EVENT_TYPES,
+    FlightRecorder,
+)
+
+
+# ------------------------------------------------------------- recorder ----
+class TestFlightRecorder:
+    def test_ring_overflow_drops_oldest_and_counts(self):
+        fr = FlightRecorder(capacity=16, me=1)
+        for i in range(100):
+            fr.record("tick", tick=i)
+        d = fr.dump()
+        assert d["me"] == 1
+        assert d["count"] == 100
+        assert len(d["events"]) == 16
+        assert d["dropped"] == 84
+        # oldest dropped: the retained window is the NEWEST 16
+        assert [ev["tick"] for ev in d["events"]] == list(range(84, 100))
+        # stamps are monotone within the ring
+        ts = [ev["t_us"] for ev in d["events"]]
+        assert ts == sorted(ts)
+
+    def test_last_n_trim_is_visible_as_dropped(self):
+        fr = FlightRecorder(capacity=64)
+        for i in range(10):
+            fr.record("wal_append", sync=False)
+        d = fr.dump(last_n=3)
+        assert len(d["events"]) == 3
+        assert d["count"] == 10 and d["dropped"] == 7
+
+    def test_last_n_zero_means_metadata_only(self):
+        """events[-0:] is ALL of them — last_n=0 must mean none (and
+        tail(0) likewise), so a metadata-only scrape stays tiny."""
+        fr = FlightRecorder(capacity=64)
+        for i in range(10):
+            fr.record("tick", tick=i)
+        d = fr.dump(last_n=0)
+        assert d["events"] == [] and d["dropped"] == 10
+        assert fr.tail(0) == []
+
+    def test_undeclared_event_type_fails_loudly(self):
+        fr = FlightRecorder()
+        with pytest.raises(KeyError):
+            fr.record("not_an_event", x=1)
+        assert set(EVENT_TYPES) >= {"api_ingress", "propose", "commit",
+                                    "frame_tx", "frame_rx", "wal_fsync",
+                                    "crash", "restart"}
+
+    def test_disabled_recorder_is_a_noop(self):
+        fr = FlightRecorder(enabled=False)
+        fr.record("tick", tick=0)
+        assert fr.dump()["count"] == 0
+        fr.enabled = True
+        fr.record("tick", tick=1)
+        assert fr.dump()["count"] == 1
+
+    def test_tail_renders_last_events(self):
+        fr = FlightRecorder()
+        for i in range(5):
+            fr.record("commit", g=0, vid=i, slot=i, tick=i)
+        lines = fr.tail(2)
+        assert len(lines) == 2
+        assert "commit" in lines[-1] and "vid=4" in lines[-1]
+
+    def test_concurrent_writers_keep_accounting_consistent(self):
+        fr = FlightRecorder(capacity=128)
+
+        def hammer(n):
+            for i in range(200):
+                fr.record("frame_rx", peer=n, seq=i, nbytes=1)
+
+        ts = [threading.Thread(target=hammer, args=(n,)) for n in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        d = fr.dump()
+        assert d["count"] == 800
+        assert len(d["events"]) == 128 and d["dropped"] == 672
+        seqs = [ev["seq"] for ev in d["events"]]
+        assert len(seqs) == 128  # no torn/partial records
+        # stamps are taken INSIDE the ring lock, so the retained window
+        # is stamp-ordered even under contention
+        ts_ = [ev["t_us"] for ev in d["events"]]
+        assert ts_ == sorted(ts_)
+
+
+# ----------------------------------------------- SlotTraces lock regression
+class TestSlotTracesLocking:
+    def test_concurrent_marks_never_double_observe(self):
+        """Regression for the `_open` locking hole: `mark_committed` /
+        `mark_applied` used to read-modify `_open` without the lock
+        while `maybe_start` could `clear()` it under the lock, so two
+        racing markers could both see 'not yet committed' and
+        double-feed the histogram.  All `_open` access now holds the
+        lock: every sampled trace contributes EXACTLY one
+        ticks_to_commit sample no matter how many threads mark it."""
+        reg = MetricsRegistry()
+        tr = SlotTraces(reg, sample_every=1)
+        n_traces = 200
+        for vid in range(1, n_traces + 1):
+            tr.maybe_start(0, vid, tick=0, arrival_s=0.0)
+
+        barrier = threading.Barrier(4)
+
+        def mark_all():
+            barrier.wait()
+            for vid in range(1, n_traces + 1):
+                tr.mark_committed(0, vid, tick=3)
+                tr.mark_applied(0, vid, tick=4)
+
+        threads = [threading.Thread(target=mark_all) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.hist("ticks_to_commit").count == n_traces
+        assert reg.hist("ticks_to_apply").count == n_traces
+
+    def test_concurrent_start_and_mark_do_not_corrupt(self):
+        """maybe_start's overflow clear() racing the markers: no
+        exception, and the histograms only ever see samples from traces
+        that were actually open."""
+        reg = MetricsRegistry()
+        tr = SlotTraces(reg, sample_every=1)
+        stop = threading.Event()
+
+        def starter():
+            vid = 0
+            while not stop.is_set():
+                vid += 1
+                tr.maybe_start(0, vid, tick=vid, arrival_s=0.0)
+
+        def marker():
+            vid = 0
+            while not stop.is_set():
+                vid += 1
+                tr.mark_committed(0, vid, tick=vid + 1)
+                tr.mark_replied(0, vid, now_s=1.0)
+
+        threads = [threading.Thread(target=starter),
+                   threading.Thread(target=marker)]
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join()
+        h = reg.hist("ticks_to_commit")
+        assert h is None or h.count > 0  # survived; samples are sane
+
+    def test_sampled_trace_carries_span_identity(self):
+        """The span-builder promotion: a sampled trace records the
+        representative (client, req_id) and, when a flight recorder is
+        attached, logs the propose event that joins the request span to
+        the slot span."""
+        reg = MetricsRegistry()
+        fr = FlightRecorder()
+        tr = SlotTraces(reg, sample_every=1, flight=fr)
+        tr.maybe_start(2, 9, tick=5, arrival_s=1.0, client=77, req_id=3)
+        tr.mark_committed(2, 9, tick=7)
+        tr.mark_applied(2, 9, tick=7)
+        tr.mark_replied(2, 9, now_s=1.25)
+        done = tr.sampled()
+        assert done[0]["client"] == 77 and done[0]["req_id"] == 3
+        ev = [e for e in fr.dump()["events"] if e["type"] == "propose"]
+        assert ev and ev[0]["g"] == 2 and ev[0]["vid"] == 9
+        assert ev[0]["client"] == 77 and ev[0]["req_id"] == 3
+
+
+# ------------------------------------------------------------- exporter ----
+def _dump(me, events, t0=1_000_000, protocol="MultiPaxos"):
+    evs = []
+    for i, (dt, etype, fields) in enumerate(events):
+        evs.append({"n": i, "t_us": t0 + dt, "type": etype, **fields})
+    return {
+        "v": 1, "me": me, "t_start_us": t0, "count": len(evs),
+        "dropped": 0, "t_dump_us": t0 + 10_000_000, "events": evs,
+        "protocol": protocol, "tick": 100, "applied": [1],
+        "device_lanes": {"commits": 1},
+    }
+
+
+def _two_server_dumps():
+    """Server 0 proposes/commits/replies; frames flow 0->1 and 1->0."""
+    d0 = _dump(0, [
+        (0, "api_ingress", {"client": 9, "req_id": 1, "kind": "req"}),
+        (10, "propose",
+         {"g": 0, "vid": 4, "tick": 7, "client": 9, "req_id": 1}),
+        (12, "frame_tx", {"peer": 1, "seq": 7, "nbytes": 100}),
+        (30, "frame_rx", {"peer": 1, "seq": 6, "nbytes": 90}),
+        (40, "wal_append", {"sync": False}),
+        (55, "wal_fsync", {"dur_us": 10, "batch": 2}),
+        (60, "commit", {"g": 0, "vid": 4, "slot": 0, "tick": 8}),
+        (61, "apply", {"g": 0, "vid": 4, "slot": 0, "tick": 8}),
+        (70, "api_reply", {"client": 9, "req_id": 1, "kind": "reply"}),
+        (80, "tick",
+         {"tick": 8, "intake": 5, "exchange": 10, "step": 20,
+          "log": 3, "apply": 4}),
+    ])
+    d1 = _dump(1, [
+        (5, "frame_tx", {"peer": 0, "seq": 6, "nbytes": 90}),
+        (20, "frame_rx", {"peer": 0, "seq": 7, "nbytes": 100}),
+        (65, "commit", {"g": 0, "vid": 4, "slot": 0, "tick": 9}),
+        (90, "restart", {"wal_size": 0, "applied": 0}),
+    ])
+    return {"0": d0, "1": d1}
+
+
+class TestExporter:
+    def test_paired_frames_cross_replica(self):
+        pairs = trace_export.paired_frames(_two_server_dumps())
+        keys = {(p["src"], p["dst"], p["seq"]) for p in pairs}
+        assert keys == {(0, 1, 7), (1, 0, 6)}
+        for p in pairs:
+            assert p["t_rx_us"] >= p["t_tx_us"] - 50  # same test clock
+
+    def test_unpaired_frames_tolerated(self):
+        """An ingress-dropped frame leaves its tx unmatched — pairing
+        must not desync the later frames (seq pairing, not counting)."""
+        dumps = _two_server_dumps()
+        # server 1 never received seq 7 (drop); a later seq 8 still pairs
+        dumps["1"]["events"] = [
+            ev for ev in dumps["1"]["events"]
+            if not (ev["type"] == "frame_rx" and ev["seq"] == 7)
+        ]
+        dumps["0"]["events"].append(
+            {"t_us": 1_000_100, "type": "frame_tx",
+             "peer": 1, "nbytes": 10, "seq": 8},
+        )
+        dumps["1"]["events"].append(
+            {"t_us": 1_000_120, "type": "frame_rx",
+             "peer": 0, "nbytes": 10, "seq": 8},
+        )
+        pairs = trace_export.paired_frames(dumps)
+        keys = {(p["src"], p["dst"], p["seq"]) for p in pairs}
+        assert (0, 1, 8) in keys and (0, 1, 7) not in keys
+
+    def test_stale_incarnation_rx_not_paired(self):
+        """A crash-restarted sender resets its tick counter, reusing
+        wire seqs; the peer's ring still holds the OLD incarnation's rx
+        for those seqs.  Pairing them would mint rx-before-tx pairs and
+        drive the clock-offset minima negative by the restart gap — the
+        sender's recorder birth stamp (t_start_us) is the guard."""
+        # victim (server 0) restarted at t=5_000_000: fresh ring, fresh
+        # recorder, tx seq 3 REUSED from its previous incarnation
+        d0 = _dump(0, [
+            (10, "restart", {"cold": False, "wal_size": 4, "applied": 2}),
+            (100, "frame_tx", {"peer": 1, "seq": 3, "nbytes": 50}),
+        ], t0=5_000_000)
+        # peer (server 1) never restarted: its ring holds BOTH the old
+        # incarnation's rx of seq 3 (t=1_000_040, before the victim's
+        # rebirth) and the new one (t=5_000_150)
+        d1 = _dump(1, [
+            (40, "frame_rx", {"peer": 0, "seq": 3, "nbytes": 50}),
+            (4_000_150, "frame_rx", {"peer": 0, "seq": 3, "nbytes": 50}),
+        ], t0=1_000_000)
+        pairs = trace_export.paired_frames({"0": d0, "1": d1})
+        assert len(pairs) == 1
+        assert pairs[0]["t_rx_us"] == 5_000_150
+        assert pairs[0]["t_rx_us"] >= pairs[0]["t_tx_us"]
+        # and the offsets stay sane (shared clock => ~0), instead of
+        # being dragged negative by a bogus cross-incarnation pair
+        offs = trace_export.clock_offsets({"0": d0, "1": d1})
+        assert all(abs(o) < 1_000 for o in offs.values())
+
+    def test_find_request_chains_connects_all_stages(self):
+        chains = trace_export.find_request_chains(_two_server_dumps())
+        assert len(chains) == 1
+        c = chains[0]
+        assert (c["client"], c["req_id"], c["g"], c["vid"]) == (9, 1, 0, 4)
+        assert (c["t_ingress_us"] <= c["t_propose_us"]
+                <= c["t_commit_us"] <= c["t_apply_us"]
+                <= c["t_reply_us"])
+
+    def test_reused_req_id_pairs_by_occurrence(self):
+        """(client, req_id) is NOT unique across a session — driver
+        instances restart req ids at 0 on one shared endpoint.  A
+        first-ingress/last-reply join would fuse two different requests
+        into one fictitious multi-second span; occurrence pairing keeps
+        each request's own ingress→reply window and the chain must bind
+        to the occurrence enclosing its slot's propose→apply."""
+        d0 = _dump(0, [
+            # occurrence 1 of (9, 0): a whole earlier request
+            (0, "api_ingress", {"client": 9, "req_id": 0, "kind": "req"}),
+            (50, "api_reply", {"client": 9, "req_id": 0, "kind": "reply"}),
+            # occurrence 2 of the SAME key: the sampled request
+            (1_000, "api_ingress",
+             {"client": 9, "req_id": 0, "kind": "req"}),
+            (1_010, "propose",
+             {"g": 0, "vid": 4, "tick": 7, "client": 9, "req_id": 0}),
+            (1_060, "commit", {"g": 0, "vid": 4, "slot": 0, "tick": 8}),
+            (1_061, "apply", {"g": 0, "vid": 4, "slot": 0, "tick": 8}),
+            (1_070, "api_reply",
+             {"client": 9, "req_id": 0, "kind": "reply"}),
+        ])
+        dumps = {"0": d0}
+        chains = trace_export.find_request_chains(dumps)
+        assert len(chains) == 1
+        c = chains[0]
+        # the chain's span is occurrence 2's own window, not a stitch of
+        # occurrence 1's ingress with occurrence 2's reply
+        assert c["t_reply_us"] - c["t_ingress_us"] == 70
+        # the export emits one req span PER occurrence, distinct ids
+        doc = trace_export.export_chrome(dumps)
+        assert trace_export.validate_chrome(doc) == []
+        req_b = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "req" and e["ph"] == "b"]
+        assert len(req_b) == 2
+        assert len({e["id"] for e in req_b}) == 2
+
+    def test_chain_requires_every_stage(self):
+        dumps = _two_server_dumps()
+        dumps["0"]["events"] = [
+            ev for ev in dumps["0"]["events"] if ev["type"] != "commit"
+        ]
+        assert trace_export.find_request_chains(dumps) == []
+
+    def test_export_is_schema_valid(self):
+        doc = trace_export.export_chrome(_two_server_dumps())
+        assert trace_export.validate_chrome(doc) == []
+        evs = doc["traceEvents"]
+        # one process per replica, named plane tracks
+        names = {
+            (e["pid"], e["args"]["name"])
+            for e in evs if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert (0, "device scan") in names and (1, "transport") in names
+        # request span pair + slot span pair + fsync X span all present
+        phs = [e["ph"] for e in evs]
+        assert phs.count("b") == phs.count("e") >= 2
+        assert any(
+            e["ph"] == "X" and e["name"] == "fsync (group commit)"
+            for e in evs
+        )
+        # the step stage exports as the device scan tick span
+        assert any(
+            e["ph"] == "X" and e["name"] == "device scan tick"
+            for e in evs
+        )
+        # flow arrows pair across pids
+        flows = [e for e in evs if e["ph"] in ("s", "f")]
+        assert flows and len(
+            [e for e in flows if e["ph"] == "s"]
+        ) == len([e for e in flows if e["ph"] == "f"])
+
+    def test_clock_offsets_align_skewed_server(self):
+        dumps = _two_server_dumps()
+        # shift server 1's monotonic base by +1s: offsets must recover
+        # roughly -1s for it (NTP midpoint over the two directions)
+        for ev in dumps["1"]["events"]:
+            ev["t_us"] += 1_000_000
+        offs = trace_export.clock_offsets(dumps)
+        assert offs[0] == 0
+        assert -1_000_100 <= offs[1] <= -999_900
+        doc = trace_export.export_chrome(dumps)
+        assert trace_export.validate_chrome(doc) == []
+
+    def test_validate_rejects_unmatched_span_end(self):
+        doc = {"traceEvents": [
+            {"ph": "E", "name": "x", "pid": 0, "tid": 0, "ts": 5},
+        ]}
+        errors = trace_export.validate_chrome(doc)
+        assert any("without matching B" in e for e in errors)
+        doc = {"traceEvents": [
+            {"ph": "b", "cat": "req", "id": "r1", "name": "x",
+             "pid": 0, "tid": 0, "ts": 5},
+        ]}
+        errors = trace_export.validate_chrome(doc)
+        assert any("unmatched async b" in e for e in errors)
+
+    def test_validate_rejects_non_monotone_stamps(self):
+        doc = {"traceEvents": [
+            {"ph": "i", "s": "t", "name": "a", "pid": 0, "tid": 0,
+             "ts": 10},
+            {"ph": "i", "s": "t", "name": "b", "pid": 0, "tid": 0,
+             "ts": 3},
+        ]}
+        errors = trace_export.validate_chrome(doc)
+        assert any("non-monotone" in e for e in errors)
+
+    def test_validate_rejects_negative_duration(self):
+        doc = {"traceEvents": [
+            {"ph": "X", "name": "a", "pid": 0, "tid": 0, "ts": 1,
+             "dur": -5},
+        ]}
+        assert any(
+            "negative dur" in e
+            for e in trace_export.validate_chrome(doc)
+        )
+
+
+# ---------------------------------------------------- nemesis repro bundle
+def test_fail_bundle_carries_flight_tails():
+    """A nemesis soak failure bundle includes per-replica flight tails
+    alongside the seed + timeline + history (the run collects
+    result['flight'] via NemesisRunner.flight_tails before teardown)."""
+    import nemesis_soak
+
+    from summerset_tpu.host.nemesis import FaultPlan
+    from summerset_tpu.utils.linearize import record_put
+
+    plan = FaultPlan.generate(1, 3, 40)
+    ops = [record_put(0, "k", "v", 0.0, 1.0, True)]
+
+    class StubRunner:
+        executed = [(3, "@00003 crash targets=[1]")]
+
+    fr = FlightRecorder(me=1)
+    fr.record("crash", error="injected")
+    result = {
+        "ok": False, "seed": 1, "error": "injected assertion",
+        "flight": {"1": fr.dump()},
+    }
+    doc = nemesis_soak.fail_bundle_doc(result, plan, StubRunner(), ops)
+    assert doc["timeline"].startswith("# FaultPlan v1 seed=1")
+    assert doc["executed"] and doc["history"][0]["key"] == "k"
+    tails = doc["flight"]
+    assert tails["1"]["events"][0]["type"] == "crash"
+    assert "dropped" in tails["1"]
