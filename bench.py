@@ -63,6 +63,46 @@ def _probe_backend(timeout_s=BACKEND_PROBE_TIMEOUT_S):
     return None
 
 
+def _cpu_fallback(err: str) -> int:
+    """Degrade to the CPU-mesh path instead of rc=1 when the TPU tunnel
+    is down (BENCH_r05 recorded 0 slots/s): re-exec this script as an
+    explicit CPU run — which cannot hang on the tunnel — at a CPU-sized
+    default shape, and pass its one-line JSON artifact through.  The
+    artifact's ``backend``/``backend_note`` fields label the run
+    unambiguously, so a degraded number can never masquerade as a TPU
+    measurement."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["BENCH_BACKEND_NOTE"] = f"cpu fallback: {err}"
+    # explicit BENCH_* overrides still win; otherwise shrink to a shape a
+    # CPU finishes in seconds rather than the 4096-group TPU headline
+    env.setdefault("BENCH_GROUPS", "256")
+    env.setdefault("BENCH_TICKS", "256")
+    env.setdefault("BENCH_RUNS", "1")
+    try:
+        # bounded: if the sitecustomize tunnel dial hangs even the
+        # explicit-CPU child (it fires at interpreter startup, before
+        # JAX_PLATFORMS is consulted), fall back to the labeled rc=1
+        # artifact rather than hanging the capture window forever
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "committed slots/sec, MultiPaxos "
+                      "(backend unavailable, cpu fallback hung)",
+            "value": 0.0,
+            "unit": "slots/sec",
+            "vs_baseline": 0.0,
+            "backend": "none",
+            "error": f"{err}; cpu fallback timed out after 900s",
+        }))
+        return 1
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    return proc.returncode
+
+
 def main():
     # An explicit CPU run (A/B sweeps, verification) can't hang on the
     # tunnel — skip the probe and its extra interpreter+backend bring-up.
@@ -70,14 +110,7 @@ def main():
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
         err = _probe_backend()
     if err is not None:
-        print(json.dumps({
-            "metric": "committed slots/sec, MultiPaxos (backend unavailable)",
-            "value": 0.0,
-            "unit": "slots/sec",
-            "vs_baseline": 0.0,
-            "error": err,
-        }))
-        sys.exit(1)
+        sys.exit(_cpu_fallback(err))
 
     import jax
     import numpy as np
@@ -113,19 +146,20 @@ def main():
         dt = time.perf_counter() - t0
         end = np.asarray(state["commit_bar"]).max(axis=1).sum()
         rate = max(rate, float(end - start) / dt)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"committed slots/sec, MultiPaxos {POPULATION}-replica x "
-                    f"{GROUPS} groups, 1 chip ({jax.devices()[0].platform})"
-                ),
-                "value": round(rate, 1),
-                "unit": "slots/sec",
-                "vs_baseline": round(rate / BASELINE, 4),
-            }
-        )
-    )
+    doc = {
+        "metric": (
+            f"committed slots/sec, MultiPaxos {POPULATION}-replica x "
+            f"{GROUPS} groups, 1 chip ({jax.devices()[0].platform})"
+        ),
+        "value": round(rate, 1),
+        "unit": "slots/sec",
+        "vs_baseline": round(rate / BASELINE, 4),
+        "backend": jax.devices()[0].platform,
+    }
+    note = os.environ.get("BENCH_BACKEND_NOTE")
+    if note:
+        doc["backend_note"] = note
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""NEMESIS.json drift + long-lived-matrix gate (ci.sh tier 2c).
+
+Asserts, WITHOUT bringing up clusters (pure plan regeneration):
+
+1. every committed matrix cell is linearizable (``ok``) with a bounded
+   recovery (``recovery_ticks`` within the soak budget);
+2. per-seed digests are byte-identical to what ``FaultPlan.generate``
+   produces from the current code — the repro contract: a committed
+   NEMESIS.json row can always be replayed with ``--seed N``, so any
+   change to the schedule generator must regenerate the artifact in the
+   same PR (this is the drift gate);
+3. the matrix actually covers the long-lived classes: ``device_reset``,
+   ``conf_change``, and ``take_snapshot`` each occur in at least one
+   scheduled event across the matrix seeds, and the QuorumLeases row
+   (the only conf-plane protocol in the matrix) is present;
+4. end-of-soak boundedness was recorded: WAL sizes under the bound.
+
+Usage:  python scripts/nemesis_gate.py [--json NEMESIS.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nemesis_soak import (  # noqa: E402  (scripts/ sibling import)
+    DEFAULT_BUDGET_TICKS, DEFAULT_TICKS, MATRIX_EXTRA, MATRIX_PROTOCOLS,
+    MATRIX_SEEDS, SOAK_CLASSES, WAL_BOUND_BYTES,
+)
+
+from summerset_tpu.host.nemesis import FaultPlan  # noqa: E402
+
+DEFAULT_REPLICAS = 3
+LONG_LIVED = ("device_reset", "conf_change", "take_snapshot")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=os.path.join(REPO, "NEMESIS.json"))
+    args = ap.parse_args()
+    with open(args.json) as f:
+        rows = json.load(f)
+
+    failures = []
+    by_seed = {
+        s: FaultPlan.generate(
+            s, DEFAULT_REPLICAS, DEFAULT_TICKS, classes=SOAK_CLASSES
+        )
+        for s in MATRIX_SEEDS
+    }
+    want_cells = {
+        (p, s)
+        for p in MATRIX_PROTOCOLS + MATRIX_EXTRA for s in MATRIX_SEEDS
+    }
+    seen_cells = set()
+    for row in rows:
+        cell = (row.get("protocol"), row.get("seed"))
+        seen_cells.add(cell)
+        tag = f"{cell[0]} seed={cell[1]}"
+        if not row.get("ok"):
+            failures.append(f"{tag}: not linearizable/ok "
+                            f"({row.get('error')})")
+        rt = row.get("recovery_ticks")
+        if rt is None or rt > DEFAULT_BUDGET_TICKS:
+            failures.append(f"{tag}: recovery unbounded ({rt} ticks)")
+        plan = by_seed.get(row.get("seed"))
+        if plan is None:
+            failures.append(f"{tag}: seed outside the matrix")
+        elif row.get("digest") != plan.digest():
+            failures.append(
+                f"{tag}: digest drift — committed {row.get('digest')} "
+                f"vs regenerated {plan.digest()}; rerun "
+                "scripts/nemesis_soak.py --matrix and commit the diff"
+            )
+        for me, size in (row.get("wal_bytes") or {}).items():
+            if size > WAL_BOUND_BYTES:
+                failures.append(f"{tag}: replica {me} WAL {size}B over "
+                                f"bound {WAL_BOUND_BYTES}")
+    missing = want_cells - seen_cells
+    if missing:
+        failures.append(f"matrix cells missing: {sorted(missing)}")
+
+    kinds = {ev.kind for p in by_seed.values() for ev in p.events}
+    for cls in LONG_LIVED:
+        if cls not in SOAK_CLASSES:
+            failures.append(f"{cls} missing from SOAK_CLASSES")
+        elif cls not in kinds:
+            failures.append(
+                f"{cls} never scheduled across matrix seeds "
+                f"{MATRIX_SEEDS} — widen the horizon or reseed"
+            )
+
+    if failures:
+        print("NEMESIS gate FAIL:")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print(
+        f"NEMESIS gate OK: {len(rows)} cells linearizable, digests "
+        f"byte-identical per seed, recovery <= {DEFAULT_BUDGET_TICKS} "
+        f"ticks, long-lived classes {LONG_LIVED} all scheduled"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,13 +48,30 @@ set_cpu_devices(8)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
 # the acceptance matrix: 3 seeds x the leader-log / term-vote / coded
-# protocol families, under crash + partition + disk + clock schedules
+# protocol families (plus a QuorumLeases row for the conf plane's
+# revoke-then-adopt barrier), under crash + partition + disk + clock +
+# long-lived (durable reset / ConfChange / compaction) schedules
 MATRIX_PROTOCOLS = ("MultiPaxos", "Raft", "RSPaxos")
+# the QL row exists because conf_change is a no-op-ish failure reply on
+# conf-less protocols; QuorumLeases drives real lease revoke-then-adopt
+# barriers through the same schedules
+MATRIX_EXTRA = ("QuorumLeases",)
 MATRIX_SEEDS = (1, 2, 3)
 SOAK_CLASSES = (
     "crash", "partition", "isolate", "one_way", "drop", "pause",
     "wal_torn", "wal_fsync", "clock_skew",
+    # long-lived cluster classes: durable device/host crash-restart,
+    # membership ConfChange under faults, compaction on the serving path
+    "device_reset", "conf_change", "take_snapshot",
 )
+# end-of-soak boundedness: compaction events must keep every survivor's
+# WAL from growing without bound, and the device window ring can never
+# be outrun by the host applier
+WAL_BOUND_BYTES = 8 << 20
+# argparse defaults shared with scripts/nemesis_gate.py (the gate
+# regenerates plans at exactly these to check digest drift)
+DEFAULT_TICKS = 120
+DEFAULT_BUDGET_TICKS = 4000
 
 
 def protocol_config(protocol: str) -> dict:
@@ -186,6 +203,52 @@ def run_one(protocol: str, seed: int, args) -> dict:
         if len(ops) <= args.min_ops:
             result["error"] = f"history too small: {len(ops)}"
             return result
+        # long-lived boundedness: with take_snapshot in the schedule the
+        # WAL must stay bounded, and the live W-slot window span (propose
+        # frontier minus host-applied floor) can never exceed the ring
+        import numpy as np
+
+        wal_bytes = {}
+        spans = {}
+        win = 32  # tests/test_cluster.Cluster serves window=32
+        for me, r in sorted(cluster.replicas.items()):
+            try:
+                win = r.window
+                wal_bytes[me] = int(r.wal.size)
+                # live ring pressure: the highest frontier this replica
+                # must keep in its W-slot windows (voted OR proposed —
+                # a follower's next_slot idles at 0 while its vote_bar
+                # tracks the leader) minus what the host applier has
+                # released.  Negative (idle restarted row) clips to 0.
+                fr = np.zeros(r.G, np.int64)
+                for k in ("vote_bar", "next_slot", "log_end",
+                          "prop_bar"):
+                    if k in r.state:
+                        fr = np.maximum(
+                            fr, np.asarray(r.state[k])[:, r.me]
+                        )
+                spans[me] = max(
+                    0, int((fr - np.asarray(r.applied, np.int64)).max())
+                )
+            except Exception:
+                pass  # a replica mid-restart has no stable view
+        result["wal_bytes"] = wal_bytes
+        result["window_span"] = spans
+        if not wal_bytes:
+            # the gate must not fail open: post-recovery, at least one
+            # replica should always be measurable — an empty read means
+            # the attribute access broke or the whole cluster is down
+            result["error"] = "boundedness unmeasurable: no replica " \
+                              "contributed wal/window readings"
+            return result
+        over = {m: b for m, b in wal_bytes.items() if b > WAL_BOUND_BYTES}
+        wide = {m: s for m, s in spans.items() if s > win}
+        if over or wide:
+            result["error"] = (
+                f"unbounded growth: wal_bytes over {WAL_BOUND_BYTES} = "
+                f"{over}, window spans over W = {wide}"
+            )
+            return result
         ok, diag = check_history(ops)
         result["ok"] = bool(ok)
         if not ok:
@@ -232,23 +295,30 @@ def main():
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--matrix", action="store_true",
                     help="run the CI seed matrix "
-                         f"({MATRIX_SEEDS} x {MATRIX_PROTOCOLS})")
+                         f"({MATRIX_SEEDS} x {MATRIX_PROTOCOLS} "
+                         f"+ {MATRIX_EXTRA})")
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--clients", type=int, default=3)
-    ap.add_argument("--ticks", type=int, default=80,
-                    help="schedule horizon in nemesis ticks")
+    ap.add_argument("--ticks", type=int, default=DEFAULT_TICKS,
+                    help="schedule horizon in nemesis ticks (the "
+                         "default gives every SOAK_CLASS at least one "
+                         "event across the matrix seeds — "
+                         "scripts/nemesis_gate.py asserts that "
+                         "coverage)")
     ap.add_argument("--tick-len", type=float, default=0.25,
                     help="wall seconds per nemesis tick")
     ap.add_argument("--tick", type=float, default=0.005,
                     help="server tick interval")
-    ap.add_argument("--budget-ticks", type=int, default=4000,
+    ap.add_argument("--budget-ticks", type=int,
+                    default=DEFAULT_BUDGET_TICKS,
                     help="recovery budget in server ticks after heal")
     ap.add_argument("--min-ops", type=int, default=20)
     ap.add_argument("--out", default=os.path.join(REPO, "NEMESIS.json"))
     args = ap.parse_args()
 
     runs = (
-        [(p, s) for p in MATRIX_PROTOCOLS for s in MATRIX_SEEDS]
+        [(p, s)
+         for p in MATRIX_PROTOCOLS + MATRIX_EXTRA for s in MATRIX_SEEDS]
         if args.matrix else [(args.protocol, args.seed)]
     )
     results = []

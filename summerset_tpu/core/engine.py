@@ -9,12 +9,23 @@ receive → protocol step → send, and ``run_ticks`` rolls the tick under
 Pause semantics (manager oracle parity): the engine freezes the entire state
 of non-alive replicas each tick — protocols never see their own pause, same
 as a SIGSTOP'd reference process.
+
+Durable crash semantics: a ``reset`` mask (``ControlInputs.reset``,
+scheduled by ``FaultPlan.compile_device`` as the ``device_reset`` fault
+class) rebuilds the masked replicas' state rows from ONLY their kernel's
+declared ``DURABLE_SCALARS``/``DURABLE_WINDOWS`` leaves at the start of
+the tick — every volatile leaf is rewound to its freshly-booted
+``init_state`` value.  This is the vectorized in-kernel form of the
+host's crash-restart contract (``core/protocol.py``): boot
+``init_state``, then ``restore_durable`` replays the WAL record — the
+durable leaves ARE that record (with applier floor 0), and everything
+else is exactly what a host crash loses.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -62,16 +73,30 @@ class Engine:
             )
         self.seed = seed
         self.net = NetModel(netcfg, kernel.G, kernel.R, kernel.broadcast_lanes)
-        self._tick_jit = jax.jit(partial(_tick, self.kernel, self.net))
+        # the freshly-booted state template a device_reset rewinds
+        # volatile rows to (the host analog boots init_state before
+        # restore_durable; a ServerReplica always boots seed=0, the
+        # engine reuses its own seed).  Closed over by the jitted tick
+        # as constants, and handed out by init() as the initial carry —
+        # the template and the boot state are the SAME arrays, so no
+        # second copy of the [G, R, ...] pytree is ever held.
+        self._boot = self.kernel.init_state(seed)
+        self._tick_jit = jax.jit(
+            partial(_tick, self.kernel, self.net, self._boot)
+        )
         self._run_jit = jax.jit(
-            partial(_run_scan, self.kernel, self.net), static_argnums=3
+            partial(_run_scan, self.kernel, self.net, self._boot),
+            static_argnums=3,
         )
         self._synth_jit = jax.jit(
-            partial(_run_synth, self.kernel, self.net), static_argnums=(2, 3)
+            partial(_run_synth, self.kernel, self.net, self._boot),
+            static_argnums=(2, 3),
         )
 
     def init(self) -> Tuple[Pytree, Pytree]:
-        state = self.kernel.init_state(self.seed)
+        # share the boot template's (immutable) arrays as the initial
+        # carry rather than building a second init_state
+        state = dict(self._boot)
         # metric lanes ride the scan carry (core/telemetry.py); drop the
         # leaf (state.pop("telem")) to compile the lane-free ablation
         telemetry.attach(state, self.kernel.G, self.kernel.R)
@@ -118,16 +143,60 @@ class Engine:
         return self._synth_jit(state, netstate, num_ticks, proposals_per_tick)
 
 
+def reset_durable_rows(
+    kernel: ProtocolKernel, state: Pytree, reset: Any,
+    boot: Optional[Pytree] = None,
+) -> Pytree:
+    """Rebuild the ``reset``-masked ``[G, R]`` replica rows from only the
+    kernel's declared durable leaves: ``DURABLE_SCALARS`` /
+    ``DURABLE_WINDOWS`` entries keep their values verbatim (they are the
+    very arrays the host WAL-logs, so the current row IS the last durable
+    record), and every other leaf is rewound to its freshly-booted
+    ``boot`` value — the same thing a host crash-restart does
+    (``init_state`` then ``restore_durable``).  The boot template, NOT
+    zeros, matters for safety: volatile leaves like the lease holdoffs
+    (``ll_left``/``gset_ttl`` boot FULL so a reborn follower cannot
+    immediately vote a challenger in under a live lease), the ``leader``
+    belief (boots -1, and 0 is a real replica id), and the per-replica
+    PRNG lanes all carry deliberately nonzero boot values.  Leaves
+    absent from ``boot`` (the engine-attached telemetry block) zero.
+    Pure and jit-safe; every state leaf leads with ``[G, R]`` by
+    contract rule C1, so one mask reshape covers all."""
+    durable = frozenset(kernel.DURABLE_SCALARS or ()) | frozenset(
+        kernel.DURABLE_WINDOWS or ()
+    )
+    boot = boot or {}
+
+    def rewind(key, leaf):
+        if key in durable:
+            return leaf
+        m = reset.reshape(reset.shape + (1,) * (leaf.ndim - 2))
+        fresh = boot.get(key)
+        if fresh is None:
+            fresh = jnp.zeros_like(leaf)
+        return jnp.where(m, fresh, leaf)
+
+    return {k: rewind(k, v) for k, v in state.items()}
+
+
 def _tick(
     kernel: ProtocolKernel,
     net: NetModel,
+    boot: Pytree,
     state: Pytree,
     netstate: Pytree,
     inputs: Dict[str, Any],
 ) -> Tuple[Pytree, Pytree, StepEffects]:
     ctrl = ControlInputs(
-        alive=inputs.get("alive"), link_up=inputs.get("link_up")
+        alive=inputs.get("alive"), link_up=inputs.get("link_up"),
+        reset=inputs.get("reset"),
     )
+    if ctrl.reset is not None:
+        # durable device crash: the replica starts this tick reborn —
+        # durable lanes intact, every volatile row rewound to its boot
+        # value — and its own step, outbox, and the freeze fallback
+        # below all see the post-crash state
+        state = reset_durable_rows(kernel, state, ctrl.reset, boot)
     netstate, inbox = net.pop(netstate, ctrl)
     new_state, outbox, fx = kernel.step(state, inbox, inputs)
     if ctrl.alive is not None:
@@ -167,17 +236,18 @@ def _tick(
     return new_state, netstate, fx
 
 
-def _run_scan(kernel, net, state, netstate, inputs_seq, collect):
+def _run_scan(kernel, net, boot, state, netstate, inputs_seq, collect):
     def body(carry, inp):
         st, ns = carry
-        st, ns, fx = _tick(kernel, net, st, ns, inp)
+        st, ns, fx = _tick(kernel, net, boot, st, ns, inp)
         return (st, ns), (fx if collect else None)
 
     (state_f, net_f), fxs = jax.lax.scan(body, (state, netstate), inputs_seq)
     return state_f, net_f, fxs
 
 
-def _run_synth(kernel, net, state, netstate, num_ticks, proposals_per_tick):
+def _run_synth(kernel, net, boot, state, netstate, num_ticks,
+               proposals_per_tick):
     G = kernel.G
 
     R = kernel.R
@@ -191,7 +261,7 @@ def _run_synth(kernel, net, state, netstate, num_ticks, proposals_per_tick):
             # exec_follows_commit=False still make progress
             "exec_floor": jnp.full((G, R), 1 << 30, jnp.int32),
         }
-        st, ns, fx = _tick(kernel, net, st, ns, inputs)
+        st, ns, fx = _tick(kernel, net, boot, st, ns, inputs)
         return (st, ns), None
 
     (state_f, net_f), _ = jax.lax.scan(

@@ -82,6 +82,16 @@ class ControlInputs:
     ``alive``:   [G, R] bool — False freezes a replica (pause): it sends
                  nothing, receives nothing, and its state does not advance.
     ``link_up``: [G, R, R] bool — False drops messages src->dst (partition).
+    ``reset``:   [G, R] bool — True rebuilds the replica's state row from
+                 only its kernel's declared durable leaves at the START of
+                 the tick: every volatile leaf is rewound to its
+                 freshly-booted ``init_state`` value, so a device crash
+                 loses volatile state exactly like a host crash-restart
+                 does (``engine.reset_durable_rows`` — the vectorized
+                 in-kernel form of the host's boot-then-
+                 ``restore_durable`` contract).  Freeze-and-thaw
+                 (``alive`` alone) remains the pause analog; ``reset`` is
+                 the durable crash analog.
 
     The partition constructors below build the standard nemesis shapes so
     tests and the fault-schedule compiler (host/nemesis.py) never
@@ -91,6 +101,7 @@ class ControlInputs:
 
     alive: Any = None
     link_up: Any = None
+    reset: Any = None
 
     @staticmethod
     def all_up(G: int, R: int) -> "ControlInputs":

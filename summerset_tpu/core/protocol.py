@@ -137,6 +137,12 @@ class ProtocolKernel:
     # — the host REFUSES to serve it rather than silently running without
     # durability (the reference persists acceptor state for every served
     # protocol: multipaxos durability.rs:85-216, raft/mod.rs:144-176).
+    # The SAME declarations drive the device plane's durable crash model:
+    # ``engine.reset_durable_rows`` keeps exactly these leaves and
+    # rewinds every volatile one to its freshly-booted init_state value
+    # when a ``device_reset`` nemesis mask fires — a kernel whose safety
+    # state is fully declared here survives both host and device
+    # crash-restarts by construction.
     DURABLE_SCALARS = None   # tuple[str] of [G, R] arrays
     DURABLE_WINDOWS = None   # tuple[str] of [G, R, W] arrays
     VALUE_WINDOW = "win_val"  # the window lane holding payload value ids

@@ -81,6 +81,17 @@ class ControlHub:
 
     def close(self) -> None:
         self._alive = False
+        # shutdown() BEFORE close(): the reader thread is blocked in
+        # recv() holding a reference to the file description, so a bare
+        # close() only drops our fd — no FIN is ever sent and the
+        # manager keeps the dead connection (and our id!) forever,
+        # wedging every rejoin attempt of a self-crashed replica in the
+        # handshake retry loop.  shutdown() tears the connection down
+        # immediately regardless of the concurrent recv.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:

@@ -50,6 +50,11 @@ logger = pf_logger("nemesis")
 # to the full set, callers narrow it (e.g. device-only plans skip wal_*)
 ALL_CLASSES = (
     "crash",       # durable crash-restart (manager-orchestrated)
+    "device_reset",  # durable DEVICE crash: down for `duration`, then the
+                   # state row is rebuilt from the kernel's declared
+                   # durable leaves only (volatile rows zeroed) — the
+                   # host lowering is a manager durable reset, so both
+                   # planes lose volatile state the same way
     "pause",       # SIGSTOP-style freeze + resume after `duration`
     "partition",   # symmetric split: targets vs the rest
     "isolate",     # cut each target from everyone
@@ -61,14 +66,31 @@ ALL_CLASSES = (
     "wal_fsync",   # next `arg` fsyncs fail; durability gate crashes
     "clock_skew",  # targets' tick clocks run at rate `arg` < 1 (device:
                    # duty-cycled alive masks; host: tick_interval / arg)
+    "conf_change",  # drive a client ConfChange (responders := targets)
+                   # through the manager relay WHILE other faults play —
+                   # the QuorumLeases/Bodega revoke-then-adopt barrier's
+                   # adversarial coverage; conf-less protocols answer
+                   # with an explicit failure (the reply path is still
+                   # exercised)
+    "take_snapshot",  # compaction on the serving path: targets snapshot
+                   # + WAL-compact mid-schedule; arg=1 arms a crash
+                   # point between the snapshot write and the WAL
+                   # truncate (recovery must reconcile new snapshot +
+                   # old WAL)
 )
 
 # classes with no device-plane lowering: frame-level delay/duplication are
-# netmodel *config* (delay line depth), not per-tick masks, and the WAL is
-# host-only.  compile_device skips these (documented weakening).
-HOST_ONLY = ("delay", "dup", "wal_torn", "wal_fsync")
+# netmodel *config* (delay line depth), not per-tick masks, the WAL /
+# snapshot files are host-only, and the conf plane is driven by host
+# inputs the mask compiler does not carry.  compile_device skips these
+# (documented weakening).
+HOST_ONLY = (
+    "delay", "dup", "wal_torn", "wal_fsync", "conf_change",
+    "take_snapshot",
+)
 # instantaneous events: no heal action at tick + duration
-INSTANT = ("crash", "wal_torn", "wal_fsync")
+INSTANT = ("crash", "wal_torn", "wal_fsync", "conf_change",
+           "take_snapshot")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,12 +176,22 @@ class FaultPlan:
                 arg = round(rng.uniform(0.3, 0.8), 3)
             elif kind == "wal_fsync":
                 arg = float(rng.randint(1, 3))
+            elif kind == "take_snapshot":
+                # ~1/3 of snapshots crash between the snapshot write and
+                # the WAL truncate — the window where a half-finished
+                # compaction must still recover losslessly
+                arg = 1.0 if rng.random() < 0.34 else 0.0
             if kind in INSTANT:
                 dur = 0
             events.append(FaultEvent(t, kind, targets, dur, arg))
-            # crashes are wall-serialized by the manager (ack + rejoin);
-            # leave slack so the next event still lands in its window
-            gap = rng.randint(3, 9) + (6 if kind == "crash" else 0)
+            # crashes are wall-serialized by the manager (ack + rejoin),
+            # and a crash-armed snapshot restarts its victims through the
+            # supervisor; leave slack so the next event still lands in
+            # its window
+            gap = rng.randint(3, 9) + (
+                6 if kind in ("crash", "device_reset")
+                or (kind == "take_snapshot" and arg > 0) else 0
+            )
             t += max(dur, 1) + gap
         return FaultPlan(seed, population, ticks, tuple(events))
 
@@ -179,23 +211,32 @@ class FaultPlan:
     # ----------------------------------------------------- device plane
     def compile_device(self, G: int) -> Dict[str, Any]:
         """Lower to per-tick ``alive`` [T, G, R] / ``link_up`` [T, G, R, R]
-        mask sequences for ``Engine.run_ticks`` (append to its
-        ``inputs_seq``).  Crash lowers to freeze-and-thaw (``alive`` down
-        for the duration): the device plane has no durable-state-loss
-        analog — that axis is exactly what the host soak covers.
-        ``HOST_ONLY`` classes are skipped here."""
+        / ``reset`` [T, G, R] mask sequences for ``Engine.run_ticks``
+        (append to its ``inputs_seq``).  Crash lowers to freeze-and-thaw
+        (``alive`` down for the duration) — the pause-like legacy model;
+        ``device_reset`` is the durable crash: down for the duration,
+        then the ``reset`` mask fires on the thaw tick and the engine
+        rebuilds the state row from only the kernel's declared durable
+        leaves (``engine.reset_durable_rows``), so volatile state is
+        demonstrably lost.  ``HOST_ONLY`` classes are skipped here."""
         from ..core.netmodel import ControlInputs
 
         T, R = self.ticks, self.population
         alive = np.ones((T, G, R), bool)
         link = np.ones((T, G, R, R), bool)
+        reset = np.zeros((T, G, R), bool)
         for ev in self.events:
             lo = ev.tick
             hi = min(ev.tick + max(ev.duration, 1), T)
             if lo >= T:
                 continue
-            if ev.kind in ("crash", "pause"):
+            if ev.kind in ("crash", "pause", "device_reset"):
                 alive[lo:hi][:, :, list(ev.targets)] = False
+                if ev.kind == "device_reset" and hi < T:
+                    # restart-from-durable-lanes on the thaw tick: the
+                    # replica steps tick `hi` already reborn (alive, but
+                    # with every volatile leaf zeroed)
+                    reset[hi][:, list(ev.targets)] = True
             elif ev.kind == "clock_skew":
                 # duty-cycled alive: the victim steps only on ticks where
                 # its scaled clock advances a whole tick (deterministic —
@@ -234,7 +275,7 @@ class FaultPlan:
                 keep |= ~sel[None, None, :, None]  # only targets' egress
                 keep |= np.eye(R, dtype=bool)[None, None]  # self-links up
                 link[lo:hi] &= keep
-        return {"alive": alive, "link_up": link}
+        return {"alive": alive, "link_up": link, "reset": reset}
 
     # ------------------------------------------------------- host plane
     def host_actions(self) -> List[Tuple[int, str, str, dict]]:
@@ -251,7 +292,10 @@ class FaultPlan:
         for ev in self.events:
             ts = list(ev.targets)
             end = ev.tick + ev.duration
-            if ev.kind == "crash":
+            if ev.kind in ("crash", "device_reset"):
+                # on the host plane BOTH are durable crash-restarts (the
+                # live replica already loses its volatile process state);
+                # device_reset's distinct lowering is device-side only
                 acts.append((ev.tick, "reset", ev.render(),
                              {"servers": ts}))
             elif ev.kind == "pause":
@@ -301,6 +345,15 @@ class FaultPlan:
                 acts.append((end, "skew", f"@{end:05d} skew heal"
                              f" targets={ts}",
                              {"servers": ts, "factor": None}))
+            elif ev.kind == "conf_change":
+                # responders := targets — driven through the data plane
+                # (a real client ConfChange) while the rest of the
+                # schedule keeps playing
+                acts.append((ev.tick, "conf_change", ev.render(),
+                             {"responders": ts}))
+            elif ev.kind == "take_snapshot":
+                acts.append((ev.tick, "take_snapshot", ev.render(),
+                             {"servers": ts, "crash": bool(ev.arg)}))
             elif ev.kind == "wal_torn":
                 acts.append((ev.tick, "wal", ev.render(),
                              {"servers": ts, "spec": {"torn": 1}}))
@@ -332,9 +385,14 @@ class NemesisRunner:
 
         self.plan = plan
         self.tick_len = tick_len
+        self.manager_addr = manager_addr
         self.ep = GenericEndpoint(manager_addr)  # ctrl stub only
         self.executed: List[Tuple[int, str]] = []
         self._on_action = on_action
+        # in-flight conf_change driver threads: conf entries ride the log
+        # and may take many ticks to install under faults — the schedule
+        # must keep playing WHILE they do (that concurrency is the point)
+        self._conf_threads: List[threading.Thread] = []
 
     # --------------------------------------------------------- plumbing
     def _request(self, req: CtrlRequest, timeout: float = 60.0):
@@ -373,6 +431,49 @@ class NemesisRunner:
             self._inject(spec["servers"], {"wal": spec["spec"]})
         elif action == "skew":
             self._inject(spec["servers"], {"skew": spec["factor"]})
+        elif action == "conf_change":
+            self._start_conf_change(list(spec["responders"]))
+        elif action == "take_snapshot":
+            if spec.get("crash"):
+                # arm the crash point FIRST: the snapshot request then
+                # dies between the snapshot write and the WAL truncate,
+                # and the victim's supervisor restart exercises the
+                # new-snapshot + old-WAL recovery path
+                self._inject(spec["servers"], {"snap_crash": 1})
+            self._request(
+                CtrlRequest("take_snapshot", servers=spec["servers"]),
+                timeout=60.0,
+            )
+
+    def _start_conf_change(self, responders: List[int]) -> None:
+        """Fire a real client ConfChange from a background driver; the
+        schedule does NOT wait for installation — partitions/crashes
+        keep playing against the in-flight revoke-then-adopt barrier."""
+        from ..client.drivers import DriverClosedLoop
+        from ..client.endpoint import GenericEndpoint
+
+        def drive() -> None:
+            ep = None
+            try:
+                ep = GenericEndpoint(self.manager_addr)
+                ep.connect()
+                drv = DriverClosedLoop(ep, timeout=8.0)
+                drv.conf_change({"responders": responders}, retries=4)
+            except Exception as e:
+                # expected under adversity: a conf-less protocol answers
+                # failure fast, a partitioned cluster may time the driver
+                # out — the attempt itself is the coverage
+                pf_warn(logger, f"conf_change {responders} gave up: {e}")
+            finally:
+                if ep is not None:
+                    try:
+                        ep.leave()
+                    except Exception:
+                        pass
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        self._conf_threads.append(t)
 
     # ------------------------------------------------------------- play
     def play(self, stop: Optional[threading.Event] = None) -> None:
@@ -396,6 +497,10 @@ class NemesisRunner:
                 pf_warn(logger, f"nemesis action failed: {desc}: {e}")
             if self._on_action is not None:
                 self._on_action(tick, desc)
+        # drain in-flight conf drivers (bounded: their own retry budgets
+        # already cap them) so a late install never races teardown
+        for t in self._conf_threads:
+            t.join(timeout=60.0)
 
     def flight_tails(self, last_n: int = 256) -> Dict[str, Any]:
         """Per-replica flight-recorder tails (graftscope) for failure
@@ -422,7 +527,8 @@ class NemesisRunner:
         try:
             self._inject(
                 list(range(self.plan.population)),
-                {"net": None, "wal": None, "skew": None},
+                {"net": None, "wal": None, "skew": None,
+                 "snap_crash": None},
             )
         except Exception:
             pass

@@ -174,6 +174,19 @@ class ServerReplica:
         # nemesis clock-skew: wall-clock stretch factor on the tick
         # interval (fault_ctl {"skew": f}); 1.0 = healthy
         self._tick_scale = 1.0
+        # nemesis snapshot crash point (fault_ctl {"snap_crash": n}): the
+        # next n snapshots crash between the snapshot write and the WAL
+        # truncate — the half-compacted window recovery must survive
+        self._snap_crash = 0
+        # set by _recover_from_snapshot when a PRESENT snapshot fails to
+        # load; fatal if the WAL turns out to be compacted (the snapshot
+        # then held committed state nothing else can replay)
+        self._snap_unreadable: Optional[str] = None
+        # the applied floors the snapshot ACTUALLY restored (None = no
+        # snapshot loaded); a compacted WAL's snap_floor marker demands
+        # a snapshot covering its floors — missing or stale is as fatal
+        # as unreadable
+        self._snap_floors: Optional[List[int]] = None
 
         # control plane first: the manager assigns our id (control.rs:43)
         self.ctrl = ControlHub(manager_addr)
@@ -462,10 +475,21 @@ class ServerReplica:
                 meta = {"applied": list(meta)}
             assert kind == "kv"
         except Exception as e:
-            pf_warn(logger, f"snapshot unreadable, ignoring: {e}")
+            # defer the verdict to _recover_from_wal: with a FULL
+            # (never-compacted) WAL the replay alone rebuilds everything
+            # and the bad snapshot is truly ignorable; if the WAL was
+            # compacted to the snapshot floor, proceeding would silently
+            # lose committed state — that case is fatal there
+            self._snap_unreadable = repr(e)
+            pf_warn(
+                logger,
+                f"snapshot unreadable: {e} — fatal unless the WAL still "
+                "holds full history",
+            )
             return
         self.statemach._kv.update(kv)
         floors = meta["applied"]
+        self._snap_floors = [int(fl) for fl in floors[: self.G]]
         for g, fl in enumerate(floors[: self.G]):
             self.applied[g] = max(self.applied[g], int(fl))
         for k, s in meta.get("wslots", {}).items():
@@ -494,7 +518,37 @@ class ServerReplica:
             if not res.offset_ok or res.entry is None:
                 break
             rec = res.entry
-            if isinstance(rec, tuple) and rec and rec[0] == "vote":
+            if isinstance(rec, tuple) and rec and rec[0] == "snap_floor":
+                # compaction marker: _take_snapshot writes this as the
+                # compacted WAL's first record.  Apply records below
+                # these floors exist ONLY in the snapshot now — so a
+                # snapshot that is unreadable, MISSING (lost file, or a
+                # crash where the WAL rename was durable but the
+                # snapshot rename was not), or STALE (floors below the
+                # marker's) means committed, acked state is gone, and
+                # serving anyway would un-commit it.  Crash instead so
+                # the supervisor surfaces the corruption.
+                marker = [int(fl) for fl in rec[1][: self.G]]
+                if self._snap_unreadable is not None:
+                    why = f"unreadable ({self._snap_unreadable})"
+                elif self._snap_floors is None:
+                    why = "missing"
+                elif any(sf < mf for sf, mf in
+                         zip(self._snap_floors, marker)):
+                    why = (f"stale (snapshot floors {self._snap_floors} "
+                           f"below the marker's)")
+                else:
+                    why = None
+                if why is not None:
+                    raise SummersetError(
+                        f"snapshot {why} but the WAL was compacted to "
+                        f"floors {marker} — committed state below the "
+                        "snapshot floor is unrecoverable; refusing to "
+                        "serve"
+                    )
+                for g, fl in enumerate(marker):
+                    self.applied[g] = max(self.applied[g], int(fl))
+            elif isinstance(rec, tuple) and rec and rec[0] == "vote":
                 g, v = rec[1], rec[2]
                 votes[g] = v
                 for vid, batch in v.get("pp", {}).items():
@@ -697,9 +751,23 @@ class ServerReplica:
             # graftlint: disable=H104 -- the snapshot tmp file is private to this replica loop and replaced atomically; routing it through StorageHub would serialize bulk snapshot IO behind latency-critical WAL appends
             os.fsync(f.fileno())
         os.replace(tmp, self.snap_path)
+        if self._snap_crash > 0:
+            # nemesis crash point: the snapshot is durably on disk but
+            # the WAL has NOT been compacted yet — recovery must
+            # reconcile the new snapshot with the old (longer) WAL
+            # without double-applying or losing acked writes
+            self._snap_crash -= 1
+            raise SummersetError(
+                "injected snapshot crash point: snapshot written, WAL "
+                "not yet compacted"
+            )
 
         # compact: rewrite the WAL with only the latest durable row per
-        # group; window payloads ride along for the unexecuted tail
+        # group; window payloads ride along for the unexecuted tail.
+        # The first record is the compaction marker: apply history below
+        # these floors now lives ONLY in the snapshot, which recovery
+        # uses to make an unreadable-snapshot-after-compaction fatal
+        # instead of a silent loss of committed state.
         ker = self.kernel
         me = self.me
         scal = {
@@ -713,6 +781,9 @@ class ServerReplica:
         if os.path.exists(wtmp):
             os.remove(wtmp)
         compact = StorageHub(wtmp)
+        compact.do_sync_action(LogAction(
+            "append", entry=("snap_floor", list(self.applied)), sync=False
+        ))
         new_logged: Dict[int, set] = {}
         vids_by_g = _unique_window_vids(val_win, np.arange(self.G))
         for g in range(self.G):
@@ -751,7 +822,9 @@ class ServerReplica:
         compact.stop()
         self.wal.stop()
         os.replace(wtmp, self.wal_path)
-        self.wal = StorageHub(self.wal_path, registry=self.metrics)
+        self.wal = StorageHub(
+            self.wal_path, registry=self.metrics, flight=self.flight
+        )
         self._logged_vids = new_logged
         self._rebuild_logged_keys()
         self._sig = None  # conservative: next tick re-logs any drift
@@ -1935,14 +2008,32 @@ class ServerReplica:
                 # FaultPlan (netmodel.ControlInputs.skew_alive).
                 f = p.get("skew")
                 self._tick_scale = float(f) if f else 1.0
+            if "snap_crash" in p:
+                # arm (or clear) the snapshot crash point: the next n
+                # take_snapshot calls die between the snapshot write and
+                # the WAL truncate (host/nemesis.py take_snapshot events
+                # with the crash arg)
+                self._snap_crash = int(p.get("snap_crash") or 0)
+            def _is_heal(k: str) -> bool:
+                v = p.get(k)
+                if k == "skew":
+                    return v is None or v == 1.0
+                # net/wal heal with None, snap_crash with 0/None —
+                # NOT `v in (None, 1.0)`: snap_crash=1 would compare
+                # equal to the skew-healthy 1.0 and stamp the arming
+                # of a crash point as a heal event
+                return not v
+
             self.flight.record(
                 "fault_ctl", tick=self.tick,
                 planes=",".join(sorted(
-                    k for k in ("net", "wal", "skew") if k in p
+                    k for k in ("net", "wal", "skew", "snap_crash")
+                    if k in p
                 )),
                 heal=all(
-                    p.get(k) in (None, 1.0)
-                    for k in ("net", "wal", "skew") if k in p
+                    _is_heal(k)
+                    for k in ("net", "wal", "skew", "snap_crash")
+                    if k in p
                 ),
             )
             self.ctrl.send_ctrl(CtrlMsg("fault_reply"))

@@ -55,6 +55,11 @@ class ClusterManager:
         self._next_sid = 0
         self._next_cid = 1000
         self._conf_seq = 0  # total order over relayed ConfChanges
+        # the newest relayed install_conf payload; re-announced to every
+        # later joiner so a server that (re)joins AFTER a ConfChange was
+        # relayed still observes it (receivers apply newest-seq-wins, so
+        # the replay can never regress a fresher conf)
+        self._conf_last: Optional[dict] = None
         # kind -> list of waiter queues: every waiter sees every reply of
         # that kind (and filters by sid), so concurrent ctrl clients can't
         # steal each other's acks
@@ -136,6 +141,17 @@ class ClusterManager:
                     {"population": self.population, "to_peers": to_peers},
                 ),
             )
+            if self._conf_last is not None:
+                # late joiner catch-up: the _conf_seq total order only
+                # helps servers that were connected at relay time — a
+                # crash-restarted server re-joining after a ConfChange
+                # must still converge on the same final conf
+                try:
+                    await safetcp.send_msg(
+                        conn.writer, CtrlMsg("install_conf", self._conf_last)
+                    )
+                except (ConnectionError, OSError):
+                    pass
             pf_info(logger, f"server {conn.sid} joined")
         elif msg.kind == "leader_status":
             if p.get("step_up"):
@@ -158,6 +174,7 @@ class ClusterManager:
             # racing changes interleave differently.
             self._conf_seq += 1
             payload = {"delta": p.get("delta") or {}, "seq": self._conf_seq}
+            self._conf_last = payload
             for s in list(self.servers.values()):
                 if s.joined and not s.writer.is_closing():
                     try:

@@ -1,0 +1,213 @@
+"""Long-lived cluster survival: snapshot/compaction recovery semantics
+and the snapshot crash-point model.
+
+Fast half — recovery decision table on a skeleton replica (no sockets):
+
+- unreadable snapshot + COMPACTED WAL (``snap_floor`` marker present) is
+  FATAL: apply history below the floor lives only in the snapshot, so
+  proceeding would silently un-commit acked state;
+- unreadable snapshot + FULL (never-compacted) WAL proceeds: the replay
+  alone rebuilds everything, the bad snapshot is truly ignorable;
+- readable snapshot + marker: floors reconciled, no crash.
+
+Slow half — the live crash point: a ``fault_ctl {"snap_crash": 1}``-armed
+``take_snapshot`` dies between the snapshot write and the WAL truncate;
+the supervisor restart must recover the new-snapshot + old-WAL overlap
+without losing acked writes, and a later snapshot still compacts.
+"""
+
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from summerset_tpu.host.server import ServerReplica
+from summerset_tpu.host.statemach import Command, StateMachine, apply_command
+from summerset_tpu.host.messages import ApiRequest, CtrlRequest
+from summerset_tpu.host.payload import PayloadStore
+from summerset_tpu.host.storage import LogAction, StorageHub
+from summerset_tpu.protocols import make_protocol
+from summerset_tpu.utils.errors import SummersetError
+
+
+def _skeleton(tmp_path, me=0, G=1, R=3, W=32):
+    """A ServerReplica shell with exactly the state the recovery methods
+    touch — no manager, no sockets, no threads."""
+    rep = ServerReplica.__new__(ServerReplica)
+    rep.G = G
+    rep.me = me
+    rep.window = W
+    rep.kernel = make_protocol("multipaxos", G, R, W)
+    rep.state = rep.kernel.init_state(seed=0)
+    rep.statemach = StateMachine()
+    rep.payloads = PayloadStore(G)
+    rep.applied = [0] * G
+    rep._wslot = {}
+    rep._ep_exec = {}
+    rep._epaxos = False
+    rep.codewords = None
+    rep._logged_vids = {g: set() for g in range(G)}
+    rep._logged_keys = np.empty(0, np.int64)
+    rep._snap_unreadable = None
+    rep._snap_floors = None
+    rep.snap_path = os.path.join(str(tmp_path), f"r{me}.snap")
+    rep.wal_path = os.path.join(str(tmp_path), f"r{me}.wal")
+    rep.wal = StorageHub(rep.wal_path)
+    return rep
+
+
+def _put_batch(key, value, req_id=1):
+    return [(7, ApiRequest("req", req_id=req_id,
+                           cmd=Command("put", key, value)))]
+
+
+def _append(wal, entry):
+    res = wal.do_sync_action(LogAction("append", entry=entry, sync=True))
+    assert res.offset_ok
+    return res
+
+
+class TestSnapshotRecoveryDecision:
+    def test_unreadable_snapshot_with_compacted_wal_is_fatal(self, tmp_path):
+        rep = _skeleton(tmp_path)
+        # a compacted WAL: the snap_floor marker first, then a vote row
+        _append(rep.wal, ("snap_floor", [5]))
+        with open(rep.snap_path, "wb") as f:
+            f.write(b"\x80garbage not a pickle")
+        rep._recover_from_snapshot()
+        assert rep._snap_unreadable is not None
+        with pytest.raises(SummersetError, match="compacted"):
+            rep._recover_from_wal()
+        rep.wal.stop()
+
+    def test_unreadable_snapshot_with_full_wal_proceeds(self, tmp_path):
+        rep = _skeleton(tmp_path)
+        # full history: apply records only, no compaction marker
+        _append(rep.wal, (0, 0, 1, _put_batch("k", "v1")))
+        _append(rep.wal, (0, 1, 2, _put_batch("k", "v2")))
+        with open(rep.snap_path, "wb") as f:
+            f.write(b"\x80garbage not a pickle")
+        rep._recover_from_snapshot()
+        rep._recover_from_wal()  # must NOT raise: replay covers history
+        assert rep.statemach._kv["k"] == "v2"
+        assert rep.applied[0] == 2
+        rep.wal.stop()
+
+    def test_readable_snapshot_with_marker_reconciles_floors(self, tmp_path):
+        rep = _skeleton(tmp_path)
+        kv = {}
+        apply_command(kv, Command("put", "k", "snapval"))
+        with open(rep.snap_path, "wb") as f:
+            pickle.dump(("kv", kv, {"applied": [5], "wslots": {"k": 4}}),
+                        f)
+        _append(rep.wal, ("snap_floor", [5]))
+        # a post-snapshot apply record above the floor still replays
+        _append(rep.wal, (0, 5, 9, _put_batch("k2", "late")))
+        rep._recover_from_snapshot()
+        assert rep._snap_unreadable is None
+        rep._recover_from_wal()
+        assert rep.statemach._kv["k"] == "snapval"
+        assert rep.statemach._kv["k2"] == "late"
+        assert rep.applied[0] == 6
+        rep.wal.stop()
+
+    def test_missing_snapshot_is_not_unreadable(self, tmp_path):
+        rep = _skeleton(tmp_path)
+        rep._recover_from_snapshot()  # absent file: a first boot
+        assert rep._snap_unreadable is None
+        rep.wal.stop()
+
+    def test_missing_snapshot_with_compacted_wal_is_fatal(self, tmp_path):
+        """A lost snapshot FILE is as fatal as an unreadable one once
+        the WAL is compacted (e.g. a crash where the compacted-WAL
+        rename reached the disk but the snapshot rename did not)."""
+        rep = _skeleton(tmp_path)
+        _append(rep.wal, ("snap_floor", [5]))
+        rep._recover_from_snapshot()  # no file at all
+        with pytest.raises(SummersetError, match="missing"):
+            rep._recover_from_wal()
+        rep.wal.stop()
+
+    def test_stale_snapshot_below_marker_floor_is_fatal(self, tmp_path):
+        """A readable but OLDER snapshot (floors below the compaction
+        marker's) cannot cover the discarded apply history either."""
+        rep = _skeleton(tmp_path)
+        with open(rep.snap_path, "wb") as f:
+            pickle.dump(("kv", {}, {"applied": [2], "wslots": {}}), f)
+        _append(rep.wal, ("snap_floor", [5]))
+        rep._recover_from_snapshot()
+        assert rep._snap_floors == [2]
+        with pytest.raises(SummersetError, match="stale"):
+            rep._recover_from_wal()
+        rep.wal.stop()
+
+
+@pytest.mark.slow
+class TestSnapshotCrashPoint:
+    def test_armed_snapshot_crashes_then_recovers_and_compacts(
+        self, tmp_path
+    ):
+        """The live crash-point model: snapshot written, WAL untouched,
+        replica dead — restart reconciles both without losing acked
+        writes, and an unarmed snapshot afterwards still compacts."""
+        from test_cluster import Cluster
+
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import GenericEndpoint
+
+        cluster = Cluster("MultiPaxos", 3, str(tmp_path))
+        try:
+            ep = GenericEndpoint(cluster.manager_addr)
+            ep.connect()
+            drv = DriverClosedLoop(ep, timeout=10.0)
+            for i in range(8):
+                drv.checked_put(f"sc{i}", f"v{i}")
+
+            # arm the crash point on ONE replica (a minority victim,
+            # like the soak's schedules), then snapshot it: the victim
+            # dies between the snapshot write and the WAL truncate and
+            # its supervisor restarts it while the quorum keeps serving
+            victim = 0
+            old_rep = cluster.replicas[victim]
+            ep.ctrl.request(CtrlRequest(
+                "inject_faults", servers=[victim],
+                payload={"snap_crash": 1, "seed": 0},
+            ), timeout=30.0)
+            ep.ctrl.request(
+                CtrlRequest("take_snapshot", servers=[victim]),
+                timeout=60.0,
+            )
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                fresh = cluster.replicas.get(victim)
+                if cluster.crash_reports and fresh is not None \
+                        and fresh is not old_rep:
+                    break
+                time.sleep(0.25)
+            crashed = [c for c in cluster.crash_reports
+                       if "snapshot crash point" in c["error"]]
+            assert len(crashed) >= 1, cluster.crash_reports
+            assert cluster.replicas.get(victim) is not old_rep
+
+            # acked writes survived the half-finished compaction
+            drv2 = DriverClosedLoop(ep, timeout=15.0)
+            for i in range(8):
+                drv2.checked_get(f"sc{i}", f"v{i}")
+
+            # the crash left the victim's snapshot ON DISK but its WAL
+            # uncompacted; an unarmed snapshot now must compact for real
+            wal_mid = {
+                me: r.wal.size for me, r in cluster.replicas.items()
+            }
+            assert wal_mid[victim] > 0, wal_mid
+            ep.ctrl.request(
+                CtrlRequest("take_snapshot", servers=None), timeout=60.0
+            )
+            time.sleep(0.5)
+            for me, r in sorted(cluster.replicas.items()):
+                assert r.wal.size <= wal_mid[me], (me, r.wal.size, wal_mid)
+            ep.leave()
+        finally:
+            cluster.stop()

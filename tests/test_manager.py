@@ -262,3 +262,73 @@ class TestLeaderStaleness:
             assert man.leader == 1
 
         asyncio.run(run())
+
+
+def _decode_frames(writer: FakeWriter):
+    """Each FakeWriter.write() call carries exactly one encoded frame
+    (safetcp.send_msg writes encode_frame(obj) in one call)."""
+    import pickle
+
+    from summerset_tpu.utils.safetcp import _LEN
+
+    return [pickle.loads(f[_LEN.size:]) for f in writer.frames]
+
+
+class TestConfReannounce:
+    """ConfChange re-announce total order (_conf_seq): a server that
+    joins AFTER a ConfChange was relayed must still observe it — a
+    crash-restarted replica rejoining mid-soak would otherwise run at a
+    stale conf forever (newest-seq-wins makes the replay idempotent)."""
+
+    def test_late_joiner_receives_last_relayed_conf(self):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        async def run():
+            man = make_manager()
+            relayer = add_server(man, 0)
+            add_server(man, 1)
+            # two racing relays: the LAST assigned seq must win the
+            # catch-up replay, not the first
+            await man._handle_ctrl(relayer, CtrlMsg(
+                "conf_forward", {"delta": {"responders": [0]}}))
+            await man._handle_ctrl(relayer, CtrlMsg(
+                "conf_forward", {"delta": {"responders": [0, 1, 2]}}))
+            assert man._conf_seq == 2
+
+            # a server joining after the relays (e.g. a restarted
+            # replica reclaiming its id) announces itself...
+            conn = add_server(man, 2)
+            conn.joined = False
+            await man._handle_ctrl(conn, CtrlMsg(
+                "new_server_join",
+                {"api_addr": ("127.0.0.1", 7002),
+                 "p2p_addr": ("127.0.0.1", 8002)},
+            ))
+            msgs = _decode_frames(conn.writer)
+            kinds = [m.kind for m in msgs]
+            assert "connect_to_peers" in kinds
+            installs = [m for m in msgs if m.kind == "install_conf"]
+            assert len(installs) == 1
+            assert installs[0].payload["seq"] == 2
+            assert installs[0].payload["delta"] == {
+                "responders": [0, 1, 2]
+            }
+
+        asyncio.run(run())
+
+    def test_joiner_before_any_conf_gets_no_install(self):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        async def run():
+            man = make_manager()
+            conn = add_server(man, 0)
+            conn.joined = False
+            await man._handle_ctrl(conn, CtrlMsg(
+                "new_server_join",
+                {"api_addr": ("127.0.0.1", 7000),
+                 "p2p_addr": ("127.0.0.1", 8000)},
+            ))
+            kinds = [m.kind for m in _decode_frames(conn.writer)]
+            assert "install_conf" not in kinds
+
+        asyncio.run(run())

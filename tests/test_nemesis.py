@@ -59,8 +59,8 @@ class TestPlanDeterminism:
         for seed in range(8):
             p = FaultPlan.generate(seed, 5, 300)
             for ev in p.events:
-                if ev.kind in ("crash", "pause", "isolate", "wal_torn",
-                               "wal_fsync"):
+                if ev.kind in ("crash", "device_reset", "pause",
+                               "isolate", "wal_torn", "wal_fsync"):
                     assert len(ev.targets) <= 2, ev
 
     def test_unknown_class_rejected(self):
@@ -135,6 +135,146 @@ class TestDeviceCompile:
             )
             m = p.compile_device(1)
             assert m["alive"].all() and m["link_up"].all()
+
+
+class TestDeviceReset:
+    """The durable device-crash model: a ``device_reset`` victim loses
+    every volatile state row (rebuilt from only the kernel's declared
+    durable leaves) yet the group re-converges — the device analog of a
+    host crash-restart's WAL replay."""
+
+    def test_compile_device_lowers_reset_at_thaw(self):
+        from summerset_tpu.host.nemesis import FaultEvent
+
+        p = FaultPlan(
+            seed=0, population=3, ticks=20,
+            events=(FaultEvent(3, "device_reset", (1,), 6),),
+        )
+        m = p.compile_device(1)
+        # down for the duration, like a crash...
+        assert not m["alive"][3:9, :, 1].any()
+        assert m["alive"][9:].all() and m["alive"][:3].all()
+        # ...then exactly one reset pulse on the thaw tick
+        assert m["reset"][9, :, 1].all()
+        assert m["reset"].sum() == m["reset"][9, :, 1].size
+        # plain crash stays freeze-and-thaw: no reset pulse
+        pc = FaultPlan(
+            seed=0, population=3, ticks=20,
+            events=(FaultEvent(3, "crash", (1,), 6),),
+        )
+        assert not pc.compile_device(1)["reset"].any()
+
+    def test_host_actions_for_long_lived_classes(self):
+        from summerset_tpu.host.nemesis import FaultEvent
+
+        p = FaultPlan(
+            seed=0, population=3, ticks=40,
+            events=(
+                FaultEvent(2, "device_reset", (1,), 8),
+                FaultEvent(15, "conf_change", (0, 2), 0),
+                FaultEvent(20, "take_snapshot", (0,), 0, 1.0),
+                FaultEvent(25, "take_snapshot", (1,), 0, 0.0),
+            ),
+        )
+        acts = {a[0]: (a[1], a[3]) for a in p.host_actions()}
+        # device_reset lowers to a durable manager reset on the host
+        assert acts[2] == ("reset", {"servers": [1]})
+        assert acts[15] == ("conf_change", {"responders": [0, 2]})
+        assert acts[20] == ("take_snapshot",
+                            {"servers": [0], "crash": True})
+        assert acts[25] == ("take_snapshot",
+                            {"servers": [1], "crash": False})
+
+    def test_reset_loses_volatile_keeps_durable_then_reconverges(self):
+        """Acceptance regression: after a reset tick the victim's
+        volatile leaves (commit_bar, telem) are zeroed while durable
+        leaves (bal_max, win_val) survive verbatim; the group then
+        re-converges with agreement under fault-free ticks."""
+        import jax.numpy as jnp
+
+        G, R, W, P = 2, 3, 32, 2
+        cfg = ReplicaConfigMultiPaxos(max_proposals_per_tick=P)
+        kernel = make_protocol("multipaxos", G, R, W, cfg)
+        eng = Engine(kernel, seed=3)
+        state, ns = eng.init()
+        state, ns, _ = run_segment(eng, state, ns, 40, n_prop=P)
+        pre = {k: np.asarray(v) for k, v in state.items()}
+        assert (pre["commit_bar"][:, 1] > 0).all()
+        assert (pre["bal_max"][:, 1] > 0).all()
+
+        # the reset tick: victim 1 is dead AND reborn-from-durable, so
+        # the tick's freeze leaves exactly the post-crash state visible
+        alive = jnp.ones((G, R), bool).at[:, 1].set(False)
+        reset = jnp.zeros((G, R), bool).at[:, 1].set(True)
+        state, ns, _ = eng.tick(state, ns, {
+            "n_proposals": jnp.zeros((G,), jnp.int32),
+            "value_base": jnp.zeros((G,), jnp.int32),
+            "alive": alive, "reset": reset,
+        })
+        st = {k: np.asarray(v) for k, v in state.items()}
+        # volatile rows rewound to boot — the crash demonstrably lost
+        # state (commit_bar/telem boot at zero)
+        assert (st["commit_bar"][:, 1] == 0).all()
+        assert (st["telem"][:, 1] == 0).all()
+        # ...and rewound means the BOOT template, not zeros: the
+        # randomized heartbeat timeout returns to its freshly-booted
+        # draw (zeroing it would instead fire an instant election storm,
+        # and zeroing lease holdoffs would break lease safety)
+        boot = {k: np.asarray(v) for k, v in eng._boot.items()}
+        assert (st["hb_cnt"][:, 1] == boot["hb_cnt"][:, 1]).all()
+        assert (boot["hb_cnt"][:, 1] > 0).all()
+        # durable rows survive verbatim (the in-kernel WAL analog)
+        assert (st["bal_max"][:, 1] == pre["bal_max"][:, 1]).all()
+        assert (st["win_val"][:, 1] == pre["win_val"][:, 1]).all()
+        # survivors keep stepping (alive that tick) — never regress
+        for r in (0, 2):
+            assert (st["commit_bar"][:, r] >=
+                    pre["commit_bar"][:, r]).all()
+
+        # fault-free heal: the group must re-converge, the victim's
+        # commit bar re-advancing past its pre-crash point
+        state, ns, _ = run_segment(
+            eng, state, ns, 200, n_prop=P, base_start=5000
+        )
+        fin = {k: np.asarray(v) for k, v in state.items()}
+        check_agreement(fin, G, R, W)
+        assert (fin["commit_bar"][:, 1] > pre["commit_bar"][:, 1]).all()
+        spread = (
+            fin["commit_bar"].max(axis=1) - fin["commit_bar"].min(axis=1)
+        )
+        assert (spread <= 4 * P).all(), fin["commit_bar"]
+
+    def test_generated_device_reset_schedule_runs_under_scan(self):
+        """A generated schedule containing device_reset events compiles
+        and the whole scan survives it (masks thread through
+        Engine.run_ticks via the new ``reset`` input)."""
+        import jax.numpy as jnp
+
+        G, R, W, P = 1, 3, 32, 2
+        ticks = 120
+        plan = FaultPlan.generate(
+            21, R, ticks, classes=("device_reset", "partition"),
+        )
+        assert any(e.kind == "device_reset" for e in plan.events)
+        masks = plan.compile_device(G)
+        cfg = ReplicaConfigMultiPaxos(max_proposals_per_tick=P)
+        eng = Engine(make_protocol("multipaxos", G, R, W, cfg), seed=7)
+        state, ns = eng.init()
+        t = jnp.arange(ticks, dtype=jnp.int32)
+        seq = {
+            "n_proposals": jnp.full((ticks, G), P, jnp.int32),
+            "value_base": jnp.broadcast_to((t * P)[:, None], (ticks, G)),
+            "alive": jnp.asarray(masks["alive"]),
+            "link_up": jnp.asarray(masks["link_up"]),
+            "reset": jnp.asarray(masks["reset"]),
+        }
+        state, ns, _ = eng.run_ticks(state, ns, seq)
+        state, ns, _ = run_segment(
+            eng, state, ns, 200, n_prop=P, base_start=9000
+        )
+        fin = {k: np.asarray(v) for k, v in state.items()}
+        check_agreement(fin, G, R, W)
+        assert (fin["commit_bar"].max(axis=1) > 0).all()
 
 
 class TestClockSkew:
@@ -365,8 +505,8 @@ class TestDevicePlaneSoak:
         ticks = 160
         plan = FaultPlan.generate(
             11, R, ticks,
-            classes=("crash", "pause", "partition", "isolate",
-                     "one_way", "drop"),
+            classes=("crash", "device_reset", "pause", "partition",
+                     "isolate", "one_way", "drop"),
         )
         masks = plan.compile_device(G)
         cfg = ReplicaConfigMultiPaxos(max_proposals_per_tick=P)
@@ -380,6 +520,7 @@ class TestDevicePlaneSoak:
             ),
             "alive": jnp.asarray(masks["alive"]),
             "link_up": jnp.asarray(masks["link_up"]),
+            "reset": jnp.asarray(masks["reset"]),
         }
         state, ns, _ = eng.run_ticks(state, ns, seq)
         st = {k: np.asarray(v) for k, v in state.items()}
@@ -417,7 +558,8 @@ class TestLiveNemesisSoak:
 
         plan = FaultPlan.generate(
             1, 3, 48,
-            classes=("crash", "partition", "pause", "drop", "wal_torn"),
+            classes=("crash", "device_reset", "partition", "pause",
+                     "drop", "wal_torn", "take_snapshot"),
         )
         cluster = Cluster("MultiPaxos", 3, str(tmp_path))
         stop = threading.Event()

@@ -70,7 +70,9 @@ def test_packed_netstate_shards_onto_mesh():
         "n_proposals": jnp.full((16,), 2, jnp.int32),
         "value_base": jnp.ones((16,), jnp.int32),
     }
-    fn = jax.jit(lambda st, n, i: _tick(eng.kernel, eng.net, st, n, i))
+    fn = jax.jit(
+        lambda st, n, i: _tick(eng.kernel, eng.net, eng._boot, st, n, i)
+    )
     for _ in range(3):
         state, ns, fx = fn(state, ns, inputs)
     jax.block_until_ready(fx.commit_bar)

@@ -79,7 +79,9 @@ def _run_equivalence(G, R, W, P, group_shards, replica_shards, ticks):
     s2, n2 = eng2.init()
     s2 = shard_pytree(mesh, s2)
     n2 = shard_netstate(mesh, n2)
-    fn = lambda st, ns, i: _tick(kernel, eng2.net, st, ns, i)  # noqa: E731
+    fn = lambda st, ns, i: _tick(  # noqa: E731
+        kernel, eng2.net, eng2._boot, st, ns, i
+    )
     shapes = jax.eval_shape(fn, s2, n2, inputs_at(0))
     out_sh = (state_sharding(mesh, shapes[0]),
               netstate_sharding(mesh, shapes[1]),
