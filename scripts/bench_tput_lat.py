@@ -36,7 +36,7 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 
 
 def run_point(cluster, clients, secs, freq, put_ratio, value_size,
-              num_keys):
+              num_keys, plan=None):
     from summerset_tpu.client.bench import ClientBench
     from summerset_tpu.client.endpoint import GenericEndpoint
 
@@ -49,6 +49,7 @@ def run_point(cluster, clients, secs, freq, put_ratio, value_size,
             ep, secs=secs, freq=freq, put_ratio=put_ratio,
             value_size=value_size, num_keys=num_keys, interval=1e9,
             seed=100 + i,
+            opgen=plan.opstream(i) if plan is not None else None,
         )
         results[i] = bench.run()
         ep.leave()
@@ -85,10 +86,25 @@ def main():
     ap.add_argument("--put-ratio", type=float, default=0.5)
     ap.add_argument("--config", default="",
                     help="k=v[,k=v...] extra cluster config")
+    ap.add_argument("--workload", default="uniform",
+                    help="workload class (host/workload.py "
+                         "WORKLOAD_CLASSES); uniform = the legacy "
+                         "bench mix, so default trajectories stay "
+                         "comparable")
+    ap.add_argument("--workload-seed", type=int, default=1)
     ap.add_argument("--out", default=os.path.join(REPO, "TPUTLAT.json"))
     args = ap.parse_args()
 
     from test_cluster import Cluster
+
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    plan = None
+    if args.workload != "uniform":
+        plan = WorkloadPlan.generate(
+            args.workload_seed, args.workload, clients=args.clients,
+            num_keys=args.num_keys,
+        )
 
     config = {}
     for kv in filter(None, args.config.split(",")):
@@ -106,7 +122,8 @@ def main():
     try:
         for load in [float(x) for x in args.loads.split(",")]:
             pt = run_point(cluster, args.clients, args.secs, load,
-                           args.put_ratio, args.value_size, args.num_keys)
+                           args.put_ratio, args.value_size,
+                           args.num_keys, plan=plan)
             print(json.dumps(pt), flush=True)
             points.append(pt)
         # scrape once after the sweep: the snapshot's histograms cover
@@ -123,6 +140,11 @@ def main():
         "replicas": args.replicas,
         "clients": args.clients,
         "secs_per_point": args.secs,
+        # workload stamp: which traffic class produced this curve (and
+        # the seed/digest to regenerate the exact op streams)
+        "workload": args.workload,
+        "workload_seed": args.workload_seed,
+        "workload_digest": plan.digest() if plan is not None else None,
         "points": points,
         "server_metrics": server_metrics,
     }

@@ -59,6 +59,12 @@ def main() -> None:
     ap.add_argument("--num-keys", type=int, default=64)
     ap.add_argument("--value-size", default="64")
     ap.add_argument("--put-ratio", type=float, default=0.5)
+    ap.add_argument("--workload", default="uniform",
+                    help="workload class (host/workload.py "
+                         "WORKLOAD_CLASSES); uniform = the legacy "
+                         "bench mix, so default trajectories stay "
+                         "comparable")
+    ap.add_argument("--workload-seed", type=int, default=1)
     ap.add_argument("--out", default=os.path.join(REPO, "HOSTBENCH.json"))
     args = ap.parse_args()
 
@@ -67,6 +73,14 @@ def main() -> None:
     from summerset_tpu.client.endpoint import (
         GenericEndpoint, scrape_metrics,
     )
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    plan = None
+    if args.workload != "uniform":
+        plan = WorkloadPlan.generate(
+            args.workload_seed, args.workload, clients=args.clients,
+            num_keys=args.num_keys,
+        )
 
     tmp = tempfile.mkdtemp(prefix="host_bench_")
     t0 = time.time()
@@ -90,6 +104,7 @@ def main() -> None:
             num_keys=args.num_keys,
             interval=1e9,  # suppress per-interval prints
             seed=i,
+            opgen=plan.opstream(i) if plan is not None else None,
         )
         results[i] = bench.run()
         ep.leave()
@@ -114,6 +129,10 @@ def main() -> None:
         "clients": len(done),
         "secs": args.secs,
         "platform": jax.devices()[0].platform,
+        # workload stamp: which traffic class produced this number
+        "workload": args.workload,
+        "workload_seed": args.workload_seed,
+        "workload_digest": plan.digest() if plan is not None else None,
         "tput": round(tput, 2),
         "lat_p50_ms": round(p50, 3),
         "lat_p99_ms": round(p99, 3),

@@ -319,6 +319,19 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                         "args": {"client": ev["client"],
                                  "req_id": ev["req_id"]},
                     })
+            elif k == "api_shed":
+                # ingress backpressure refused the request before it
+                # entered the queue: an instant on the api track (there
+                # is no span — nothing was proposed), carrying the hint
+                # so overload windows are readable off the timeline
+                evs.append({
+                    "ph": "i", "s": "t", "name": "api_shed",
+                    "pid": me, "tid": TID["api"], "ts": t,
+                    "args": {"client": ev.get("client"),
+                             "req_id": ev.get("req_id"),
+                             "retry_ms": ev.get("retry_ms"),
+                             "depth": ev.get("depth")},
+                })
             elif k == "propose":
                 sk = (ev["g"], ev["vid"])
                 t_cm = commit.get(sk)

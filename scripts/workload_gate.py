@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""WORKLOADS.json drift + overload-survival gate (ci.sh tier 2g).
+
+Asserts, WITHOUT bringing up clusters (pure plan regeneration):
+
+1. every committed matrix cell passed (``ok``) with a bounded recovery;
+2. per-seed digests are byte-identical to what the current generators
+   produce (``WorkloadPlan.generate`` AND the cell's ``FaultPlan``) —
+   the repro contract: any change to either schedule generator must
+   regenerate the artifact in the same PR (the drift gate);
+3. the matrix covers the workload classes: every class named in
+   ``WL_MATRIX`` actually has a committed row, and at least one
+   overload (``hot_burst``) row exists per protocol listed;
+4. overload rows shed VISIBLY (client-observed sheds > 0 and the
+   server-side ``api_shed`` counters agree) and BOUNDEDLY (progress
+   was made: acked > 0, sheds < issued, and no value was ever both
+   acked and shed);
+5. overload rows stayed within the committed latency/recovery budgets
+   (accepted-op p99 through the burst, post-burst throughput tail).
+
+Usage:  python scripts/workload_gate.py [--json WORKLOADS.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from workload_soak import (  # noqa: E402  (scripts/ sibling import)
+    DEFAULT_BUDGET_TICKS, P99_BUDGET_S, RECOVER_FRAC, WL_MATRIX,
+    build_plans,
+)
+
+DEFAULT_REPLICAS = 3
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json",
+                    default=os.path.join(REPO, "WORKLOADS.json"))
+    args = ap.parse_args()
+    with open(args.json) as f:
+        rows = json.load(f)
+
+    failures = []
+    want = {(p, c, s): fs for p, c, s, fs in WL_MATRIX}
+    seen = set()
+    for row in rows:
+        cell = (row.get("protocol"), row.get("wl_class"),
+                row.get("seed"))
+        seen.add(cell)
+        tag = f"{cell[0]} {cell[1]} seed={cell[2]}"
+        if not row.get("ok"):
+            failures.append(f"{tag}: failed ({row.get('error')})")
+        rt = row.get("recovery_ticks")
+        if rt is None or rt > DEFAULT_BUDGET_TICKS:
+            failures.append(f"{tag}: recovery unbounded ({rt} ticks)")
+        if cell not in want:
+            failures.append(f"{tag}: cell outside WL_MATRIX")
+            continue
+        wplan, fplan = build_plans(
+            cell[0], cell[1], cell[2], want[cell], DEFAULT_REPLICAS
+        )
+        if row.get("wl_digest") != wplan.digest():
+            failures.append(
+                f"{tag}: workload digest drift — committed "
+                f"{row.get('wl_digest')} vs regenerated "
+                f"{wplan.digest()}; rerun scripts/workload_soak.py "
+                "--matrix and commit the diff"
+            )
+        fdig = fplan.digest() if fplan is not None else None
+        if row.get("fault_digest") != fdig:
+            failures.append(
+                f"{tag}: fault digest drift — committed "
+                f"{row.get('fault_digest')} vs regenerated {fdig}"
+            )
+        if cell[1] == "hot_burst":
+            shed = row.get("shed", 0)
+            # post-run scrape + the burst-peak pre-crash scrape: the
+            # crashed leader's counter dies with its incarnation
+            api_shed = sum((row.get("api_shed") or {}).values()) + sum(
+                (row.get("api_shed_pre") or {}).values()
+            )
+            if shed <= 0 or api_shed <= 0:
+                failures.append(
+                    f"{tag}: overload row without visible shedding "
+                    f"(client {shed}, server {api_shed})"
+                )
+            if row.get("acked", 0) <= 0 or shed >= row.get("issued", 0):
+                failures.append(f"{tag}: shedding unbounded (no "
+                                "progress through the burst)")
+            if row.get("ack_shed_overlap", 0) != 0:
+                failures.append(f"{tag}: an ack was lost to a shed")
+            bp = row.get("burst_p99_s")
+            if bp is None or bp > P99_BUDGET_S:
+                failures.append(
+                    f"{tag}: accepted-op p99 {bp}s over the "
+                    f"{P99_BUDGET_S}s budget"
+                )
+            rec = row.get("recover_tput")
+            st = row.get("offered_steady")
+            if rec is None or st is None or rec < RECOVER_FRAC * st:
+                failures.append(
+                    f"{tag}: throughput did not recover "
+                    f"({rec}/s tail vs {st}/s offered steady)"
+                )
+
+    missing = set(want) - seen
+    if missing:
+        failures.append(f"matrix cells missing: {sorted(missing)}")
+    classes_want = {c for _, c, _, _ in WL_MATRIX}
+    classes_seen = {c for _, c, _ in seen}
+    if classes_want - classes_seen:
+        failures.append(
+            f"workload classes uncovered: "
+            f"{sorted(classes_want - classes_seen)}"
+        )
+    protos_want = {p for p, c, _, _ in WL_MATRIX if c == "hot_burst"}
+    protos_seen = {p for p, c, _ in seen if c == "hot_burst"}
+    if protos_want - protos_seen:
+        failures.append(
+            f"overload rows missing for: "
+            f"{sorted(protos_want - protos_seen)}"
+        )
+
+    if failures:
+        print("WORKLOADS gate FAIL:")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    n_over = sum(1 for _, c, _ in seen if c == "hot_burst")
+    print(
+        f"WORKLOADS gate OK: {len(rows)} cells passed, digests "
+        f"byte-identical per seed, {sorted(classes_seen)} covered, "
+        f"{n_over} overload rows shed visibly and recovered"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

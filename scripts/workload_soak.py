@@ -1,0 +1,618 @@
+#!/usr/bin/env python3
+"""Workload x nemesis joint soak: seeded adversarial traffic against a
+live cluster, with overload survival asserted end to end.
+
+Per (protocol, workload class, seed) cell:
+
+1. bring up an in-process cluster (the tier-2 harness from
+   tests/test_cluster.py) with a DELIBERATELY small ingress tier:
+   ``api_max_batch`` caps what one tick drains, which pins the ingress
+   capacity at ``api_max_batch / tick`` ops/s, and ``api_max_pending``
+   bounds the queue so overload must surface as explicit shedding;
+2. generate the seed's ``WorkloadPlan`` (zipfian hot keys, mixes, value
+   sizes, multi-tenant ranges, open-loop burst phases) and — for joint
+   cells — a ``FaultPlan`` (partition / drop / one_way) sharing the
+   same logical tick axis; both regenerate byte-identically (the repro
+   contract);
+3. drive open-loop recorder clients through the plan's arrival phases
+   (``hot_burst`` offers ~2x ingress capacity mid-run) while the
+   nemesis schedule plays; overload rows additionally crash the LIVE
+   leader mid-burst (queried at fire time — a seeded plan cannot know
+   election outcomes);
+4. assert: linearizability of the recorded history (shed puts excluded
+   on the server's never-proposed guarantee — a get observing a shed
+   value FAILS), visible-and-bounded shedding on overload rows (client
+   sheds > 0, server ``api_shed`` > 0, progress still made, and no
+   value both acked and shed), bounded accepted-op p99 through the
+   burst, throughput recovery to the pre-burst steady state, and a
+   bounded post-heal recovery write.
+
+Results land in WORKLOADS.json (gated by scripts/workload_gate.py: per
+-seed digest drift, shed > 0 on overload rows, class coverage).  On
+failure both timelines + the executed fault log + the full operation
+history are dumped next to ``--out``; re-running with the same seeds
+replays identical schedules.
+
+Usage:
+    python scripts/workload_soak.py                   # the overload row
+    python scripts/workload_soak.py --matrix          # full joint matrix
+    python scripts/workload_soak.py --protocol Raft \\
+        --wl-class hot_burst --seed 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from summerset_tpu.utils.jaxcompat import set_cpu_devices  # noqa: E402
+
+set_cpu_devices(8)
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+# the joint acceptance matrix: every non-uniform workload class at least
+# once, two overload (hot_burst) rows across protocol families.  Row
+# shape: (protocol, wl_class, workload seed, fault seed | None).
+# hot_burst rows are the OVERLOAD rows: burst ~2x ingress capacity +
+# a live leader crash mid-burst; they must shed visibly.
+WL_MATRIX = (
+    ("MultiPaxos", "read_mostly", 1, 1),
+    ("MultiPaxos", "write_heavy", 2, 2),
+    ("MultiPaxos", "value_mix", 3, None),
+    ("MultiPaxos", "multi_tenant", 2, 3),
+    ("MultiPaxos", "hot_burst", 1, 1),
+    ("Raft", "hot_burst", 2, 2),
+)
+# message-plane fault classes for the joint cells (crash pressure comes
+# from the explicit mid-burst leader crash instead of the generator:
+# manager-serialized crash-restarts are wall-heavy and would slide the
+# whole burst window)
+FAULT_CLASSES = ("partition", "drop", "one_way")
+
+# ingress tier sizing: api_max_batch caps per-tick drain, so the
+# NOMINAL capacity is API_MAX_BATCH / tick — but on a loaded CI box the
+# effective tick is compute-bound well past its interval, so the soak
+# MEASURES the real drain rate (calibrate_capacity) and scales the
+# plan's rate_x phases against that: "2x ingress capacity" means 2x
+# what this box actually drains, on every box.  The queue bound is
+# small so a 2x burst (net fill ~= capacity) overflows it — and starts
+# shedding — within the first second of the burst, BEFORE the leader
+# crash stirs election noise into the window.
+API_MAX_BATCH = 2
+API_MAX_PENDING = 8
+# shared with scripts/workload_gate.py (digest regeneration)
+DEFAULT_CLIENTS = 3
+DEFAULT_KEYS = 24
+DEFAULT_HORIZON = 120      # workload/fault schedule ticks
+DEFAULT_TICK_LEN = 0.1     # wall seconds per schedule tick
+DEFAULT_BUDGET_TICKS = 4000
+P99_BUDGET_S = 3.5         # accepted-op p99 bound through the burst
+RECOVER_FRAC = 0.5         # post-burst tput must reach this x steady
+
+
+def protocol_config(protocol: str) -> dict:
+    cfg = {"api_max_batch": API_MAX_BATCH,
+           "api_max_pending": API_MAX_PENDING}
+    if protocol in ("RSPaxos", "CRaft", "Crossword"):
+        cfg["fault_tolerance"] = 0
+    return cfg
+
+
+def build_plans(protocol: str, wl_class: str, seed: int,
+                fault_seed, replicas: int):
+    """The cell's two schedules — one seeded generator call each, so
+    the gate can regenerate digests without a cluster."""
+    from summerset_tpu.host.nemesis import FaultPlan
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    wplan = WorkloadPlan.generate(
+        seed, wl_class, clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    fplan = None
+    if fault_seed is not None:
+        fplan = FaultPlan.generate(
+            fault_seed, replicas, DEFAULT_HORIZON,
+            classes=FAULT_CLASSES,
+        )
+    return wplan, fplan
+
+
+def calibrate_capacity(manager_addr, clients: int, secs: float = 2.5,
+                       flood: float = 800.0,
+                       timeout: float = 5.0) -> float:
+    """Measured ingress capacity: open-loop put flood on dedicated
+    ``cal*`` keys (disjoint from every workload key, so the checked
+    history never observes calibration values); with the bounded queue
+    saturated, the acked rate over the tail window IS the serving
+    path's drain rate on this box."""
+    import random
+
+    from summerset_tpu.client.drivers import DriverOpenLoopPaced
+    from summerset_tpu.client.endpoint import GenericEndpoint
+
+    acks = [0] * clients
+    t_end = time.monotonic() + secs
+    t_meas = time.monotonic() + 0.5  # let the queue fill first
+
+    def one(ci: int) -> None:
+        rng = random.Random(1000 + ci)
+        try:
+            ep = GenericEndpoint(manager_addr)
+            ep.connect()
+        except Exception:
+            return
+        drv = DriverOpenLoopPaced(ep, timeout=timeout, seed=ci)
+        t_next = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now >= t_end:
+                break
+            drv.expired()
+            if now >= t_next:
+                if not drv.gated(now):
+                    drv.issue("put", f"cal{ci}",
+                              f"cal-{ci}-{drv.next_req}")
+                t_next = now + rng.expovariate(flood / clients)
+            for info, rep in drv.poll(
+                min(max(t_next - now, 0.0005), 0.01)
+            ):
+                if rep.kind == "success" and now >= t_meas:
+                    acks[ci] += 1
+        try:
+            ep.leave()
+        except Exception:
+            pass
+
+    ths = [threading.Thread(target=one, args=(ci,), daemon=True)
+           for ci in range(clients)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=secs + timeout + 10)
+    return max(sum(acks) / max(secs - 0.5, 0.1), 5.0)
+
+
+def phase_window(wplan, idx: int, t0: float, tick_len: float):
+    p = wplan.phases[idx]
+    return (t0 + p.tick * tick_len,
+            t0 + (p.tick + p.ticks) * tick_len)
+
+
+def accepted_in(ops, lo: float, hi: float):
+    return [o for o in ops
+            if o.acked and not o.shed and lo <= o.t_resp < hi]
+
+
+def p99(xs):
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(len(s) * 0.99))]
+
+
+def fail_bundle_doc(result: dict, wplan, fplan, runner, ops) -> dict:
+    return {
+        **result,
+        "workload_timeline": wplan.timeline(),
+        "fault_timeline": fplan.timeline() if fplan else None,
+        "executed": runner.executed if runner is not None else [],
+        "history": [
+            {
+                "client": o.client, "kind": o.kind, "key": o.key,
+                "value": o.value, "t_inv": o.t_inv,
+                "t_resp": (
+                    None if o.t_resp == float("inf") else o.t_resp
+                ),
+                "acked": o.acked, "shed": o.shed,
+            }
+            for o in sorted(ops, key=lambda o: o.t_inv)
+        ],
+    }
+
+
+def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
+            args) -> dict:
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
+    from summerset_tpu.client.tester import start_workload_clients
+    from summerset_tpu.host.messages import CtrlRequest
+    from summerset_tpu.host.nemesis import NemesisRunner
+    from summerset_tpu.utils.linearize import check_history
+
+    wplan, fplan = build_plans(
+        protocol, wl_class, seed, fault_seed, args.replicas
+    )
+    # the repro contract: same seeds -> byte-identical timelines
+    w2, f2 = build_plans(
+        protocol, wl_class, seed, fault_seed, args.replicas
+    )
+    assert wplan.timeline() == w2.timeline(), "non-deterministic wplan!"
+    assert fplan is None or fplan.timeline() == f2.timeline()
+    overload = wl_class == "hot_burst"
+    cap_nominal = API_MAX_BATCH / args.tick  # ops/s if ticks were free
+    print(f"--- {protocol} {wl_class} seed={seed} "
+          f"wdigest={wplan.digest()} "
+          f"fdigest={fplan.digest() if fplan else None} "
+          f"nominal_capacity={cap_nominal:.0f}/s")
+    print(wplan.timeline(), end="")
+    if fplan is not None:
+        print(fplan.timeline(), end="")
+
+    tmp = tempfile.mkdtemp(
+        prefix=f"wlsoak_{protocol.lower()}_{wl_class}_{seed}_"
+    )
+    result = {
+        "protocol": protocol, "wl_class": wl_class, "seed": seed,
+        "fault_seed": fault_seed, "wl_digest": wplan.digest(),
+        "fault_digest": fplan.digest() if fplan else None,
+        "overload": overload, "ok": False,
+    }
+    cluster = None
+    stop = threading.Event()
+    ops: list = []
+    stats: list = []
+    threads: list = []
+    runner = None
+    nem_thread = None
+    try:
+        cluster = Cluster(
+            protocol, args.replicas, tmp,
+            config=protocol_config(protocol), tick=args.tick,
+        )
+        # warm the jit path before the schedule clock starts
+        wep = GenericEndpoint(cluster.manager_addr)
+        wep.connect()
+        DriverClosedLoop(wep, timeout=10.0).checked_put("warm", "1")
+        wep.leave()
+
+        # measured ingress capacity: the plan's rate_x multipliers are
+        # relative to what THIS box actually drains, so the burst is
+        # genuinely ~2x capacity whether the tick runs at its interval
+        # or compute-bound past it
+        cap = calibrate_capacity(
+            cluster.manager_addr, wplan.clients,
+            timeout=args.op_timeout,
+        )
+        result["capacity_ops_s"] = round(cap, 1)
+        result["capacity_nominal_ops_s"] = cap_nominal
+        print(f"calibrated ingress capacity: {cap:.1f} ops/s "
+              f"(nominal {cap_nominal:.0f})")
+        # let the calibration flood's queued tail drain before the
+        # schedule clock starts, or steady-phase latencies inherit it
+        time.sleep(min(2.0, API_MAX_PENDING / cap + 0.3))
+
+        t0 = time.monotonic()
+
+        def rate_total_of() -> float:
+            tick = (time.monotonic() - t0) / args.tick_len
+            return wplan.rate_x_at(tick) * cap
+
+        threads = start_workload_clients(
+            cluster.manager_addr, wplan, rate_total_of, stop, ops,
+            stats, timeout=args.op_timeout,
+        )
+        if fplan is not None:
+            runner = NemesisRunner(
+                cluster.manager_addr, fplan, tick_len=args.tick_len,
+            )
+            nem_thread = threading.Thread(
+                target=runner.play, daemon=True
+            )
+            nem_thread.start()
+        crash_log: list = []
+        if overload:
+            # live leader crash mid-burst: the victim is whoever leads
+            # AT FIRE TIME (a seeded plan cannot know election
+            # outcomes), so the crash is guaranteed to hit the serving
+            # path while the queue is at ~2x capacity
+            burst = wplan.phases[1]
+            # ~1.2s into the burst: the bounded queue has demonstrably
+            # overflowed (shed onset ~ API_MAX_PENDING / capacity into
+            # the burst) before the crash lands on top of it
+            fire_at = t0 + (burst.tick + 12) * args.tick_len
+
+            def crash_leader() -> None:
+                lag = fire_at - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
+                try:
+                    # burst-peak scrape FIRST: the victim's api_shed
+                    # counter dies with its incarnation, so the
+                    # while-overloaded evidence must be captured before
+                    # the crash wipes it
+                    pre = scrape_metrics(
+                        cluster.manager_addr, timeout=10.0
+                    )
+                    result["api_shed_pre"] = {
+                        sid: snap.get("host", {})
+                                 .get("counters", {})
+                                 .get("api_shed", 0)
+                        for sid, snap in (pre or {}).items()
+                    }
+                    ep = GenericEndpoint(cluster.manager_addr)
+                    info = ep.ctrl.request(CtrlRequest("query_info"))
+                    victim = (
+                        info.leader if info.leader is not None
+                        else sorted(info.servers)[0]
+                    )
+                    crash_log.append(
+                        {"victim": victim,
+                         "at_tick": round(
+                             (time.monotonic() - t0) / args.tick_len,
+                             1)}
+                    )
+                    ep.ctrl.request(
+                        CtrlRequest("reset_servers", servers=[victim],
+                                    durable=True),
+                        timeout=240.0,
+                    )
+                    ep.ctrl.close()
+                except Exception as e:
+                    crash_log.append({"error": repr(e)})
+
+            ct = threading.Thread(target=crash_leader, daemon=True)
+            ct.start()
+            threads.append(ct)
+
+        horizon_s = wplan.horizon() * args.tick_len
+        time.sleep(max(0.0, t0 + horizon_s - time.monotonic()))
+        time.sleep(2.0)   # drain inflight past the horizon
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        if nem_thread is not None:
+            nem_thread.join(timeout=120)
+        if runner is not None:
+            runner.heal_all()
+        result["leader_crash"] = crash_log
+
+        # bounded recovery: a checked write within the tick budget
+        t_heal = time.monotonic()
+        budget_s = args.budget_ticks * args.tick
+        rep = GenericEndpoint(cluster.manager_addr)
+        rep.connect()
+        drv = DriverClosedLoop(rep, timeout=min(5.0, budget_s))
+        recovered = False
+        while time.monotonic() - t_heal < budget_s:
+            r = drv.put("wl_recovery", f"s{seed}")
+            if r.kind == "success":
+                recovered = True
+                break
+            drv._retry_pause(r)
+        recovery_s = time.monotonic() - t_heal
+        rep.leave()
+        result["recovery_ticks"] = int(recovery_s / args.tick)
+        if not recovered:
+            result["error"] = (
+                f"no recovery within {args.budget_ticks} ticks"
+            )
+            return result
+
+        # ------------------------------------------------ verdict math
+        result["num_ops"] = len(ops)
+        result["clients"] = sorted(stats, key=lambda s: s["ci"])
+        issued = sum(s["issued"] for s in stats)
+        acked = sum(s["acked"] for s in stats)
+        shed = sum(s["shed"] for s in stats)
+        held = sum(s["held"] for s in stats)
+        result["issued"], result["acked"] = issued, acked
+        result["shed"], result["held"] = shed, held
+        # server-side shed accounting: the api_shed counters must agree
+        # that shedding happened (scraped full, committed trimmed)
+        full = scrape_metrics(cluster.manager_addr)
+        api_shed = {}
+        for sid, snap in (full or {}).items():
+            ctr = snap.get("host", {}).get("counters", {})
+            api_shed[sid] = ctr.get("api_shed", 0)
+        result["api_shed"] = api_shed
+        result["server_metrics"] = {
+            sid: {
+                "tick": snap["tick"],
+                "counters": {
+                    k: v
+                    for k, v in snap["host"]["counters"].items()
+                    if k.startswith("api_")
+                },
+                "histograms": {
+                    k: v
+                    for k, v in snap["host"]["histograms"].items()
+                    if k.split("{", 1)[0] in (
+                        "api_request_latency_us", "ticks_to_commit",
+                    )
+                },
+            }
+            for sid, snap in (full or {}).items()
+        }
+        if len(ops) < args.min_ops:
+            result["error"] = f"history too small: {len(ops)}"
+            return result
+        if acked == 0:
+            result["error"] = "no op ever acked"
+            return result
+
+        # no ack lost to a shed: a value must never be both acked and
+        # negatively acked (values are globally unique per op instance,
+        # so any overlap is a protocol bug, not a collision)
+        acked_vals = {o.value for o in ops
+                      if o.kind == "put" and o.acked and not o.shed}
+        shed_vals = {o.value for o in ops if o.shed}
+        overlap = acked_vals & shed_vals
+        result["ack_shed_overlap"] = len(overlap)
+        if overlap:
+            result["error"] = (
+                f"{len(overlap)} values both acked and shed: "
+                f"{sorted(overlap)[:4]}"
+            )
+            return result
+
+        # phase stats: steady / (burst / recover for overload rows)
+        win_steady = phase_window(wplan, 0, t0, args.tick_len)
+        # skip the first 20% of steady: election/jit settling
+        s_lo = win_steady[0] + 0.2 * (win_steady[1] - win_steady[0])
+        steady_acc = accepted_in(ops, s_lo, win_steady[1])
+        steady_tput = len(steady_acc) / max(win_steady[1] - s_lo, 1e-9)
+        result["steady_tput"] = round(steady_tput, 1)
+        # the steady phases offer rate_x[0] x capacity on both sides of
+        # the burst; recovery is judged against this OFFERED rate (the
+        # measured steady window carries calibration-drain transients
+        # and, at these op counts, real expovariate noise)
+        offered_steady = wplan.phases[0].rate_x * cap
+        result["offered_steady"] = round(offered_steady, 1)
+        lat_all = [o.t_resp - o.t_inv
+                   for o in ops if o.acked and not o.shed]
+        result["p99_s"] = round(p99(lat_all), 3)
+        if overload:
+            win_burst = phase_window(wplan, 1, t0, args.tick_len)
+            win_rec = phase_window(wplan, 2, t0, args.tick_len)
+            burst_acc = accepted_in(ops, *win_burst)
+            result["burst_tput"] = round(
+                len(burst_acc)
+                / max(win_burst[1] - win_burst[0], 1e-9), 1)
+            burst_lat = [o.t_resp - o.t_inv for o in burst_acc
+                         if win_burst[0] <= o.t_inv]
+            result["burst_p99_s"] = round(p99(burst_lat), 3)
+            # recovery tail: the last 40% of the recover phase, clear
+            # of the crash-election window at its start
+            r_lo = win_rec[0] + 0.6 * (win_rec[1] - win_rec[0])
+            rec_acc = accepted_in(ops, r_lo, win_rec[1])
+            rec_tput = len(rec_acc) / max(win_rec[1] - r_lo, 1e-9)
+            result["recover_tput"] = round(rec_tput, 1)
+
+            # server-visible shedding: the post-run scrape PLUS the
+            # burst-peak scrape taken just before the leader crash
+            # (the victim's counter does not survive its restart)
+            server_shed = sum(api_shed.values()) + sum(
+                (result.get("api_shed_pre") or {}).values()
+            )
+            if shed == 0 or server_shed == 0:
+                result["error"] = (
+                    "overload row shed nothing: client sheds "
+                    f"{shed}, server api_shed {api_shed} "
+                    f"(pre-crash {result.get('api_shed_pre')})"
+                )
+                return result
+            if len(burst_acc) < 10:
+                # crash + election eat a slice of the burst; what must
+                # hold is PROGRESS, not a tput floor (the tput floor is
+                # the recover-phase assertion below)
+                result["error"] = (
+                    f"burst made no progress: {len(burst_acc)} acked"
+                )
+                return result
+            if shed >= issued:
+                result["error"] = "everything shed, nothing served"
+                return result
+            if result["burst_p99_s"] > args.p99_budget:
+                result["error"] = (
+                    f"accepted-op p99 {result['burst_p99_s']}s over "
+                    f"budget {args.p99_budget}s through the burst"
+                )
+                return result
+            if rec_tput < args.recover_frac * offered_steady:
+                result["error"] = (
+                    f"throughput did not recover: {rec_tput:.1f}/s "
+                    f"tail vs {offered_steady:.1f}/s offered steady "
+                    f"(need >= {args.recover_frac}x)"
+                )
+                return result
+
+        ok, diag = check_history(ops)
+        result["ok"] = bool(ok)
+        if not ok:
+            result["error"] = diag
+        return result
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if not result["ok"] and runner is not None:
+            result["flight"] = runner.flight_tails(last_n=256)
+        if runner is not None:
+            runner.close()
+        if cluster is not None:
+            cluster.stop()
+        if not result["ok"]:
+            dump = os.path.splitext(args.out)[0] + (
+                f"_{protocol}_{wl_class}_s{seed}_fail.json"
+            )
+            with open(dump, "w") as f:
+                json.dump(
+                    fail_bundle_doc(result, wplan, fplan, runner, ops),
+                    f, indent=1,
+                )
+            print(f"FAIL bundle -> {dump}")
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--protocol", default="MultiPaxos")
+    ap.add_argument("--wl-class", default="hot_burst")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--matrix", action="store_true",
+                    help="run the full joint matrix (WL_MATRIX)")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--tick", type=float, default=0.005,
+                    help="server tick interval (with api_max_batch="
+                         f"{API_MAX_BATCH} this pins ingress capacity)")
+    ap.add_argument("--tick-len", type=float, default=DEFAULT_TICK_LEN,
+                    help="wall seconds per workload/fault tick")
+    ap.add_argument("--op-timeout", type=float, default=5.0)
+    ap.add_argument("--min-ops", type=int, default=60)
+    ap.add_argument("--p99-budget", type=float, default=P99_BUDGET_S)
+    ap.add_argument("--recover-frac", type=float, default=RECOVER_FRAC)
+    ap.add_argument("--budget-ticks", type=int,
+                    default=DEFAULT_BUDGET_TICKS)
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "WORKLOADS.json"))
+    args = ap.parse_args()
+
+    if args.matrix:
+        runs = list(WL_MATRIX)
+    else:
+        match = [
+            row for row in WL_MATRIX
+            if row[0] == args.protocol and row[1] == args.wl_class
+            and row[2] == args.seed
+        ]
+        runs = match or [
+            (args.protocol, args.wl_class, args.seed, args.seed)
+        ]
+    results = []
+    for protocol, wl_class, seed, fseed in runs:
+        r = run_one(protocol, wl_class, seed, fseed, args)
+        status = "PASS" if r["ok"] else f"FAIL ({r.get('error')})"
+        print(f"=== {protocol} {wl_class} seed={seed}: {status} "
+              f"(ops={r.get('num_ops')}, acked={r.get('acked')}, "
+              f"shed={r.get('shed')}, p99={r.get('p99_s')}s)")
+        results.append(r)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {args.out}")
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # hard exit: same rationale as nemesis_soak (daemon replica threads
+    # frozen mid-XLA can std::terminate after results are written)
+    os._exit(0 if all(r["ok"] for r in results) else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,10 +54,11 @@ STORAGE_OWNER = "host/storage.py"
 
 # seeded-determinism scopes: module -> class names whose methods must be
 # wallclock-free and draw only from explicitly seeded RNGs (the nemesis
-# schedule-generation surface; NemesisRunner's wall pacing is exempt by
-# not being listed)
+# and workload schedule-generation surfaces; NemesisRunner's and the
+# open-loop drivers' wall pacing are exempt by not being listed)
 SEEDED_SCOPES: Dict[str, Tuple[str, ...]] = {
     "host/nemesis.py": ("FaultPlan", "FaultEvent"),
+    "host/workload.py": ("WorkloadPlan", "WorkloadPhase", "OpStream"),
 }
 
 # monotonic-only scopes: module -> class names (or "*" for the whole

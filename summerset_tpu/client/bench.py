@@ -74,6 +74,7 @@ class ClientBench:
         trace: Optional[List[Tuple[str, str, Optional[str]]]] = None,
         interval: float = 0.1,
         seed: int = 0,
+        opgen=None,
     ):
         self.ep = endpoint
         self.secs = secs
@@ -85,7 +86,15 @@ class ClientBench:
         self.trace = trace
         self.interval = interval
         self.rng = random.Random(seed)
-        self.keys = [f"k{i}" for i in range(num_keys)]
+        # workload plane (host/workload.WorkloadPlan.opstream): when an
+        # op stream is given it owns kinds/keys/value sizes and the
+        # uniform knobs above are ignored — uniform stays the default so
+        # committed TPUTLAT/HOSTBENCH trajectories remain comparable
+        self.opgen = opgen
+        self.keys = (
+            list(opgen.keys) if opgen is not None
+            else [f"k{i}" for i in range(num_keys)]
+        )
 
     def _value(self, now: float) -> str:
         size = self.schedule[0][1]
@@ -98,7 +107,17 @@ class ClientBench:
             self.rng.choices(string.ascii_lowercase, k=size)
         )
 
+    def _sized_value(self, size: int) -> str:
+        return "".join(
+            self.rng.choices(string.ascii_lowercase, k=max(1, size))
+        )
+
     def _next_cmd(self, now: float, i: int) -> Command:
+        if self.opgen is not None:
+            kind, key, size = self.opgen.next()
+            if kind == "put":
+                return Command("put", key, self._sized_value(size))
+            return Command("get", key)
         if self.trace:
             op, key, val = self.trace[i % len(self.trace)]
             if op == "put":

@@ -5,6 +5,14 @@ Parity: reference ``summerset_client/src/drivers/`` —
 (closed_loop.rs; ``DriverReply::{Success{latency}, Redirect, Timeout}``,
 drivers/mod.rs:12-40); ``DriverOpenLoop`` pipelines issues and acks
 (open_loop.rs) with would-block-style retry awareness.
+
+``DriverOpenLoopPaced`` is the workload plane's driver
+(``host/workload.WorkloadPlan``): a shed-aware pipelined driver for
+open-loop arrival schedules — arrivals keep coming at the offered rate
+regardless of replies, and an ``ApiReply(kind="shed")`` negative ack
+gates issuing until the server's retry-after hint (with seeded jitter)
+has elapsed, so backed-off clients neither hot-retry into a full queue
+nor synchronize into a thundering herd when it drains.
 """
 
 from __future__ import annotations
@@ -13,7 +21,7 @@ import dataclasses
 import random
 import socket
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..host.statemach import Command, CommandResult
 from .endpoint import GenericEndpoint
@@ -45,10 +53,23 @@ class Backoff:
         time.sleep(d)
         return d
 
+    def sleep_hint(self, hint_s: float) -> float:
+        """Honor a server retry-after hint with jitter (uniform in
+        [0.5, 1.5] x hint, capped): the hint centers the backoff on the
+        server's own drain estimate, the jitter de-synchronizes the
+        herd of shed clients that all received the same hint."""
+        d = min(max(hint_s, 0.001) * self._rng.uniform(0.5, 1.5),
+                self.cap)
+        time.sleep(d)
+        return d
+
 
 @dataclasses.dataclass
 class DriverReply:
     # success | redirect | timeout | failure (server refused) |
+    # shed (ingress backpressure: definitely NOT executed; honor
+    # retry_after before retrying — the server is healthy, rotating
+    # away from it would just overload the next one) |
     # disconnect (connection dead — callers must reconnect/rotate, a
     # retry in place can never succeed)
     kind: str
@@ -56,6 +77,7 @@ class DriverReply:
     result: Optional[CommandResult] = None
     redirect: Optional[int] = None
     local: bool = False           # served as a leased local read
+    retry_after: float = 0.0      # seconds (shed backoff hint)
 
 
 class DriverClosedLoop:
@@ -95,28 +117,21 @@ class DriverClosedLoop:
             if rep.req_id != rid:
                 continue  # stale reply from a previous timeout
             if rep.kind == "redirect":
-                hint = rep.redirect
-                self.ep.note_leader(hint)
                 # the reconnect is bounded by THIS request's remaining
                 # budget: a black-holed hinted server must not stretch
                 # the call past self.timeout (the connect used to ride a
                 # fixed 15s socket timeout, overshooting the deadline)
-                budget = deadline - time.monotonic()
-                try:
-                    if budget <= 0:
-                        pass  # out of budget: the caller's retry rotates
-                    elif (
-                        hint is not None and hint >= 0
-                        and hint != self.ep.current
-                    ):
-                        self.ep.reconnect(hint, timeout=budget)
-                    else:
-                        # no hint, or the server pointed at itself
-                        # (leadership unsettled): walk the membership
-                        self.ep.rotate(deadline=deadline)
-                except Exception:
-                    pass  # hinted server down: the next retry rotates
+                self.ep.follow_redirect(rep.redirect, deadline=deadline)
                 return DriverReply("redirect", redirect=rep.redirect)
+            if rep.kind == "shed":
+                # ingress backpressure: the request never entered the
+                # queue (guaranteed not executed); the caller should
+                # back off by the hint, not rotate — the server is
+                # healthy, just full
+                return DriverReply(
+                    "shed",
+                    retry_after=max(rep.retry_after_ms, 1) / 1e3,
+                )
             if rep.kind in ("reply", "conf") and rep.success:
                 return DriverReply(
                     "success",
@@ -165,22 +180,10 @@ class DriverClosedLoop:
                 if raw.req_id != rid:
                     continue
                 if raw.kind == "redirect":
-                    hint = raw.redirect
-                    self.ep.note_leader(hint)
-                    budget = deadline - time.monotonic()
-                    try:
-                        if budget <= 0:
-                            pass
-                        elif (
-                            hint is not None and hint >= 0
-                            and hint != self.ep.current
-                        ):
-                            self.ep.reconnect(hint, timeout=budget)
-                        else:
-                            self.ep.rotate(deadline=deadline)
-                    except Exception:
-                        pass
-                    rep = DriverReply("redirect", redirect=hint)
+                    self.ep.follow_redirect(
+                        raw.redirect, deadline=deadline
+                    )
+                    rep = DriverReply("redirect", redirect=raw.redirect)
                     break
                 rep = (
                     DriverReply("success",
@@ -210,6 +213,17 @@ class DriverClosedLoop:
             except Exception:
                 pass
 
+    def _retry_pause(self, rep: DriverReply) -> None:
+        """Between-retry wait: sheds honor the server's retry-after
+        hint (jittered; no rotation — the server is healthy, just
+        full), everything else takes the exponential backoff after the
+        usual failover rotation."""
+        if rep.kind == "shed":
+            self.backoff.sleep_hint(rep.retry_after)
+        else:
+            self._failover(rep)
+            self.backoff.sleep()
+
     def checked_put(self, key: str, value: str, retries: int = 20):
         """Retry through redirects/timeouts until acked (tester helper,
         parity: tester.rs checked_put).  Retries back off with jitter
@@ -219,8 +233,7 @@ class DriverClosedLoop:
             if rep.kind == "success":
                 self.backoff.reset()
                 return rep
-            self._failover(rep)
-            self.backoff.sleep()
+            self._retry_pause(rep)
         raise AssertionError(f"checked_put({key}) failed after retries")
 
     def checked_get(self, key: str, expect: Optional[str],
@@ -232,8 +245,7 @@ class DriverClosedLoop:
                 assert got == expect, f"get({key}) = {got} != {expect}"
                 self.backoff.reset()
                 return rep
-            self._failover(rep)
-            self.backoff.sleep()
+            self._retry_pause(rep)
         raise AssertionError(f"checked_get({key}) failed after retries")
 
 
@@ -265,8 +277,157 @@ class DriverOpenLoop:
         if rep.kind == "redirect":
             self.ep.reconnect(rep.redirect)
             return DriverReply("redirect", redirect=rep.redirect)
+        if rep.kind == "shed":
+            # negative ack (never executed) — a bench counting this as
+            # success would fold refused ops into the very overload
+            # curves the workload classes exist to measure
+            return DriverReply(
+                "shed", retry_after=max(rep.retry_after_ms, 1) / 1e3,
+            )
+        if rep.kind not in ("reply", "conf") or not rep.success:
+            return DriverReply("failure")
         return DriverReply(
             "success",
             latency=(time.monotonic() - t0) if t0 else 0.0,
             result=rep.result,
         )
+
+
+class DriverOpenLoopPaced:
+    """Shed-aware pipelined driver for open-loop workload schedules
+    (``host/workload.WorkloadPlan``): the caller paces arrivals (the
+    plan's phase table x the wall clock is the runner's business), this
+    driver owns the inflight window, reply matching, shed gating, and
+    per-op deadlines.
+
+    Recording semantics for the workload soak's ``utils/linearize``
+    histories (returned per reply so the caller can record):
+
+    - ``success``  — acked; record with [t_inv, t_resp];
+    - ``shed``     — negatively acked (guaranteed never proposed);
+      record as a shed op (the checker EXCLUDES it — a get observing
+      its value is then a linearizability violation) and gate issuing
+      until the jittered retry-after elapses;
+    - ``redirect`` — refused without proposing; not recorded (the
+      driver reconnects toward the hint);
+    - expiry (``expired()``) — no reply within ``timeout``: a put may
+      or may not have executed, record UNACKED.
+    """
+
+    def __init__(self, endpoint: GenericEndpoint, timeout: float = 5.0,
+                 seed: int = 0, max_inflight: int = 128):
+        self.ep = endpoint
+        self.timeout = timeout
+        self.next_req = 0
+        # rid -> {"kind", "key", "value", "t0", "deadline"}
+        self.inflight: Dict[int, dict] = {}
+        # bounded window (YCSB-style): past it, arrivals are dropped
+        # client-side and counted — an unbounded window under overload
+        # would just move the unbounded queue into the client
+        self.max_inflight = max(1, int(max_inflight))
+        self._rng = random.Random(seed * 65537 + 3)
+        self.hold_until = 0.0  # shed gate (monotonic seconds)
+        self.counts = {
+            "issued": 0, "acked": 0, "shed": 0, "expired": 0,
+            "redirect": 0, "failure": 0, "held": 0, "window": 0,
+        }
+
+    def gated(self, now: float) -> bool:
+        """Is issuing currently suppressed by a shed retry-after
+        hint?  (Open-loop arrivals landing inside the gate are counted
+        ``held`` by the caller and dropped — the client-side half of
+        graceful degradation.)"""
+        return now < self.hold_until
+
+    def issue(self, kind: str, key: str,
+              value: Optional[str] = None) -> Optional[int]:
+        """Send one op; returns its rid, or None when the connection
+        died at send (the op never left — nothing to record; the driver
+        rotates so the next arrival has a live socket)."""
+        if len(self.inflight) >= self.max_inflight:
+            self.counts["window"] += 1
+            return None
+        rid = self.next_req
+        self.next_req += 1
+        cmd = (Command("put", key, value) if kind == "put"
+               else Command("get", key))
+        try:
+            self.ep.send_req(rid, cmd)
+        except Exception:
+            self._reconnect()
+            return None
+        now = time.monotonic()
+        self.inflight[rid] = {
+            "kind": kind, "key": key, "value": value,
+            "t0": now, "deadline": now + self.timeout,
+        }
+        self.counts["issued"] += 1
+        return rid
+
+    def _reconnect(self) -> None:
+        try:
+            self.ep.rotate(deadline=time.monotonic() + 1.0)
+        except Exception:
+            pass
+
+    def poll(self, budget: float) -> List[Tuple[dict, DriverReply]]:
+        """Drain replies for up to ``budget`` seconds; returns
+        ``[(inflight-info, DriverReply)]`` for every matched reply."""
+        out: List[Tuple[dict, DriverReply]] = []
+        end = time.monotonic() + max(budget, 0.0)
+        while True:
+            rem = end - time.monotonic()
+            if rem <= 0:
+                break
+            try:
+                rep = self.ep.recv_reply(timeout=max(rem, 0.001))
+            except socket.timeout:
+                break
+            except Exception:
+                # dead/mid-frame connection: inflight ops will expire
+                # as unacked; reconnect for the next arrivals
+                self._reconnect()
+                break
+            info = self.inflight.pop(rep.req_id, None)
+            if info is None:
+                continue  # stale reply from before a reconnect
+            now = time.monotonic()
+            if rep.kind == "shed":
+                hint = max(rep.retry_after_ms, 1) / 1e3
+                self.hold_until = max(
+                    self.hold_until,
+                    now + hint * self._rng.uniform(0.5, 1.5),
+                )
+                self.counts["shed"] += 1
+                out.append((info, DriverReply(
+                    "shed", retry_after=hint,
+                )))
+            elif rep.kind == "redirect":
+                self.counts["redirect"] += 1
+                self.ep.follow_redirect(rep.redirect, deadline=now + 1.0)
+                out.append((info, DriverReply(
+                    "redirect", redirect=rep.redirect,
+                )))
+            elif rep.kind in ("reply", "conf") and rep.success:
+                self.counts["acked"] += 1
+                out.append((info, DriverReply(
+                    "success", latency=now - info["t0"],
+                    result=rep.result, local=rep.local,
+                )))
+            else:
+                self.counts["failure"] += 1
+                out.append((info, DriverReply("failure")))
+            if not self.inflight:
+                break
+        return out
+
+    def expired(self) -> List[dict]:
+        """Pop and return every inflight op past its deadline (puts
+        among them must be recorded UNACKED — they may have executed)."""
+        now = time.monotonic()
+        out = []
+        for rid, info in list(self.inflight.items()):
+            if now > info["deadline"]:
+                out.append(self.inflight.pop(rid))
+        self.counts["expired"] += len(out)
+        return out

@@ -279,6 +279,32 @@ class GenericEndpoint:
             except OSError:
                 continue
 
+    def follow_redirect(self, hint: Optional[int],
+                        deadline: Optional[float] = None) -> None:
+        """The one redirect-failover policy every driver shares: note
+        the data-plane leader hint, reconnect toward it when it is a
+        usable different server, else walk the membership — all bounded
+        by ``deadline`` (monotonic seconds) and swallowing connect
+        errors (a black-holed hinted server costs this call its budget,
+        never an exception; the caller's next retry rotates)."""
+        import time
+
+        self.note_leader(hint)
+        budget = (
+            None if deadline is None else deadline - time.monotonic()
+        )
+        try:
+            if budget is not None and budget <= 0:
+                return  # out of budget: the caller's retry rotates
+            if hint is not None and hint >= 0 and hint != self.current:
+                self.reconnect(hint, timeout=budget)
+            else:
+                # no hint, or the server pointed at itself (leadership
+                # unsettled): walk the membership
+                self.rotate(deadline=deadline)
+        except Exception:
+            pass  # hinted server down: the next retry rotates
+
     def send_req(self, req_id: int, cmd: Command) -> None:
         assert self.api is not None, "connect() first"
         self.api.send_req(ApiRequest("req", req_id=req_id, cmd=cmd))

@@ -17,9 +17,9 @@ import time
 from typing import List, Optional, Tuple
 
 from ..host.messages import CtrlRequest
-from ..utils.linearize import record_get, record_put
+from ..utils.linearize import record_get, record_put, record_shed_put
 from ..utils.logging import pf_info, pf_logger
-from .drivers import DriverClosedLoop
+from .drivers import DriverClosedLoop, DriverOpenLoopPaced
 from .endpoint import GenericEndpoint
 
 logger = pf_logger("tester")
@@ -54,9 +54,12 @@ def recorded_closed_loop(
 
     Semantics of the record: successes carry [t_inv, t_resp]; a put that
     timed out / disconnected is recorded UNACKED (it may or may not have
-    executed — the checker is free to place or drop it); a redirect is
-    no op at all (the server refused without proposing).  Gets that fail
-    observe nothing and are not recorded.
+    executed — the checker is free to place or drop it); a SHED put is
+    recorded as a negative ack (guaranteed never executed — the checker
+    excludes it, so a get observing its value is a violation) and the
+    client honors the retry-after hint; a redirect is no op at all (the
+    server refused without proposing).  Gets that fail observe nothing
+    and are not recorded.
     """
     rng = random.Random(seed * 1009 + ci)
     try:
@@ -75,6 +78,9 @@ def recorded_closed_loop(
             t1 = time.monotonic()
             if rep.kind == "success":
                 ops.append(record_put(ci, key, val, t0, t1, True))
+            elif rep.kind == "shed":
+                ops.append(record_shed_put(ci, key, val, t0, t1))
+                drv.backoff.sleep_hint(rep.retry_after)
             elif rep.kind in ("timeout", "failure", "disconnect"):
                 ops.append(record_put(ci, key, val, t0, None, False))
                 drv._failover(rep)
@@ -84,6 +90,8 @@ def recorded_closed_loop(
             if rep.kind == "success":
                 val = rep.result.value if rep.result else None
                 ops.append(record_get(ci, key, val, t0, t1))
+            elif rep.kind == "shed":
+                drv.backoff.sleep_hint(rep.retry_after)
             elif rep.kind in ("timeout", "failure", "disconnect"):
                 drv._failover(rep)
         seq += 1
@@ -111,6 +119,146 @@ def start_recorded_clients(
             daemon=True,
         )
         for ci in range(num_clients)
+    ]
+    for t in threads:
+        t.start()
+    return threads
+
+
+# ---------------------------------------------------- workload soak plane
+def recorded_open_loop(
+    manager_addr: Tuple[str, int],
+    ci: int,
+    stream,
+    rate_of,
+    stop: threading.Event,
+    ops: list,
+    stats: list,
+    seed: int = 0,
+    timeout: float = 5.0,
+) -> None:
+    """One OPEN-LOOP client paced by a WorkloadPlan stream: arrivals
+    come at ``rate_of()`` reqs/s (the runner's closure over the plan's
+    phase table — rate 0 stops issuing, e.g. past the horizon)
+    regardless of outstanding replies, with seeded-expovariate
+    inter-arrival jitter.  Op kinds/keys/values come from ``stream``
+    (``WorkloadPlan.opstream(ci)`` — a pure function of the seed).
+
+    Records the same ``utils/linearize`` history as the closed-loop
+    recorder, extended with the overload outcomes: acked ops carry
+    [t_inv, t_resp] (their latency IS t_resp - t_inv, which the soak's
+    accepted-op p99 reads straight off the history); shed puts are
+    recorded as negative acks; arrivals landing inside a shed
+    retry-after gate are counted ``held`` and dropped client-side (the
+    client half of graceful degradation); expiries record unacked puts.
+    Per-client driver counters land in ``stats`` at exit.
+    """
+    rng = random.Random(seed * 4241 + ci * 97 + 1)
+    try:
+        ep = GenericEndpoint(manager_addr)
+        ep.connect()
+    except Exception:
+        return  # cluster unreachable at spawn: nothing observed
+    drv = DriverOpenLoopPaced(ep, timeout=timeout, seed=seed * 31 + ci)
+
+    def record(info: dict, rep) -> None:
+        t1 = time.monotonic()
+        if rep.kind == "success":
+            if info["kind"] == "put":
+                ops.append(record_put(
+                    ci, info["key"], info["value"], info["t0"],
+                    info["t0"] + rep.latency, True,
+                ))
+            else:
+                val = rep.result.value if rep.result else None
+                ops.append(record_get(
+                    ci, info["key"], val, info["t0"],
+                    info["t0"] + rep.latency,
+                ))
+        elif rep.kind == "shed" and info["kind"] == "put":
+            ops.append(record_shed_put(
+                ci, info["key"], info["value"], info["t0"], t1,
+            ))
+        elif rep.kind == "failure" and info["kind"] == "put":
+            # an explicit error reply: conservatively unacked (the
+            # reference error path replies without proposing, but the
+            # checker need not trust that)
+            ops.append(record_put(
+                ci, info["key"], info["value"], info["t0"], None, False,
+            ))
+
+    def expire() -> None:
+        for info in drv.expired():
+            if info["kind"] == "put":
+                ops.append(record_put(
+                    ci, info["key"], info["value"], info["t0"], None,
+                    False,
+                ))
+
+    t_next = time.monotonic()
+    while not stop.is_set():
+        now = time.monotonic()
+        expire()
+        rate = float(rate_of())
+        if rate > 0 and now >= t_next:
+            if drv.gated(now):
+                drv.counts["held"] += 1
+            else:
+                kind, key, size = stream.next()
+                val = None
+                if kind == "put":
+                    body = f"c{ci}-{drv.next_req}"
+                    val = body + "x" * max(0, size - len(body))
+                drv.issue(kind, key, val)
+            t_next = now + rng.expovariate(rate)
+        budget = (
+            min(max(t_next - now, 0.0005), 0.02) if rate > 0 else 0.02
+        )
+        for info, rep in drv.poll(budget):
+            record(info, rep)
+    # drain stragglers briefly, then expire what never answered
+    t_end = time.monotonic() + min(timeout, 2.0)
+    while drv.inflight and time.monotonic() < t_end:
+        for info, rep in drv.poll(0.1):
+            record(info, rep)
+    for info in drv.inflight.values():
+        if info["kind"] == "put":
+            ops.append(record_put(
+                ci, info["key"], info["value"], info["t0"], None, False,
+            ))
+    drv.inflight.clear()
+    stats.append({"ci": ci, **drv.counts})
+    try:
+        ep.leave()
+    except Exception:
+        pass
+
+
+def start_workload_clients(
+    manager_addr: Tuple[str, int],
+    plan,
+    rate_total_of,
+    stop: threading.Event,
+    ops: list,
+    stats: list,
+    timeout: float = 5.0,
+) -> List[threading.Thread]:
+    """Spawn ``plan.clients`` open-loop recorder threads, each driving
+    its own ``plan.opstream(ci)`` at an equal share of the total
+    offered rate ``rate_total_of()`` (reqs/s)."""
+    n = max(1, int(plan.clients))
+
+    def rate_of():
+        return float(rate_total_of()) / n
+
+    threads = [
+        threading.Thread(
+            target=recorded_open_loop,
+            args=(manager_addr, ci, plan.opstream(ci), rate_of, stop,
+                  ops, stats, plan.seed, timeout),
+            daemon=True,
+        )
+        for ci in range(n)
     ]
     for t in threads:
         t.start()

@@ -7,6 +7,22 @@ queue that the replica drains every ``batch_interval`` seconds (capped at
 (external.rs:697-730).  Clients identify themselves by sending their
 assigned id as the first frame.  Replies are routed back through the
 servant owning that client's connection.
+
+Ingress backpressure (the overload-survival contract the workload plane
+soaks): the pending queue is BOUNDED at ``max_pending``.  A data-plane
+request arriving at a full queue is refused on the spot with an
+``ApiReply(kind="shed", retry_after_ms=...)`` — an explicit negative
+ack sent before the request ever enters the queue, so a shed op is
+GUARANTEED never proposed (``utils/linearize`` excludes shed puts on
+exactly that guarantee).  The retry-after hint is the queue's estimated
+drain time (depth over an EWMA of the replica's observed batch-take
+rate), so backed-off clients return roughly when space exists instead
+of synchronously hammering a still-full queue.  Sheds are never silent:
+the ``api_shed`` counter, the ``api_queue_depth`` gauge, and a typed
+``api_shed`` flight-recorder event make every refusal attributable in
+telemetry and on graftscope request chains.  Conf/leave requests bypass
+the bound (control-plane ops are rare and must not starve under data
+overload).
 """
 
 from __future__ import annotations
@@ -29,12 +45,21 @@ class ExternalApi:
         api_addr: Tuple[str, int],
         batch_interval: float = 0.001,
         max_batch_size: int = 5000,
+        max_pending: int = 16384,
         registry=None,
         flight=None,
     ):
         self.api_addr = api_addr
         self.batch_interval = batch_interval
         self.max_batch_size = max_batch_size
+        # ingress bound: data-plane requests beyond this queue depth are
+        # shed with a retry-after hint instead of buffered unboundedly
+        self.max_pending = max(1, int(max_pending))
+        # EWMA of the replica's batch-take rate (reqs/s), written by
+        # get_req_batch on the replica thread and read (one float load)
+        # by servants computing retry-after hints
+        self._drain_rate = 0.0
+        self._drain_t: Optional[float] = None
         # graftscope seam (host/tracing.FlightRecorder): api_ingress /
         # api_reply events keyed by (client, req_id) — the request-span
         # endpoints the trace exporter joins to the propose/commit chain
@@ -50,6 +75,10 @@ class ExternalApi:
             # pre-register so the eviction blind spot is visible (and
             # zero) in every snapshot, not only after an overload
             registry.counter_add("api_stamps_evicted", 0)
+            # likewise the backpressure lanes: a zero api_shed series
+            # distinguishes "never overloaded" from "not measured"
+            registry.counter_add("api_shed", 0)
+            registry.gauge_set("api_queue_depth", 0)
         self._arrivals: Dict[Tuple[int, int], float] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server = None
@@ -74,9 +103,35 @@ class ExternalApi:
         with self._lock:
             batch = self._pending[: self.max_batch_size]
             del self._pending[: len(batch)]
+            depth = len(self._pending)
             if not self._pending:
                 self._batch_ready.clear()
+        if batch:
+            # EWMA drain rate: what the replica actually takes per
+            # second (max_batch_size per tick, not per batch_interval —
+            # the replica polls once per tick).  Retry-after hints are
+            # depth / this rate: roughly when the queue will have space.
+            now = time.monotonic()
+            t0 = self._drain_t
+            if t0 is not None and now > t0:
+                inst = len(batch) / (now - t0)
+                self._drain_rate = (
+                    inst if self._drain_rate <= 0.0
+                    else 0.8 * self._drain_rate + 0.2 * inst
+                )
+            self._drain_t = now
+            if self.registry is not None:
+                self.registry.gauge_set("api_queue_depth", depth)
         return batch
+
+    def _retry_after_ms(self, depth: int) -> int:
+        """Shed hint: estimated ms until the queue has drained ``depth``
+        entries, clamped to [5, 1000] (a cold/stalled drain rate must
+        not produce an unbounded or zero hint)."""
+        rate = self._drain_rate
+        if rate <= 0.0:
+            return 50
+        return int(min(1000.0, max(5.0, 1000.0 * depth / rate)))
 
     def send_reply(self, reply: ApiReply, client: int) -> None:
         """Route a reply to the servant owning `client`'s connection."""
@@ -159,6 +214,46 @@ class ExternalApi:
                         writer, ApiReply(kind="leave", req_id=req.req_id)
                     )
                     break
+                if req.kind == "req":
+                    # bounded ingress (conf/leave bypass the bound —
+                    # rare control ops must not starve under data
+                    # overload).  The check-then-append split below is
+                    # still race-free against other servants: they are
+                    # coroutines on THIS loop and nothing between the
+                    # check and the append awaits, while the replica
+                    # thread only ever SHRINKS the queue — so the depth
+                    # read here can only overestimate, never undershoot,
+                    # and the bound holds strictly.  Stamping ingress
+                    # before the append (not after) keeps the
+                    # flight-recorder ordering invariant: a request's
+                    # api_ingress always precedes any propose that
+                    # consumed it.
+                    with self._lock:
+                        depth = len(self._pending)
+                    if depth >= self.max_pending:
+                        hint = self._retry_after_ms(depth)
+                        if self.registry is not None:
+                            self.registry.counter_add(
+                                "api_requests_total"
+                            )
+                            self.registry.counter_add("api_shed")
+                            # the shed IS this request's reply; keep
+                            # the requests/replies counter pair
+                            # reconcilable under sustained overload
+                            self.registry.counter_add(
+                                "api_replies_total", kind="shed"
+                            )
+                        if self.flight is not None:
+                            self.flight.record(
+                                "api_shed", client=int(client),
+                                req_id=req.req_id, retry_ms=hint,
+                                depth=depth,
+                            )
+                        await safetcp.send_msg(writer, ApiReply(
+                            kind="shed", req_id=req.req_id,
+                            success=False, retry_after_ms=hint,
+                        ))
+                        continue
                 if self.flight is not None:
                     self.flight.record(
                         "api_ingress", client=int(client),

@@ -28,15 +28,25 @@ class ApiRequest:
 
 @dataclasses.dataclass(frozen=True)
 class ApiReply:
-    """Server -> client (parity: ``ApiReply``, external.rs:155-183)."""
+    """Server -> client (parity: ``ApiReply``, external.rs:155-183).
 
-    kind: str   # "reply" | "conf" | "redirect" | "error" | "leave"
+    ``kind == "shed"`` is the ingress-backpressure negative ack: the
+    bounded api queue was full, the request was REFUSED BEFORE entering
+    the queue (so it can never have been proposed, let alone executed —
+    ``utils/linearize`` soundly excludes shed puts on that guarantee),
+    and ``retry_after_ms`` hints when the client should retry (drivers
+    honor it with seeded jittered backoff instead of hot-retrying into
+    the same full queue)."""
+
+    kind: str   # "reply" | "conf" | "redirect" | "error" | "shed"
+    #             | "leave"
     req_id: int = 0
     result: Optional[CommandResult] = None
     redirect: Optional[int] = None  # hinted leader id
     success: bool = True
     rq_retry: bool = False          # read-query retry hint
     local: bool = False             # served as a leased local read
+    retry_after_ms: int = 0         # shed: suggested client backoff
 
 
 # -------------------------------------------------------------- p2p plane

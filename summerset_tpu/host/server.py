@@ -150,6 +150,14 @@ class ServerReplica:
         # host-side knobs (not kernel config fields)
         self.snapshot_interval = int(cfg.pop("snapshot_interval", 0))
         self.record_breakdown = bool(cfg.pop("record_breakdown", False))
+        # ingress backpressure knobs (host/external.py): api_max_batch
+        # caps what one tick's intake drains (it DEFINES the ingress
+        # capacity api_max_batch / tick_interval that the workload
+        # plane's overload soak offers 2x of), api_max_pending bounds
+        # the queue — beyond it requests are shed with a retry-after
+        # hint instead of buffered without bound
+        self.api_max_batch = int(cfg.pop("api_max_batch", 5000))
+        self.api_max_pending = int(cfg.pop("api_max_pending", 16384))
         self._bd_last_print = time.monotonic()
         self.near_quorum_reads = bool(cfg.pop("near_quorum_reads", False))
         # telemetry plane: one registry threaded through every hub seam
@@ -431,7 +439,9 @@ class ServerReplica:
                         raise
 
             self.external = ExternalApi(
-                api_addr, registry=self.metrics, flight=self.flight,
+                api_addr, max_batch_size=self.api_max_batch,
+                max_pending=self.api_max_pending,
+                registry=self.metrics, flight=self.flight,
             )
         except BaseException:
             # failed bring-up must release every port/handle it grabbed:

@@ -287,6 +287,12 @@ DECLARED = (
     "api_requests_total",
     "api_replies_total",
     "api_stamps_evicted",
+    # ingress backpressure (host/external.py bounded queue): sheds are
+    # pre-registered at zero so "no overload yet" is visible as 0, not
+    # as a missing series; queue depth is the gauge the shed decision
+    # reads, sampled at every batch take
+    "api_shed",
+    "api_queue_depth",
     "transport_frames_sent",
     "transport_bytes_sent",
     "transport_frames_recv",

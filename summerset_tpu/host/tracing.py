@@ -55,6 +55,10 @@ from typing import Any, Dict, List, Optional
 EVENT_TYPES = (
     "api_ingress",   # client request hit the api plane (client, req_id)
     "api_reply",     # reply left the api plane (client, req_id, kind)
+    "api_shed",      # ingress backpressure refused a request before it
+                     # entered the queue (client, req_id, retry_ms,
+                     # depth) — overload is attributable on graftscope
+                     # request chains instead of vanishing silently
     "propose",       # sampled batch proposed (g, vid, tick, client, req_id)
     "tick",          # run-loop iteration (tick, per-stage durations us)
     "frame_tx",      # p2p frame sent (peer=dst, seq=sender tick, nbytes)

@@ -1,0 +1,274 @@
+"""Deterministic workload plane: seeded adversarial traffic schedules.
+
+The workload twin of ``host/nemesis.py``: where a ``FaultPlan`` decides
+*what breaks and when*, a ``WorkloadPlan`` decides *what traffic arrives
+and when* — and both obey the same determinism contract, enforced by the
+same lint (graftlint H103 covers this module's plan/stream classes):
+``WorkloadPlan.generate(seed, wl_class, ...)`` draws only from
+``random.Random`` seeded off its arguments, so the same seed always
+yields a byte-identical ``timeline()`` and the same per-client op
+sequence.  Every overload bug found under a workload schedule is a
+one-line repro (``--wl-class C --seed N``), and the joint
+workload × nemesis soak (``scripts/workload_soak.py``) replays BOTH
+schedules from their seeds.
+
+Classes are YCSB-style (PAPERS.md: compartmentalized SMR and HT-Paxos
+both assume an ingress tier that batches and absorbs client load; these
+classes are the traffic that tier must absorb):
+
+- ``uniform``      — uniform keys, balanced mix (the legacy bench class);
+- ``read_mostly``  — zipfian hot keys, ~5-10% puts (YCSB-B territory);
+- ``write_heavy``  — zipfian hot keys, ~85-95% puts (ingest pressure on
+                     the log + WAL planes);
+- ``value_mix``    — log-uniform value sizes over a wide range (frame
+                     encoder / payload-plane stress);
+- ``multi_tenant`` — per-client private key ranges plus a small shared
+                     hot range (the KeyRangeMap routing scenario);
+- ``hot_burst``    — strong zipfian skew plus an open-loop arrival
+                     schedule whose burst phase offers ~2x the ingress
+                     capacity: the overload-survival scenario (bounded
+                     queues must shed visibly, not buffer unboundedly).
+
+Split of responsibilities: everything *logical* (op kinds, keys, value
+sizes, phase structure, rate multipliers) lives here and is a pure
+function of the seed; everything *temporal* (mapping phase ticks to wall
+seconds, expovariate arrival pacing against the monotonic clock) lives
+in the drivers (``client/drivers.DriverOpenLoopPaced`` and the soak
+runner), exactly as ``NemesisRunner`` owns wall pacing for fault plans.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import zlib
+from typing import List, Tuple
+
+#: every workload class the plane knows how to generate
+WORKLOAD_CLASSES = (
+    "uniform",
+    "read_mostly",
+    "write_heavy",
+    "value_mix",
+    "multi_tenant",
+    "hot_burst",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadPhase:
+    """One open-loop arrival phase.  ``tick``/``ticks`` are workload
+    schedule ticks (the runner maps them to wall seconds with its
+    ``tick_len``, sharing the logical clock with the FaultPlan playing
+    alongside); ``rate_x`` is the offered-arrival multiplier relative to
+    the serving path's ingress capacity (``api_max_batch / tick``) — a
+    phase with ``rate_x >= 1`` offers more than the ingress tier can
+    drain and MUST surface as visible shedding, not unbounded queues."""
+
+    tick: int
+    ticks: int
+    rate_x: float
+
+    def render(self) -> str:
+        return (
+            f"@{self.tick:05d} phase rate_x={self.rate_x:g}"
+            f" ticks={self.ticks}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadPlan:
+    seed: int
+    wl_class: str
+    clients: int
+    num_keys: int
+    put_ratio: float
+    zipf_s: float           # 0 = uniform key popularity
+    value_lo: int
+    value_hi: int
+    log_values: bool        # log-uniform (vs uniform) value sizes
+    tenant_span: int        # >0: per-client private key range width
+    shared_keys: int        # multi-tenant: size of the shared hot range
+    shared_frac: float      # fraction of multi-tenant ops on shared keys
+    phases: Tuple[WorkloadPhase, ...]
+
+    # ------------------------------------------------------------ build
+    @staticmethod
+    def generate(
+        seed: int,
+        wl_class: str,
+        clients: int = 3,
+        num_keys: int = 24,
+        horizon: int = 120,
+    ) -> "WorkloadPlan":
+        """Draw a plan from the seed.  Class parameters are jittered
+        per-seed inside each class's envelope, so different seeds of the
+        same class are genuinely different workloads while the class's
+        character (skew, mix, burst shape) is preserved."""
+        import random
+
+        if wl_class not in WORKLOAD_CLASSES:
+            raise ValueError(f"unknown workload class {wl_class!r}")
+        # class-salted seed: seed 1 of read_mostly and seed 1 of
+        # write_heavy must not share a random stream
+        rng = random.Random(
+            (seed << 16) ^ zlib.crc32(wl_class.encode())
+        )
+        put_ratio, zipf_s = 0.5, 0.0
+        value_lo, value_hi, log_values = 48, 64, False
+        tenant_span, shared_keys, shared_frac = 0, 0, 0.0
+        steady = round(0.25 + rng.uniform(0.0, 0.15), 3)
+        phases: List[WorkloadPhase] = [
+            WorkloadPhase(0, horizon, steady)
+        ]
+        if wl_class == "read_mostly":
+            put_ratio = round(rng.uniform(0.04, 0.10), 3)
+            zipf_s = round(rng.uniform(0.9, 1.2), 3)
+            value_lo, value_hi = 32, 128
+        elif wl_class == "write_heavy":
+            put_ratio = round(rng.uniform(0.85, 0.95), 3)
+            zipf_s = round(rng.uniform(0.8, 1.1), 3)
+            value_lo, value_hi = 64, 192
+        elif wl_class == "value_mix":
+            value_lo, value_hi, log_values = 16, 2048, True
+        elif wl_class == "multi_tenant":
+            put_ratio = round(rng.uniform(0.3, 0.5), 3)
+            tenant_span = rng.randint(6, 10)
+            shared_keys = rng.randint(3, 5)
+            shared_frac = round(rng.uniform(0.2, 0.4), 3)
+            num_keys = clients * tenant_span + shared_keys
+        elif wl_class == "hot_burst":
+            zipf_s = round(rng.uniform(1.1, 1.3), 3)
+            # steady → burst (~2x ingress capacity) → recover; the
+            # recover tail is where the soak measures throughput
+            # returning to the pre-burst steady state
+            t1 = int(horizon * rng.uniform(0.28, 0.34))
+            blen = int(horizon * rng.uniform(0.22, 0.28))
+            burst_x = round(rng.uniform(1.9, 2.2), 3)
+            phases = [
+                WorkloadPhase(0, t1, steady),
+                WorkloadPhase(t1, blen, burst_x),
+                WorkloadPhase(t1 + blen, horizon - t1 - blen, steady),
+            ]
+        return WorkloadPlan(
+            seed, wl_class, clients, num_keys, put_ratio, zipf_s,
+            value_lo, value_hi, log_values, tenant_span, shared_keys,
+            shared_frac, tuple(phases),
+        )
+
+    # ------------------------------------------------------- determinism
+    def timeline(self) -> str:
+        """Canonical rendering; byte-identical for identical plans (the
+        repro contract — soak failures print this plus the seed)."""
+        head = (
+            f"# WorkloadPlan v1 seed={self.seed} class={self.wl_class}"
+            f" clients={self.clients}\n"
+            f"keys={self.num_keys} put={self.put_ratio:g}"
+            f" zipf={self.zipf_s:g}"
+            f" value=[{self.value_lo},{self.value_hi}"
+            f"{',log' if self.log_values else ''}]"
+            f" tenant_span={self.tenant_span}"
+            f" shared={self.shared_keys}@{self.shared_frac:g}\n"
+        )
+        return head + "".join(p.render() + "\n" for p in self.phases)
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.timeline().encode()).hexdigest()[:16]
+
+    # ---------------------------------------------------------- streams
+    def rate_x_at(self, tick: float) -> float:
+        """Offered-rate multiplier at a workload tick (0 past the
+        horizon — issuing stops, inflight ops drain)."""
+        for p in self.phases:
+            if p.tick <= tick < p.tick + p.ticks:
+                return p.rate_x
+        return 0.0
+
+    def horizon(self) -> int:
+        return max(p.tick + p.ticks for p in self.phases)
+
+    def opstream(self, ci: int) -> "OpStream":
+        """The per-client op stream: a pure function of (plan, ci)."""
+        return OpStream(self, ci)
+
+
+class OpStream:
+    """Seeded per-client op generator: ``next()`` yields
+    ``(kind, key, value_size)`` tuples drawn from this client's own
+    ``random.Random`` — replaying a client from the same (plan, ci)
+    yields the identical op sequence.
+
+    Key popularity: zipfian over a per-plan shuffled key order (the hot
+    key identity varies per seed but is SHARED across clients, so skew
+    creates real cross-client contention).  Multi-tenant plans route
+    ``shared_frac`` of ops to the shared hot range and the rest to this
+    client's private range (disjoint from every other client's)."""
+
+    def __init__(self, plan: WorkloadPlan, ci: int):
+        import random
+
+        self.plan = plan
+        self.ci = int(ci)
+        self._rng = random.Random(
+            plan.seed * 7919 + self.ci * 104729 + 13
+        )
+        if plan.tenant_span > 0:
+            self._shared = [
+                f"t_shared{i}" for i in range(plan.shared_keys)
+            ]
+            self._private = [
+                f"t{self.ci}_k{j}" for j in range(plan.tenant_span)
+            ]
+            self.keys = self._shared + self._private
+            self._cdf: List[float] = []
+        else:
+            # per-plan (client-shared) hot-key identity: one shuffle
+            # seeded off the plan alone
+            order = list(range(plan.num_keys))
+            random.Random((plan.seed << 8) | 0xA5).shuffle(order)
+            self.keys = [f"w{i}" for i in order]
+            self._shared, self._private = [], []
+            s = plan.zipf_s
+            if s > 0:
+                w = [1.0 / ((i + 1) ** s) for i in range(plan.num_keys)]
+                tot = sum(w)
+                acc, cdf = 0.0, []
+                for x in w:
+                    acc += x / tot
+                    cdf.append(acc)
+                self._cdf = cdf
+            else:
+                self._cdf = []
+
+    def _pick_key(self) -> str:
+        p = self.plan
+        if p.tenant_span > 0:
+            if self._shared and self._rng.random() < p.shared_frac:
+                return self._rng.choice(self._shared)
+            return self._rng.choice(self._private)
+        if self._cdf:
+            i = bisect.bisect_left(self._cdf, self._rng.random())
+            return self.keys[min(i, len(self.keys) - 1)]
+        return self._rng.choice(self.keys)
+
+    def _pick_size(self) -> int:
+        p = self.plan
+        if p.value_hi <= p.value_lo:
+            return p.value_lo
+        if p.log_values:
+            # log-uniform: small values dominate, the tail reaches
+            # value_hi (frame-encoder stress without every op paying it)
+            import math
+
+            lo, hi = math.log(p.value_lo), math.log(p.value_hi)
+            return int(round(math.exp(self._rng.uniform(lo, hi))))
+        return self._rng.randint(p.value_lo, p.value_hi)
+
+    def next(self) -> Tuple[str, str, int]:
+        """One op: ``("put"|"get", key, value_size)`` (size is 0 for
+        gets)."""
+        key = self._pick_key()
+        if self._rng.random() < self.plan.put_ratio:
+            return "put", key, self._pick_size()
+        return "get", key, 0

@@ -13,6 +13,12 @@ Model: each key is an independent register (linearizability is
 compositional, Herlihy & Wing §3), puts carry globally unique values, and
 un-acknowledged operations (timeouts) may or may not have taken effect —
 the checker may place them at any point after invocation or drop them.
+SHED operations (``shed=True``) are *negatively acknowledged*: the
+server's load-shed reply guarantees the request was refused before ever
+entering the ingress queue, so unlike an unacked op the checker must
+NEVER place a shed put — it is dropped outright, which makes any get
+observing its (globally unique) value a linearizability violation ("an
+ack lost to a shed" / a shed that secretly executed).
 
 Algorithm: Wing & Gong tree search with memoization on
 (remaining-operation set, register value), per key.  Histories from the
@@ -39,12 +45,24 @@ class Op:
     t_inv: float
     t_resp: float = INF        # INF = never acknowledged (may have run)
     acked: bool = True         # False: op may be dropped by the checker
+    shed: bool = False         # True: negatively acked (load shed) —
+    #                            guaranteed never executed; the checker
+    #                            drops it and may NOT place it
 
 
 def record_put(client: int, key: str, value: str, t_inv: float,
                t_resp: Optional[float], acked: bool) -> Op:
     return Op(client, "put", key, value, t_inv,
               INF if t_resp is None else t_resp, acked)
+
+
+def record_shed_put(client: int, key: str, value: str, t_inv: float,
+                    t_resp: float) -> Op:
+    """A put refused by ingress backpressure (``ApiReply(kind="shed")``):
+    recorded so overload histories carry the negative acks, excluded by
+    the checker on the server's never-proposed guarantee."""
+    return Op(client, "put", key, value, t_inv, t_resp,
+              acked=False, shed=True)
 
 
 def record_get(client: int, key: str, value: Optional[str], t_inv: float,
@@ -86,6 +104,13 @@ def _prune_unobserved_unacked(kops: List[Op]) -> List[Op]:
 
 
 def _check_key(kops: List[Op]) -> bool:
+    # shed ops are dropped BEFORE unacked pruning, and unconditionally:
+    # an unacked put whose value was read stays placeable, but a SHED
+    # put must never be placed even when observed — the shed reply
+    # guarantees it did not execute, so an observation of its unique
+    # value must FAIL the search (no remaining put can write it), not
+    # be legalized by placement
+    kops = [o for o in kops if not o.shed]
     kops = _prune_unobserved_unacked(kops)
     n = len(kops)
     if n == 0:
@@ -131,6 +156,6 @@ def _diagnose(key: str, kops: List[Op]) -> str:
         end = "∞" if o.t_resp == INF else f"{o.t_resp:.4f}"
         lines.append(
             f"  c{o.client} {o.kind}({o.value}) [{o.t_inv:.4f}, {end}]"
-            + ("" if o.acked else " (unacked)")
+            + (" (shed)" if o.shed else "" if o.acked else " (unacked)")
         )
     return "\n".join(lines)

@@ -228,6 +228,24 @@ class FaultPlan:
         )
 """
 
+_WORKLOAD_SEEDED_SCOPE = """
+import time, random
+
+class WorkloadPlan:
+    def generate(self):
+        t0 = time.monotonic()      # any clock read: schedules must be
+        rng = random.Random()      # a pure function of the seed
+        return t0, rng.random()
+
+class OpStream:
+    def next(self):
+        return random.random()     # global (unseeded) RNG draw
+
+
+def runner_pacing():
+    return time.time()             # module-level: outside the scope
+"""
+
 _MONO_SCOPE = """
 import time
 
@@ -314,6 +332,43 @@ def test_hostlint_seeded_scope(tmp_path):
         "FaultPlan.generate:random.Random",
         "FaultPlan.generate:time.time",
     ]
+
+
+def test_hostlint_workload_plan_joins_seeded_scope(tmp_path):
+    """The workload plane's plan/stream classes are in the H103 seeded
+    scope: clock reads (monotonic included — schedules are a pure
+    function of the seed) and unseeded/global RNG draws fire, while the
+    module-level wall pacing helper stays exempt."""
+    findings, _ = _scan(
+        tmp_path, _WORKLOAD_SEEDED_SCOPE, "host/workload.py"
+    )
+    assert sorted(f.scope for f in findings) == [
+        "OpStream.next:random.random",
+        "WorkloadPlan.generate:random.Random",
+        "WorkloadPlan.generate:time.monotonic",
+    ]
+    assert all(f.code == "H103" for f in findings)
+
+
+def test_hostlint_workload_scope_is_module_keyed(tmp_path):
+    """The same source OUTSIDE host/workload.py keeps today's behavior
+    (no seeded-scope rule applies) — the scope is the module, not the
+    class names."""
+    findings, _ = _scan(
+        tmp_path, _WORKLOAD_SEEDED_SCOPE, "host/other.py"
+    )
+    assert findings == []
+
+
+def test_hostlint_real_workload_module_is_clean():
+    """The live host/workload.py passes its own seeded scope."""
+    import summerset_tpu
+
+    pkg = os.path.dirname(summerset_tpu.__file__)
+    findings, suppressed = hostlint.scan_file(
+        os.path.join(pkg, "host", "workload.py"), "host/workload.py"
+    )
+    assert findings == [] and suppressed == []
 
 
 def test_hostlint_monotonic_scope_allows_monotonic_flags_wallclock(

@@ -9,6 +9,7 @@ from summerset_tpu.utils.linearize import (
     check_history,
     record_get,
     record_put,
+    record_shed_put,
 )
 
 
@@ -96,6 +97,45 @@ class TestCheckerAccepts:
         ok, _ = check_history(ops_bad)
         assert not ok
 
+    def test_shed_mix_with_unacked_and_acked(self):
+        """Workload-soak regression: a history mixing sheds, unacked
+        puts, and acks.  Shed puts are negatively acked — the server
+        guaranteed they never entered the queue — so the checker must
+        EXCLUDE them like the unacked prune does, without losing the
+        unacked puts' may-have-run semantics."""
+        ops = [
+            record_put(0, "k", "a", 0.0, 1.0, True),
+            record_shed_put(1, "k", "s0", 1.5, 1.6),     # overload
+            record_put(2, "k", "u0", 1.5, None, False),  # timed out
+            record_shed_put(1, "k", "s1", 2.0, 2.1),
+            record_put(0, "k", "b", 3.0, 4.0, True),
+            record_get(3, "k", "b", 5.0, 6.0),
+            # the unacked put's effect is still allowed to surface
+            record_get(3, "k", "u0", 7.0, 8.0),
+        ]
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+    def test_many_sheds_check_fast(self):
+        """An overload burst sheds dozens of puts per key; excluded
+        outright, they must cost the search nothing (placed like
+        unacked ops they would double the space each)."""
+        import time as _time
+
+        ops = [record_put(0, "k", "base", 0.0, 0.5, True)]
+        for i in range(60):
+            ops.append(record_shed_put(
+                1 + (i % 3), "k", f"shed-{i}", 1.0, 1.1
+            ))
+        for i in range(10):
+            t = 10.0 + i
+            ops.append(record_put(0, "k", f"w{i}", t, t + 0.2, True))
+            ops.append(record_get(4, "k", f"w{i}", t + 0.3, t + 0.4))
+        t0 = _time.monotonic()
+        ok, diag = check_history(ops)
+        assert ok, diag
+        assert _time.monotonic() - t0 < 5.0
+
     def test_keys_are_independent(self):
         ops = [
             record_put(0, "x", "1", 0.0, 1.0, True),
@@ -141,6 +181,28 @@ class TestCheckerCatches:
         ]
         ok, _ = check_history(ops)
         assert not ok
+
+    def test_observed_shed_value_caught(self):
+        """A get observing a SHED put's value is a violation: the shed
+        reply guaranteed the put never executed, so the checker must
+        not legalize the observation by placing it (an unacked put in
+        the same position WOULD be placeable — that asymmetry is the
+        whole point of the negative ack)."""
+        ops = [
+            record_put(0, "k", "a", 0.0, 1.0, True),
+            record_shed_put(1, "k", "s0", 2.0, 2.1),
+            record_get(2, "k", "s0", 3.0, 4.0),
+        ]
+        ok, _ = check_history(ops)
+        assert not ok
+        # the identical history with an UNACKED put instead passes
+        ops_unacked = [
+            record_put(0, "k", "a", 0.0, 1.0, True),
+            record_put(1, "k", "s0", 2.0, None, False),
+            record_get(2, "k", "s0", 3.0, 4.0),
+        ]
+        ok, diag = check_history(ops_unacked)
+        assert ok, diag
 
     def test_fresh_read_before_any_write_is_none_only(self):
         ops = [record_get(0, "k", None, 0.0, 1.0)]

@@ -1,0 +1,264 @@
+"""Workload plane unit tests: WorkloadPlan seeded determinism, class
+character (skew / mixes / tenancy / burst phases), ingress backpressure
+(bounded queue + shed replies + telemetry/flight visibility), and the
+drivers' shed handling."""
+
+import socket
+import time
+
+import pytest
+
+from summerset_tpu.client.drivers import (
+    Backoff, DriverClosedLoop, DriverOpenLoopPaced,
+)
+from summerset_tpu.client.endpoint import ClientApiStub
+from summerset_tpu.host.external import ExternalApi
+from summerset_tpu.host.messages import ApiReply, ApiRequest
+from summerset_tpu.host.statemach import Command
+from summerset_tpu.host.telemetry import DECLARED, MetricsRegistry
+from summerset_tpu.host.tracing import EVENT_TYPES, FlightRecorder
+from summerset_tpu.host.workload import WORKLOAD_CLASSES, WorkloadPlan
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------- plans --
+@pytest.mark.parametrize("wl_class", WORKLOAD_CLASSES)
+def test_plan_seed_determinism(wl_class):
+    """Same seed -> byte-identical timeline AND identical op streams;
+    different seeds differ (the FaultPlan repro contract, workload
+    side)."""
+    a = WorkloadPlan.generate(7, wl_class)
+    b = WorkloadPlan.generate(7, wl_class)
+    assert a.timeline() == b.timeline()
+    assert a.digest() == b.digest()
+    sa, sb = a.opstream(1), b.opstream(1)
+    assert [sa.next() for _ in range(300)] == [
+        sb.next() for _ in range(300)
+    ]
+    assert a.digest() != WorkloadPlan.generate(8, wl_class).digest()
+
+
+def test_plan_classes_are_salted():
+    """Seed 1 of two classes must not share a random stream."""
+    assert (
+        WorkloadPlan.generate(1, "read_mostly").digest()
+        != WorkloadPlan.generate(1, "write_heavy").digest()
+    )
+
+
+def test_zipf_skew_and_mixes():
+    n = 4000
+    hot = WorkloadPlan.generate(3, "hot_burst").opstream(0)
+    uni = WorkloadPlan.generate(3, "uniform").opstream(0)
+
+    def top_frac(stream):
+        from collections import Counter
+
+        c = Counter(stream.next()[1] for _ in range(n))
+        return c.most_common(1)[0][1] / n
+
+    assert top_frac(hot) > 3 * top_frac(uni)
+    rm = WorkloadPlan.generate(3, "read_mostly").opstream(0)
+    puts = sum(1 for _ in range(n) if rm.next()[0] == "put")
+    assert puts / n < 0.15
+    wh = WorkloadPlan.generate(3, "write_heavy").opstream(0)
+    puts = sum(1 for _ in range(n) if wh.next()[0] == "put")
+    assert puts / n > 0.7
+
+
+def test_value_sizes_within_bounds():
+    p = WorkloadPlan.generate(5, "value_mix")
+    st = p.opstream(0)
+    sizes = [s for k, _, s in (st.next() for _ in range(3000))
+             if k == "put"]
+    assert sizes and min(sizes) >= p.value_lo - 1
+    assert max(sizes) <= p.value_hi + 1
+    # log-uniform: the tail must actually reach past the midpoint
+    assert max(sizes) > (p.value_lo + p.value_hi) // 2
+
+
+def test_multi_tenant_ranges_disjoint_with_shared_overlap():
+    p = WorkloadPlan.generate(2, "multi_tenant")
+    streams = [p.opstream(ci) for ci in range(p.clients)]
+    privs = []
+    for st in streams:
+        keys = {st.next()[1] for _ in range(1500)}
+        assert any(k.startswith("t_shared") for k in keys)
+        privs.append({k for k in keys if not k.startswith("t_shared")})
+    for i in range(len(privs)):
+        for j in range(i + 1, len(privs)):
+            assert not (privs[i] & privs[j])
+
+
+def test_hot_burst_phases_shape():
+    p = WorkloadPlan.generate(11, "hot_burst")
+    assert len(p.phases) == 3
+    steady, burst, recover = p.phases
+    assert burst.rate_x >= 1.9           # ~2x ingress capacity
+    assert steady.rate_x == recover.rate_x < 1.0
+    assert p.rate_x_at(burst.tick) == burst.rate_x
+    assert p.rate_x_at(p.horizon()) == 0.0  # issuing stops past horizon
+    assert p.horizon() == 120
+
+
+def test_unknown_class_refused():
+    with pytest.raises(ValueError):
+        WorkloadPlan.generate(1, "nope")
+
+
+# ------------------------------------------------- ingress backpressure --
+def test_bounded_queue_sheds_with_hint_and_telemetry():
+    """Requests beyond max_pending draw shed replies (retry_after_ms
+    hint), never enter the queue, and are visible in the api_shed
+    counter, the api_queue_depth gauge, and typed flight events."""
+    reg = MetricsRegistry()
+    fl = FlightRecorder()
+    api = ExternalApi(("127.0.0.1", _free_port()), max_pending=4,
+                      registry=reg, flight=fl)
+    try:
+        stub = ClientApiStub(7, api.api_addr)
+        for i in range(10):
+            stub.send_req(ApiRequest(
+                "req", req_id=i, cmd=Command("put", "k", "v")
+            ))
+        sheds = []
+        try:
+            while True:
+                sheds.append(stub.recv_reply(timeout=1.0))
+        except Exception:
+            pass
+        assert len(sheds) == 6
+        assert all(
+            r.kind == "shed" and not r.success
+            and r.retry_after_ms >= 1 for r in sheds
+        )
+        # the queue holds exactly the bound, nothing more
+        batch = api.get_req_batch(timeout=2.0)
+        assert len(batch) == 4
+        assert reg.counter_value("api_shed") == 6
+        assert "api_queue_depth" in reg.snapshot()["gauges"]
+        evs = [e for e in fl.dump()["events"]
+               if e["type"] == "api_shed"]
+        assert len(evs) == 6
+        assert evs[0]["retry_ms"] >= 1 and evs[0]["client"] == 7
+        stub.close()
+    finally:
+        api.stop()
+
+
+def test_shed_metrics_pre_registered():
+    """A zero api_shed series must exist BEFORE any overload (so "never
+    overloaded" is distinguishable from "not measured"), and both lanes
+    are in the telemetry smoke gate's declared set."""
+    assert "api_shed" in DECLARED and "api_queue_depth" in DECLARED
+    assert "api_shed" in EVENT_TYPES
+    reg = MetricsRegistry()
+    api = ExternalApi(("127.0.0.1", _free_port()), registry=reg)
+    try:
+        snap = reg.snapshot()
+        assert snap["counters"].get("api_shed") == 0
+        assert snap["gauges"].get("api_queue_depth") == 0
+    finally:
+        api.stop()
+
+
+def test_conf_requests_bypass_the_bound():
+    """Control-plane requests must not starve under data overload."""
+    api = ExternalApi(("127.0.0.1", _free_port()), max_pending=1)
+    try:
+        stub = ClientApiStub(3, api.api_addr)
+        stub.send_req(ApiRequest(
+            "req", req_id=0, cmd=Command("put", "k", "v")
+        ))
+        stub.send_req(ApiRequest("conf", req_id=1,
+                                 conf_delta={"responders": [0]}))
+        deadline = time.monotonic() + 3.0
+        got = []
+        while len(got) < 2 and time.monotonic() < deadline:
+            got.extend(api.get_req_batch(timeout=0.5))
+        kinds = sorted(req.kind for _c, req in got)
+        assert kinds == ["conf", "req"]
+        stub.close()
+    finally:
+        api.stop()
+
+
+# ----------------------------------------------------- driver shed path --
+class _FakeEndpoint:
+    """Minimal endpoint double: scripted replies, no sockets."""
+
+    def __init__(self, replies):
+        self.replies = list(replies)
+        self.sent = []
+        self.current = 0
+        self.id = 0
+
+    def send_req(self, rid, cmd):
+        self.sent.append((rid, cmd))
+
+    def recv_reply(self, timeout=None):
+        if not self.replies:
+            raise socket.timeout()
+        return self.replies.pop(0)
+
+    def note_leader(self, sid):
+        pass
+
+    def reconnect(self, sid=None, timeout=None):
+        pass
+
+    def rotate(self, avoid=None, deadline=None):
+        pass
+
+
+def test_closed_loop_driver_returns_shed_with_hint():
+    ep = _FakeEndpoint([ApiReply("shed", req_id=0, success=False,
+                                 retry_after_ms=120)])
+    drv = DriverClosedLoop(ep, timeout=1.0)
+    rep = drv.put("k", "v")
+    assert rep.kind == "shed"
+    assert abs(rep.retry_after - 0.12) < 1e-9
+
+
+def test_backoff_sleep_hint_is_jittered_and_capped():
+    b = Backoff(cap=0.05, seed=3)
+    t0 = time.monotonic()
+    d = b.sleep_hint(10.0)  # absurd hint: the cap must bound it
+    assert d <= 0.05 and time.monotonic() - t0 < 1.0
+    # jitter is seeded: same seed, same delays
+    assert Backoff(cap=1.0, seed=5).sleep_hint(0.001) == \
+        Backoff(cap=1.0, seed=5).sleep_hint(0.001)
+
+
+def test_open_loop_paced_shed_gates_issuing():
+    ep = _FakeEndpoint([ApiReply("shed", req_id=0, success=False,
+                                 retry_after_ms=200)])
+    drv = DriverOpenLoopPaced(ep, timeout=1.0, seed=4)
+    assert drv.issue("put", "k", "v") == 0
+    out = drv.poll(0.2)
+    assert len(out) == 1
+    info, rep = out[0]
+    assert rep.kind == "shed" and info["key"] == "k"
+    assert drv.gated(time.monotonic())
+    assert drv.counts["shed"] == 1
+    assert not drv.inflight  # the shed op left the window
+
+
+def test_open_loop_paced_window_bound_and_expiry():
+    ep = _FakeEndpoint([])
+    drv = DriverOpenLoopPaced(ep, timeout=0.01, seed=1, max_inflight=2)
+    assert drv.issue("put", "a", "1") is not None
+    assert drv.issue("put", "b", "2") is not None
+    assert drv.issue("put", "c", "3") is None  # window full: dropped
+    assert drv.counts["window"] == 1
+    time.sleep(0.02)
+    dead = drv.expired()
+    assert {d["key"] for d in dead} == {"a", "b"}
+    assert drv.counts["expired"] == 2 and not drv.inflight
