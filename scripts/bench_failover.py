@@ -7,11 +7,16 @@ per-bin completion-rate timeline plus the measured gap until throughput
 recovers to half its pre-kill average.
 
 Writes FAILOVER.json at the repo root:
-  {"protocol", "kill_at_s", "bins_ms", "timeline": [ops per bin, ...],
+  {"protocol", "workload", "workload_seed", "workload_digest",
+   "kill_at_s", "bins_ms", "timeline": [ops per bin, ...],
    "pre_kill_tput", "recovery_ms"}
 
 Usage: python scripts/bench_failover.py [--protocol MultiPaxos]
        [--secs 12] [--kill-at 6] [--clients 4] [--bin-ms 100]
+       [--workload <class>] [--workload-seed N]
+(--workload runs the fleet under a seeded WorkloadPlan traffic class —
+the ROADMAP "FAILOVER fleet per workload class" follow-on; the stamp
+makes any timeline regenerable from class+seed)
 """
 
 import argparse
@@ -45,6 +50,13 @@ def main():
     ap.add_argument("--bin-ms", type=int, default=100)
     ap.add_argument("--tick", type=float, default=0.002)
     ap.add_argument("--config", default="")
+    ap.add_argument("--workload", default="uniform",
+                    help="workload class (host/workload.py "
+                         "WORKLOAD_CLASSES); uniform = the legacy "
+                         "alternating put/get mix, so default "
+                         "trajectories stay comparable")
+    ap.add_argument("--workload-seed", type=int, default=1)
+    ap.add_argument("--num-keys", type=int, default=64)
     ap.add_argument("--out", default=os.path.join(REPO, "FAILOVER.json"))
     args = ap.parse_args()
 
@@ -52,6 +64,17 @@ def main():
     from summerset_tpu.client.drivers import DriverClosedLoop
     from summerset_tpu.client.endpoint import GenericEndpoint
     from summerset_tpu.host.messages import CtrlRequest
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    # seeded-deterministic traffic class for the failover window — the
+    # op/key/size sequence is a pure function of (plan, client index),
+    # stamped into the artifact so any timeline is regenerable
+    plan = None
+    if args.workload != "uniform":
+        plan = WorkloadPlan.generate(
+            args.workload_seed, args.workload, clients=args.clients,
+            num_keys=args.num_keys,
+        )
 
     config = {}
     for kv in filter(None, args.config.split(",")):
@@ -81,10 +104,18 @@ def main():
         ep = GenericEndpoint(cluster.manager_addr)
         ep.connect()
         drv = DriverClosedLoop(ep, timeout=2.0)
+        ops = plan.opstream(i) if plan is not None else None
         n = 0
         while not stop.is_set():
-            key = f"fo{(n + i) % 32}"
-            r = drv.put(key, f"v{i}-{n}") if n % 2 else drv.get(key)
+            if ops is not None:
+                kind, key, size = ops.next()
+                do_put = kind == "put"
+                val = f"v{i}-{n}".ljust(size, "x")[:max(size, 1)]
+            else:
+                key = f"fo{(n + i) % 32}"
+                do_put = bool(n % 2)
+                val = f"v{i}-{n}"
+            r = drv.put(key, val) if do_put else drv.get(key)
             if r.kind == "success":
                 completions.append(time.monotonic())
             elif r.kind in ("timeout", "disconnect"):
@@ -157,6 +188,11 @@ def main():
         "replicas": args.replicas,
         "clients": args.clients,
         "secs": args.secs,
+        # workload stamp (like TPUTLAT/HOSTBENCH since PR 7): class +
+        # seed + digest regenerate the exact per-client op streams
+        "workload": args.workload,
+        "workload_seed": args.workload_seed,
+        "workload_digest": plan.digest() if plan is not None else None,
         "kill_at_s": round(t_kill - t_start, 3),
         "killed_leader": leader,
         "bins_ms": args.bin_ms,

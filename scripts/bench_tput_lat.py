@@ -146,8 +146,23 @@ def main():
         "workload_seed": args.workload_seed,
         "workload_digest": plan.digest() if plan is not None else None,
         "points": points,
+        # the artifact judges itself: a curve where nothing ever
+        # committed is a failed capture even when the process exits 0
+        "ok": any(p["tput"] > 0 for p in points),
         "server_metrics": server_metrics,
     }
+    # graftprof analytic stamp (host-serving config variant at this
+    # cluster's shape): deterministic-per-backend cost/memory/compile
+    # metrics so the TPUTLAT trajectory stays comparable when the box's
+    # wall-clock is noisy
+    try:
+        from summerset_tpu.host.profiling import protocol_analytic_block
+
+        out["graftprof"] = protocol_analytic_block(
+            args.protocol.lower(), "host", args.groups, args.replicas, 64
+        )
+    except Exception as e:  # the stamp must never kill the bench
+        out["graftprof"] = {"error": f"{type(e).__name__}: {e}"}
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"out": args.out, "points": len(points)}), flush=True)

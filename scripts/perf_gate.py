@@ -1,0 +1,270 @@
+#!/usr/bin/env python3
+"""graftprof gate (ci.sh tier 2h): hold the perf trajectory against the
+committed PROFILE.json baseline.
+
+Two regimes, matched to what each metric can promise:
+
+- **Analytic metrics are gated STRICTLY.**  ``cost_analysis`` flops /
+  bytes, ``memory_analysis`` buffer bytes, and HLO instruction counts
+  (total + per declared phase) are deterministic per backend: the gate
+  recompiles every protocol x variant cell at the committed shape and
+  fails on ANY difference.  A kernel edit that changes the tick's cost
+  profile must regenerate the baseline (``scripts/profile_run.py``) and
+  commit the diff — exactly the LINT.json drift contract.
+- **Wall-clock is gated with variance-aware tolerance + interleaved
+  re-measure escalation.**  A shared CI box cannot promise 5%
+  wall-clock stability, so the steady-tick time may drift up to
+  ``--wall-tol`` (fractional) before failing — and an over-tolerance
+  first measurement escalates into more re-measures (best-of wins, the
+  trace_smoke pattern) before the gate calls it a regression.  A
+  measurement FASTER than baseline never fails (it prints a
+  regenerate-suggestion instead).
+- The phase-scope instrumentation overhead is re-measured live
+  (ablation A/B, ``core.protocol.set_phase_scopes``) and must stay
+  under ``--max-overhead-pct`` — the same <5% budget the telemetry and
+  tracing planes carry.
+
+Exit 0 = baseline reproduced; 1 = drift, regression, or a baseline
+whose own ``ok`` fields record a bad capture (0 slots/s etc.).
+
+Usage: python scripts/perf_gate.py --check [--wall-tol 0.5]
+       [--max-rounds 3] [--max-overhead-pct 5.0] [--skip-wall]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from summerset_tpu.host import profiling  # noqa: E402
+
+#: the analytic cell fields compared strictly (deterministic per
+#: backend); everything wall-clock-ish is deliberately NOT here
+STRICT_FIELDS = ("phases", "analytic", "memory", "shape")
+
+
+def check_analytic_cell(committed: dict, errors: list) -> None:
+    """Strict drift check for one protocol x variant cell."""
+    name = committed["protocol"]
+    variant = committed["variant"]
+    shape = committed["shape"]
+    cur = profiling.profile_cell(
+        name, variant, G=shape["G"], R=shape["R"], W=shape["W"],
+        with_device_trace=False, with_wall=False,
+    )
+    where = f"{name}[{variant}]"
+    for field in STRICT_FIELDS:
+        if cur.get(field) != committed.get(field):
+            errors.append(
+                f"{where}: analytic drift in {field!r}:\n"
+                f"    committed: {json.dumps(committed.get(field), sort_keys=True)}\n"
+                f"    current:   {json.dumps(cur.get(field), sort_keys=True)}"
+            )
+
+
+def wall_measure(committed: dict, ticks: int, reps: int) -> float:
+    """One wall re-measure of a committed cell's steady tick."""
+    from summerset_tpu.core import Engine
+
+    shape = committed["shape"]
+    kernel = profiling._build_cell_kernel(
+        committed["protocol"], committed["variant"],
+        shape["G"], shape["R"], shape["W"],
+    )
+    eng = Engine(kernel)
+    state, ns = eng.init()
+    comp = eng.lower_synthetic(state, ns, ticks, shape["P"]).compile()
+    s_per_tick, _, _, _ = profiling.measure_steady_tick(
+        comp, state, ns, ticks, reps
+    )
+    return s_per_tick
+
+
+def check_wall_cell(committed: dict, tol: float, max_rounds: int,
+                    errors: list, notes: list) -> None:
+    """Variance-aware wall gate with re-measure escalation: the first
+    over-tolerance reading triggers more measurement rounds (best-of
+    all rounds is what gets compared), so one noisy window cannot fail
+    CI by itself."""
+    wall = committed.get("wall") or {}
+    base = wall.get("s_per_tick")
+    where = f"{committed['protocol']}[{committed['variant']}]"
+    if not base or base <= 0:
+        errors.append(f"{where}: committed wall.s_per_tick missing/zero")
+        return
+    ticks, reps = wall.get("ticks", 128), wall.get("reps", 3)
+    best = float("inf")
+    rounds = 0
+    while rounds < max_rounds:
+        rounds += 1
+        best = min(best, wall_measure(committed, ticks, reps))
+        if best <= base * (1.0 + tol):
+            break
+    ratio = best / base
+    if ratio > 1.0 + tol:
+        errors.append(
+            f"{where}: steady tick regressed {ratio:.2f}x vs committed "
+            f"({best*1e3:.3f} vs {base*1e3:.3f} ms/tick) after {rounds} "
+            f"escalation round(s); tolerance {tol:.0%}"
+        )
+    elif ratio < 1.0 / (1.0 + tol):
+        notes.append(
+            f"{where}: steady tick IMPROVED {1/ratio:.2f}x vs committed "
+            f"({best*1e3:.3f} ms/tick) — consider regenerating "
+            "PROFILE.json to bank the win"
+        )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--profile", default=os.path.join(REPO, "PROFILE.json"))
+    ap.add_argument("--check", action="store_true",
+                    help="(the only mode; present for CI-invocation "
+                         "symmetry with the other gates)")
+    ap.add_argument("--wall-tol", type=float, default=0.5,
+                    help="fractional steady-tick drift allowed before a "
+                         "wall regression fails (default 0.5 = +50%%)")
+    ap.add_argument("--max-rounds", type=int, default=3)
+    ap.add_argument("--max-overhead-pct", type=float, default=5.0)
+    ap.add_argument("--skip-wall", action="store_true")
+    ap.add_argument("--skip-overhead", action="store_true")
+    ap.add_argument("--wall-all-variants", action="store_true",
+                    help="re-measure wall for host cells too (default: "
+                         "device cells only; host cells stay "
+                         "analytic-gated to bound CI time)")
+    args = ap.parse_args()
+
+    try:
+        with open(args.profile) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf_gate: cannot read baseline {args.profile}: {e}")
+        return 1
+
+    # gate on the baseline's own backend: a cpu baseline (the committed
+    # CI default) pins the cpu platform so the tunnel can't hang us; a
+    # native capture (profile_run --backend native) is re-derived on
+    # whatever chip is visible, and the backend-match check below fails
+    # loudly when they disagree
+    if doc.get("backend") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    errors: list = []
+    notes: list = []
+
+    backend = jax.devices()[0].platform
+    if doc.get("backend") != backend:
+        errors.append(
+            f"baseline backend {doc.get('backend')!r} != current "
+            f"{backend!r}: analytic metrics are only comparable per "
+            "backend — regenerate on this backend"
+        )
+
+    cells = [
+        cell
+        for per in doc.get("protocols", {}).values()
+        for cell in per.values()
+    ]
+    if not cells:
+        errors.append("baseline has no protocol cells")
+
+    # the baseline must record a GOOD capture: a committed artifact with
+    # ok=false / 0 slots/s is itself a gate failure (the BENCH_r05
+    # lesson — a dead capture must not pass silently)
+    for cell in cells:
+        where = f"{cell['protocol']}[{cell['variant']}]"
+        if not cell.get("ok", False):
+            errors.append(f"{where}: committed cell has ok=false")
+        wall = cell.get("wall") or {}
+        if wall and wall.get("committed_slots_per_s", 0) <= 0:
+            errors.append(f"{where}: committed capture made no progress "
+                          "(0 committed slots/s)")
+        if doc.get("profiler_available") and \
+                cell.get("phase_wall_us_per_tick") is None:
+            errors.append(f"{where}: no per-phase device-time breakdown "
+                          "although the profiler was available at "
+                          "capture time")
+
+    if not errors:
+        for cell in cells:
+            print(f"analytic: {cell['protocol']}[{cell['variant']}] ...",
+                  flush=True)
+            check_analytic_cell(cell, errors)
+
+        sweep = doc.get("g_sweep")
+        if sweep:
+            print("analytic: g-sweep ...", flush=True)
+            cur = profiling.g_sweep(
+                sweep["protocol"],
+                groups=tuple(p["G"] for p in sweep["points"]),
+            )
+            if cur["points"] != sweep["points"]:
+                errors.append(
+                    "g_sweep: analytic drift:\n"
+                    f"    committed: {json.dumps(sweep['points'])}\n"
+                    f"    current:   {json.dumps(cur['points'])}"
+                )
+
+    if not errors and not args.skip_wall:
+        for cell in cells:
+            if cell.get("variant") != "device" and \
+                    not args.wall_all_variants:
+                continue
+            if not cell.get("wall"):
+                continue
+            print(f"wall: {cell['protocol']}[{cell['variant']}] ...",
+                  flush=True)
+            check_wall_cell(cell, args.wall_tol, args.max_rounds,
+                            errors, notes)
+
+    if not errors and not args.skip_overhead:
+        committed_ov = doc.get("scope_overhead") or {}
+        if committed_ov.get("pct", 0.0) > args.max_overhead_pct:
+            errors.append(
+                f"committed scope_overhead {committed_ov.get('pct')}% > "
+                f"{args.max_overhead_pct}%"
+            )
+        else:
+            print("overhead: phase-scope ablation A/B ...", flush=True)
+            ov = profiling.measure_scope_overhead(
+                max_pct=args.max_overhead_pct,
+            )
+            print(f"  live overhead {ov['pct']}% "
+                  f"({ov['pairs']} interleaved pairs)")
+            if ov["pct"] > args.max_overhead_pct:
+                errors.append(
+                    f"phase-scope instrumentation overhead {ov['pct']}% "
+                    f"> {args.max_overhead_pct}% (after escalation)"
+                )
+
+    for n in notes:
+        print(f"note: {n}")
+    if errors:
+        print(f"perf_gate: FAIL ({len(errors)} problem(s))")
+        for e in errors:
+            print(f"  - {e}")
+        print("regenerate with: python scripts/profile_run.py "
+              "(and commit the PROFILE.json diff with the change "
+              "that caused it)")
+        return 1
+    print(f"perf_gate: PASS ({len(cells)} cells reproduced against "
+          f"{args.profile})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

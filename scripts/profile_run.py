@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""graftprof capture: write the committed PROFILE.json baseline.
+
+Captures, per protocol x config variant at the canonical shape plus an
+analytic G-sweep (``summerset_tpu/host/profiling.py``):
+
+- XLA analytic cost model: ``cost_analysis()`` flops / bytes accessed,
+  ``memory_analysis()`` argument/output/temp buffer bytes, compile wall
+  time, HLO instruction counts (total and per declared phase);
+- steady-state wall-clock (best-of-N, shape-matched warmup) and the
+  committed-slot rate over the best window;
+- MEASURED per-phase device time via ``jax.profiler`` programmatic
+  trace capture joined to the phase registry's named scopes;
+- the phase-scope instrumentation ablation A/B (< 5% budget).
+
+PERF.md rounds >= 9 are produced from this file's output
+(``--markdown`` prints the breakdown table to paste), not by hand; the
+committed PROFILE.json is gated by ``scripts/perf_gate.py`` in ci.sh
+tier 2h (analytic metrics strictly, wall-clock variance-aware).
+
+Usage:
+    python scripts/profile_run.py                 # write PROFILE.json
+    python scripts/profile_run.py --markdown      # + print PERF table
+    python scripts/profile_run.py --backend native  # real chip capture
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "PROFILE.json"))
+    ap.add_argument("--protocols", default="multipaxos,raft,rspaxos")
+    ap.add_argument("--groups", type=int, default=None)
+    ap.add_argument("--replicas", type=int, default=None)
+    ap.add_argument("--window", type=int, default=None)
+    ap.add_argument("--ticks", type=int, default=None)
+    ap.add_argument("--reps", type=int, default=None)
+    ap.add_argument("--backend", choices=("cpu", "native"), default="cpu",
+                    help="'cpu' (the CI/committed baseline backend) or "
+                         "'native' (whatever chip is visible — for TPU "
+                         "captures that are NOT committed as the gated "
+                         "baseline unless CI also runs on that backend)")
+    ap.add_argument("--no-overhead", action="store_true")
+    ap.add_argument("--no-sweep", action="store_true")
+    ap.add_argument("--markdown", action="store_true",
+                    help="print the generated PERF.md breakdown table")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from summerset_tpu.host import profiling
+
+    kw = {}
+    if args.groups is not None:
+        kw["G"] = args.groups
+    if args.replicas is not None:
+        kw["R"] = args.replicas
+    if args.window is not None:
+        kw["W"] = args.window
+    if args.ticks is not None:
+        kw["ticks"] = args.ticks
+    if args.reps is not None:
+        kw["reps"] = args.reps
+
+    doc = profiling.build_profile(
+        protocols=tuple(
+            p.strip() for p in args.protocols.split(",") if p.strip()
+        ),
+        with_overhead=not args.no_overhead,
+        with_sweep=not args.no_sweep,
+        log=lambda m: print(m, flush=True),
+        **kw,
+    )
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    ov = doc.get("scope_overhead")
+    if ov:
+        print(f"phase-scope overhead: {ov['pct']}% "
+              f"({ov['pairs']} interleaved pairs)")
+    if args.markdown:
+        print()
+        print(profiling.phase_table_markdown(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,12 @@ Run on the real chip (leave JAX_PLATFORMS unset):
     python scripts/profile_tick.py [--ticks N] [--deep]
 
 ``--deep`` adds the phase-stub ablations (empty step floor, no accept
-ingest, ...) used for the PERF.md breakdown.
+ingest, ...) used for the historical PERF.md breakdowns.  Since round 9
+the committed per-phase numbers come from the graftprof phase registry
+instead (``scripts/profile_run.py`` -> PROFILE.json: named-scope
+attribution of measured device time, no stub subclasses needed); this
+script remains the quick interactive ablation tool, sharing graftprof's
+steady-state timing discipline (``host/profiling.measure_steady_tick``).
 
 Note: variants that stub prepare-reply work override
 ``_gated_prepare_reply`` (not ``_ingest_prepare_reply``) — the production
@@ -14,13 +19,17 @@ state, so overriding the inner method would measure nothing.
 """
 
 import argparse
-import time
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
 from summerset_tpu.core import Engine
 from summerset_tpu.core.protocol import StepEffects
+from summerset_tpu.host.profiling import measure_steady_tick
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.multipaxos import (
     MultiPaxosKernel,
@@ -34,19 +43,14 @@ def time_engine(eng, ticks, proposals, telemetry=True, reps=2):
         # the ablation: without the metric-lane leaf the kernel compiles
         # its lane-free variant (presence is a static condition)
         state.pop("telem", None)
-    # compile the exact (ticks, proposals) variant AND run it once untimed:
-    # the first post-compile call carries one-time overhead on this backend
-    state, ns = eng.run_synthetic(state, ns, ticks, proposals)
-    jax.block_until_ready(state["commit_bar"])
-    state, ns = eng.run_synthetic(state, ns, ticks, proposals)
-    jax.block_until_ready(state["commit_bar"])
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        state, ns = eng.run_synthetic(state, ns, ticks, proposals)
-        jax.block_until_ready(state["commit_bar"])
-        best = min(best, time.perf_counter() - t0)
-    return best / ticks
+    # graftprof's shared timing discipline: AOT-compile the exact
+    # (ticks, proposals) variant, absorb the first-call overhead with
+    # untimed warm runs, then best-of-N (PERF.md round-2 lessons)
+    compiled = eng.lower_synthetic(state, ns, ticks, proposals).compile()
+    s_per_tick, _, _, _ = measure_steady_tick(
+        compiled, state, ns, ticks, reps
+    )
+    return s_per_tick
 
 
 def build(G=4096, R=5, W=64, P=16, kernel_cls=None, **kw):

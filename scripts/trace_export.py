@@ -14,7 +14,13 @@ process per replica and one track per plane:
                     stopwatches as child ``X`` spans; the ``step`` stage
                     is the device scan tick, so the device plane and the
                     host plane share one timeline) plus slot spans
-                    (propose → commit, async pairs keyed by (g, vid));
+                    (propose → commit, async pairs keyed by (g, vid)).
+                    With ``--phase-profile PROFILE.json`` (graftprof),
+                    every measured step span is further subdivided into
+                    named ``phase:*`` child spans — the kernel phase
+                    registry's steady-state attribution projected onto
+                    the live timeline, clock-aligned with host spans by
+                    construction;
 - **transport**   — frame instants plus Chrome flow arrows (``s``/``f``)
                     from each tx to its paired rx on the RECEIVING
                     replica's track: tx/rx pair by (src, dst, seq) where
@@ -56,6 +62,62 @@ _STAGE_ORDER = ("intake", "exchange", "step", "log", "apply")
 
 def _events(dump: dict) -> list:
     return dump.get("events", [])
+
+
+def phase_fractions(profile: dict, protocol: str) -> List[Tuple[str, float]]:
+    """Per-phase fractions of the device tick for one protocol, from a
+    graftprof PROFILE.json doc — declared phase order, normalized.
+
+    Prefers the host-variant cell's MEASURED per-phase device time
+    (``phase_wall_us_per_tick``, the live-cluster serving config);
+    falls back to the device cell, then to per-phase HLO op counts
+    when no profiler capture is available.  Empty when the protocol
+    has no cell — callers then skip the merge rather than guess."""
+    per = (profile.get("protocols") or {}).get(protocol) or {}
+    for variant in ("host", "device"):
+        cell = per.get(variant)
+        if not cell:
+            continue
+        order = cell.get("phases") or []
+        w = cell.get("phase_wall_us_per_tick") or {}
+        w = {k: v for k, v in w.items() if k in order and v > 0}
+        if w:
+            tot = sum(w.values())
+            return [(ph, w[ph] / tot) for ph in order if ph in w]
+        ops = (cell.get("analytic") or {}).get("hlo_ops_by_phase") or {}
+        ops = {k: v for k, v in ops.items() if k in order and v > 0}
+        if ops:
+            tot = sum(ops.values())
+            return [(ph, ops[ph] / tot) for ph in order if ph in ops]
+    return []
+
+
+def _phase_children(start: int, dur: int, fracs: List[Tuple[str, float]],
+                    me: int, tick) -> List[dict]:
+    """Child X spans subdividing one measured ``step`` stopwatch span
+    by the profile's per-phase fractions.  The parent span is the
+    MEASURED device-scan tick; the subdivision is the steady-state
+    attribution PROJECTED onto it (args carry the provenance), emitted
+    in declared phase order.  Each child runs between consecutive
+    ROUNDED boundaries of the cumulative fraction — rounding start and
+    duration independently would let adjacent siblings overlap by 1 us
+    on short step spans, and the viewer would nest one under the other.
+    Sub-microsecond phases round to their boundary and are dropped."""
+    out: List[dict] = []
+    pos = 0.0
+    t0 = start
+    for ph, frac in fracs:
+        pos += frac * dur
+        t1 = min(start + int(round(pos)), start + dur)
+        d = t1 - t0
+        if d > 0:
+            out.append({
+                "ph": "X", "name": f"phase:{ph}", "pid": me,
+                "tid": TID["device scan"], "ts": t0, "dur": d,
+                "args": {"tick": tick, "projected_from": "PROFILE.json"},
+            })
+        t0 = t1
+    return out
 
 
 # ------------------------------------------------------------- pairing --
@@ -235,10 +297,16 @@ def find_request_chains(dumps: Dict[Any, dict]) -> List[dict]:
 
 # -------------------------------------------------------------- export --
 def export_chrome(dumps: Dict[Any, dict], align: bool = True,
-                  pairs: Optional[List[dict]] = None) -> dict:
+                  pairs: Optional[List[dict]] = None,
+                  phase_profile: Optional[dict] = None) -> dict:
     """Merge per-server dumps into one Chrome trace-event document.
     ``pairs`` lets callers that already ran :func:`paired_frames` skip
-    re-walking every event (the pairing scan is the expensive part)."""
+    re-walking every event (the pairing scan is the expensive part).
+    ``phase_profile`` (a graftprof PROFILE.json doc) additionally
+    subdivides every measured device-scan tick span into named phase
+    child spans — the kernel phase registry's steady-state attribution
+    projected onto the live timeline, clock-aligned with the host spans
+    by construction (they nest inside the measured ``step`` stopwatch)."""
     if pairs is None:
         pairs = paired_frames(dumps)
     offsets = clock_offsets(dumps, pairs=pairs) if align else {}
@@ -257,6 +325,10 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
     for sid, dump in sorted(dumps.items(), key=lambda kv: str(kv[0])):
         me = int(dump.get("me", sid))
         off = offsets.get(me, 0)
+        fracs = (
+            phase_fractions(phase_profile, dump.get("protocol", ""))
+            if phase_profile else []
+        )
 
         def ts(t_us: int) -> int:
             return max(0, t_us + off - t0)
@@ -377,6 +449,10 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                         "ts": max(0, start), "dur": d,
                         "args": {"tick": ev.get("tick")},
                     })
+                    if st == "step" and fracs:
+                        evs.extend(_phase_children(
+                            max(0, start), d, fracs, me, ev.get("tick")
+                        ))
                     start += d
             elif k in ("frame_tx", "frame_rx"):
                 evs.append({
@@ -536,8 +612,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--no-align", action="store_true",
                     help="skip the NTP-style cross-server clock "
                          "alignment")
+    ap.add_argument("--phase-profile", default=None, metavar="PROFILE",
+                    help="graftprof PROFILE.json: subdivide each "
+                         "measured device-scan tick span into named "
+                         "phase child spans (the kernel phase "
+                         "registry's steady-state attribution projected "
+                         "onto the live timeline)")
     ap.add_argument("--out", default="trace.json")
     args = ap.parse_args(argv)
+
+    phase_profile = None
+    if args.phase_profile:
+        with open(args.phase_profile) as f:
+            phase_profile = json.load(f)
 
     if args.manager:
         import os
@@ -567,7 +654,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
 
     pairs = paired_frames(dumps)  # once; export reuses it
-    doc = export_chrome(dumps, align=not args.no_align, pairs=pairs)
+    doc = export_chrome(dumps, align=not args.no_align, pairs=pairs,
+                        phase_profile=phase_profile)
     errors = validate_chrome(doc)
     chains = find_request_chains(dumps)
     with open(args.out, "w") as f:

@@ -182,10 +182,30 @@ def main() -> None:
         ep.leave()
         assert len(dumps) == 3, f"flight scrape incomplete: {dumps.keys()}"
 
+        # graftprof join: subdivide every measured device-scan tick span
+        # into named phase child spans from the committed PROFILE.json
+        # (clock-aligned with the host spans by construction — they nest
+        # inside the measured step stopwatch)
+        phase_profile = None
+        profile_path = os.path.join(REPO, "PROFILE.json")
+        if os.path.exists(profile_path):
+            with open(profile_path) as f:
+                phase_profile = json.load(f)
+
         pairs = trace_export.paired_frames(dumps)  # once; export reuses
-        doc = trace_export.export_chrome(dumps, pairs=pairs)
+        doc = trace_export.export_chrome(dumps, pairs=pairs,
+                                         phase_profile=phase_profile)
         errors = trace_export.validate_chrome(doc)
         assert not errors, f"schema violations: {errors[:10]}"
+        phase_spans = [
+            e for e in doc["traceEvents"]
+            if str(e.get("name", "")).startswith("phase:")
+        ]
+        if phase_profile is not None:
+            assert phase_spans, (
+                "PROFILE.json present but no device phase spans landed "
+                "in the export"
+            )
         chains = trace_export.find_request_chains(dumps)
         assert chains, "no connected api→propose→commit→apply→reply chain"
         cross = {(p["src"], p["dst"]) for p in pairs}
@@ -217,6 +237,10 @@ def main() -> None:
                 ),
             },
             "paired_frames": len(pairs),
+            "device_phase_spans": len(phase_spans),
+            "phase_names": sorted({
+                str(e["name"])[len("phase:"):] for e in phase_spans
+            }),
             "cross_replica_edges": sorted(
                 f"{s}->{d}" for s, d in cross
             ),

@@ -70,6 +70,10 @@ SEEDED_SCOPES: Dict[str, Tuple[str, ...]] = {
 # spans, silently corrupting exported timelines.
 MONOTONIC_SCOPES: Dict[str, Tuple[str, ...]] = {
     "host/tracing.py": ("*",),
+    # graftprof timing: perf_counter (monotonic family) is the
+    # sanctioned stopwatch; a wallclock read in the profiler would make
+    # committed PROFILE.json numbers jump with NTP steps
+    "host/profiling.py": ("*",),
 }
 
 # wallclock spellings that fire inside BOTH scope kinds (the seeded

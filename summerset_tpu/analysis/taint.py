@@ -35,21 +35,24 @@ garbage in state).  Intentional flows are declared per kernel in
 explicit, and stale entries (declared but no longer occurring) are
 themselves ``T9`` findings so the allowlist can't rot.
 
-Known limitations (ROADMAP): (1) the gate rules are
-polarity-insensitive — a *flags-derived* predicate clears taint
-regardless of which branch the dead-link (``flags == 0``) case selects,
-so an inverted gate like ``jnp.where(valid, 0, inbox_lane)`` launders
-the lane.  Tracking gate polarity through comparisons / ``~`` / bit ops
-would close this.  (2) state and effects outputs are sinks, but outbox
-leaves are not: an ungated inbox->OUTBOX flow (a relay hop forwarding a
-lane verbatim) is not reported, and the receiver's own flags gate only
-attests its inbound link was alive — not that the relayed bytes were
-valid — so a partitioned link one hop upstream can launder garbage
-through a clean forwarder.  Treating outbox leaves as sinks (with their
-own allow entries for the deliberate relay lanes in the chain/push
-kernels) would close that hop.  Until both land, the pass is a
-high-signal lint over the idiomatic gating patterns, not a verified
-proof.
+Sinks are state leaves, ``effects.<leaf>`` outputs (the host serves
+effects to clients), AND ``outbox.<leaf>`` lanes: an ungated
+inbox->outbox flow is a relay hop putting possibly-dead-link bytes back
+on the wire, and the receiver's own flags gate only attests ITS inbound
+link was alive — not that the relayed payload was valid — so garbage
+from a partition one hop upstream would otherwise transit a clean
+forwarder invisibly.  (The chain_rep/simple_push relay lanes need no
+allow entries: both forward from their flags-gated window STATE —
+store-and-forward where the store is the gate — which this pass now
+verifies rather than assumes.)
+
+Known limitation (ROADMAP): the gate rules are polarity-insensitive — a
+*flags-derived* predicate clears taint regardless of which branch the
+dead-link (``flags == 0``) case selects, so an inverted gate like
+``jnp.where(valid, 0, inbox_lane)`` launders the lane.  Tracking gate
+polarity through comparisons / ``~`` / bit ops would close this; until
+then the pass is a high-signal lint over the idiomatic gating patterns,
+not a verified proof.
 """
 
 from __future__ import annotations
@@ -281,8 +284,16 @@ def analyze_kernel_flows(kernel) -> Set[Tuple[str, str]]:
             # state.  Prefixed so an effects sink can't collide with the
             # state leaf of the same name in scopes / TAINT_ALLOW.
             dst = f"effects.{leaf}"
-        else:  # outbox relay hops: see the limitation in the docstring
-            continue
+        else:
+            # outbox leaves are sinks too: an ungated inbox->outbox flow
+            # is a relay hop forwarding possibly-dead-link bytes, and
+            # the RECEIVER's flags gate only attests its own inbound
+            # link was alive — not that the relayed payload was valid —
+            # so garbage from a partition one hop upstream would transit
+            # a clean forwarder invisibly.  Deliberate relay lanes (the
+            # chain/push store-and-forward windows) carry TAINT_ALLOW
+            # entries naming the flow and why it is safe.
+            dst = f"outbox.{leaf}"
         for src in taint.sources:
             flows.add((src, dst))
     return flows

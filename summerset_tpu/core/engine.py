@@ -142,6 +142,34 @@ class Engine:
         """
         return self._synth_jit(state, netstate, num_ticks, proposals_per_tick)
 
+    # -- AOT lowering hooks (graftprof, host/profiling.py) -------------------
+    # The profiler needs the XLA artifacts themselves — ``lowered
+    # .compile()`` for cost_analysis / memory_analysis / compile wall
+    # time, the optimized-HLO text for per-phase op attribution, and the
+    # compiled executable as a warm timed callable that can never hit a
+    # recompile inside a measurement window.
+
+    def lower_tick(
+        self, state: Pytree, netstate: Pytree, inputs: Dict[str, Any]
+    ):
+        """``jax.stages.Lowered`` for ONE tick at these shapes — the
+        scan-length-free module the analytic perf gate compares."""
+        return self._tick_jit.lower(state, netstate, inputs)
+
+    def lower_synthetic(
+        self,
+        state: Pytree,
+        netstate: Pytree,
+        num_ticks: int,
+        proposals_per_tick: int,
+    ):
+        """``jax.stages.Lowered`` for the scanned synthetic-load run —
+        compile once, then call the compiled executable with
+        ``(state, netstate)`` for recompile-proof timed windows."""
+        return self._synth_jit.lower(
+            state, netstate, num_ticks, proposals_per_tick
+        )
+
 
 def reset_durable_rows(
     kernel: ProtocolKernel, state: Pytree, reset: Any,

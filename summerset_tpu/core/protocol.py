@@ -17,6 +17,7 @@ the same table for humans.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Dict, FrozenSet, Tuple
 
@@ -25,6 +26,41 @@ import jax
 from . import telemetry
 
 Pytree = Any
+
+# --------------------------------------------------------------- phases --
+#: name-stack prefix every phase scope carries.  Distinctive on purpose:
+#: the graftprof profiler (host/profiling.py) recovers per-phase HLO op
+#: counts and measured device time by matching this prefix in compiled
+#: HLO ``op_name`` metadata, so it must never collide with a jax- or
+#: user-minted scope name.
+PHASE_SCOPE_PREFIX = "graftphase__"
+
+#: global phase-scope switch (profiling ablation A/B).  ``named_scope``
+#: is trace-time metadata only — flipping this and re-tracing compiles
+#: the scope-free variant, which is exactly the ablation the <5%
+#: instrumentation-overhead gate (scripts/perf_gate.py) compares
+#: against.  Consulted at trace time, so a fresh Engine (new jit
+#: wrappers) picks the current setting up.
+_PHASE_SCOPES_ENABLED = True
+
+
+def set_phase_scopes(enabled: bool) -> None:
+    """Enable/disable ``jax.named_scope`` phase annotation globally
+    (the graftprof instrumentation ablation; default on)."""
+    global _PHASE_SCOPES_ENABLED
+    _PHASE_SCOPES_ENABLED = bool(enabled)
+
+
+def phase_scopes_enabled() -> bool:
+    return _PHASE_SCOPES_ENABLED
+
+
+def phase_scope(name: str):
+    """The named scope a declared phase runs under (or a no-op context
+    when phase scopes are ablated away)."""
+    if _PHASE_SCOPES_ENABLED:
+        return jax.named_scope(PHASE_SCOPE_PREFIX + name)
+    return contextlib.nullcontext()
 
 #: The kernel SPI contract, numbered and linter-enforced.  Every rule is
 #: stated against what the runtime actually relies on: the engine's
@@ -72,9 +108,10 @@ KERNEL_CONTRACT: Tuple[Tuple[str, str, str], ...] = (
      "accumulate/bump path in core/telemetry.py, contributed through "
      "the _telemetry hook"),
     ("T1", "flags-gating",
-     "every inbox read that lands in a state update or an effects "
-     "output passes a gate (select / mask-multiply) derived — directly "
-     "or transitively — from the netmodel-zeroed flags field; "
+     "every inbox read that lands in a state update, an effects "
+     "output, or an outbox lane (a relay hop back onto the wire) "
+     "passes a gate (select / mask-multiply) derived — directly or "
+     "transitively — from the netmodel-zeroed flags field; "
      "intentional exceptions are declared in TAINT_ALLOW with a reason"),
     ("T9", "suppression-hygiene",
      "every TAINT_ALLOW entry names a flow that still occurs — a stale "
@@ -122,6 +159,24 @@ class ProtocolKernel:
     # AST-cross-checks every input-name literal the kernel's class
     # bodies read against this table.
     EXTRA_INPUTS: Tuple[Tuple[str, str], ...] = ()
+    # -- phase registry (graftprof) -----------------------------------------
+    # The kernel's named step phases, in execution order, as
+    # (phase_name, method_name) pairs.  Each method has the uniform
+    # mutate-in-place signature ``meth(self, s, c)`` (``s`` = the state
+    # dict under construction, ``c`` = the step's scratch namespace) and
+    # is invoked by :meth:`_run_phases` under
+    # ``jax.named_scope(PHASE_SCOPE_PREFIX + phase_name)``.  The scopes
+    # ride the jaxpr name stack into compiled-HLO ``op_name`` metadata,
+    # which is what lets host/profiling.py attribute analytic op counts
+    # AND measured device time to phases — the PERF.md breakdown table
+    # is generated from these declarations, not hand-maintained.
+    # Subclasses inherit the family's table (overriding a phase METHOD
+    # keeps its attribution); kernels with extra top-level work extend
+    # the tuple.  ``scripts/perf_gate.py`` gates the declared-name set
+    # against the committed PROFILE.json, and tests/test_profiling.py
+    # asserts every registered kernel declares >= 1 phase whose scopes
+    # actually appear in the traced jaxpr.
+    PHASES: Tuple[Tuple[str, str], ...] = ()
     # declared-intentional ungated inbox->state flows for the
     # flags-taint pass, as (inbox_leaf, state_leaf, reason).  The pass
     # fails on any flow not listed here AND on stale entries that no
@@ -251,6 +306,27 @@ class ProtocolKernel:
             s[telemetry.TELEM_KEY] = telemetry.accumulate(
                 s[telemetry.TELEM_KEY], self._telemetry(old, s, c)
             )
+
+    # -- phase runner --------------------------------------------------------
+    def _run_phases(self, s: Pytree, c: Any) -> None:
+        """Run the declared :data:`PHASES` in order, each under its
+        ``phase_scope``.  Kernels' ``step`` bodies call this after
+        building the scratch namespace ``c`` (which must carry
+        ``c.old`` — the pre-step state — for the ``telemetry`` phase)."""
+        for name, meth in self.PHASES:
+            with phase_scope(name):
+                getattr(self, meth)(s, c)
+
+    def _phase_build_outbox(self, s: Pytree, c: Any) -> None:
+        """Registry wrapper: build the outbox as a named phase.  The
+        result lands on ``c.out`` so the phase keeps the uniform
+        ``(s, c)`` mutate-in-place signature."""
+        c.out = self._build_outbox(s, c)
+
+    def _phase_telemetry(self, s: Pytree, c: Any) -> None:
+        """Registry wrapper: the stacked telemetry accumulate as a named
+        phase (reads old-vs-new off ``c.old``)."""
+        self._accumulate_telemetry(c.old, s, c)
 
     # -- SPI -----------------------------------------------------------------
     def init_state(self, seed: int = 0) -> Pytree:

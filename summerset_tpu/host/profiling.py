@@ -1,0 +1,551 @@
+"""graftprof: machine-produced performance attribution for the device
+plane (CI tier 2h, committed baseline ``PROFILE.json``).
+
+Every optimization claim in PERF.md used to come from hand-run
+``scripts/profile_tick.py`` ablations pasted into prose, and the bench
+trajectory was effectively ungated (BENCH_r05 shipped rc=1 with
+0 slots/s and nothing noticed).  This module makes every hot-path
+number machine-produced and regression-gateable:
+
+- **Analytic cost model** — the per-tick XLA executable's
+  ``cost_analysis()`` (flops / bytes accessed / transcendentals),
+  ``memory_analysis()`` (argument / output / temp / generated-code
+  bytes), compile wall time, and HLO instruction counts.  Deterministic
+  per backend, so ``scripts/perf_gate.py`` gates them STRICTLY: a
+  kernel edit that doubles the tick's flops fails CI even on a noisy
+  box whose wall-clock could not resolve it.
+- **Per-phase attribution** — kernels declare their named step phases
+  in ``ProtocolKernel.PHASES`` (core/protocol.py); each phase runs
+  under ``jax.named_scope(PHASE_SCOPE_PREFIX + name)``, the scope rides
+  the jaxpr name stack into compiled-HLO ``op_name`` metadata, and this
+  module recovers (a) HLO op counts per phase by parsing the optimized
+  module text, and (b) MEASURED device time per phase by running the
+  steady-state scan under ``jax.profiler.trace`` and joining each trace
+  event's ``hlo_op`` back to its defining instruction's phase scope.
+  The PERF.md breakdown table is generated from this, not maintained
+  by hand.
+- **Steady-state wall-clock** — best-of-N ``run_synthetic`` windows
+  with shape-matched warmup (the two measurement bugs PERF.md round 2
+  documents: warmup must hit the same static shape, and the first
+  post-compile call carries one-time overhead).  Gated with a
+  variance-aware tolerance + interleaved re-measure escalation, never
+  strictly.
+- **Instrumentation ablation** — ``named_scope`` is trace-time
+  metadata, but the <5% overhead budget every observability plane in
+  this repo carries (telemetry, tracing) is still measured, not
+  assumed: interleaved scopes-on/scopes-off engine pairs via
+  ``core.protocol.set_phase_scopes``.
+
+All timing here uses the monotonic clock family
+(``time.perf_counter``); ``host/profiling.py`` is registered in
+graftlint's ``MONOTONIC_SCOPES``, so a wallclock read in this module is
+an H103 finding.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import Engine
+from ..core.protocol import (
+    PHASE_SCOPE_PREFIX,
+    phase_scopes_enabled,
+    set_phase_scopes,
+)
+
+PROFILE_VERSION = 1
+
+#: the canonical capture set: the three protocols the acceptance gate
+#: requires (MultiPaxos + Raft + the RS-coded MultiPaxos variant), each
+#: at both config variants (device defaults / host-serving knobs).
+CANONICAL_PROTOCOLS = ("multipaxos", "raft", "rspaxos")
+CANONICAL_VARIANTS = ("device", "host")
+
+#: canonical capture geometry — small enough that the full 3x2 cell
+#: matrix plus the G-sweep compiles and runs in CI minutes on CPU,
+#: large enough that G/R/W are mutually distinct and the window isn't
+#: degenerate.  The committed PROFILE.json records the shape it was
+#: captured at; perf_gate re-derives at the recorded shape.
+CANONICAL_SHAPE: Dict[str, int] = {"G": 64, "R": 3, "W": 16}
+CANONICAL_TICKS = 128
+CANONICAL_REPS = 3
+G_SWEEP = (16, 64, 256)
+
+_PHASE_RE = re.compile(PHASE_SCOPE_PREFIX + r"(\w+)")
+# one optimized-HLO instruction definition: "%name = ..." (ROOT or not),
+# with its op_name metadata somewhere on the same line
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_MODULE_RE = re.compile(r"HloModule\s+([^\s,]+)")
+
+
+def _build_cell_kernel(name: str, variant: str, G: int, R: int, W: int):
+    """One protocol x config-variant kernel at profile geometry —
+    the same variant-flipping rules the graftlint verifier uses
+    (``analysis/contract.build_kernel``), so 'host' means the same
+    thing in LINT.json and PROFILE.json."""
+    from ..analysis.contract import build_kernel
+    from ..protocols import make_protocol
+
+    return build_kernel(make_protocol, name, variant, G=G, R=R, W=W)
+
+
+def _synth_inputs(kernel, proposals: int) -> Dict[str, Any]:
+    """The per-tick input dict ``run_synthetic`` feeds the kernel —
+    reproduced here so the analytic tick lowering sees the same shapes
+    the measured scan does."""
+    G, R = kernel.G, kernel.R
+    return {
+        "n_proposals": jnp.full((G,), proposals, jnp.int32),
+        "value_base": jnp.zeros((G,), jnp.int32),
+        "exec_floor": jnp.full((G, R), 1 << 30, jnp.int32),
+    }
+
+
+def _norm_cost(compiled) -> Dict[str, float]:
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    out = {}
+    for key, label in (
+        ("flops", "flops"),
+        ("bytes accessed", "bytes_accessed"),
+        ("transcendentals", "transcendentals"),
+    ):
+        v = ca.get(key)
+        if v is not None:
+            out[label] = round(float(v), 1)
+    return out
+
+
+def _mem_stats(compiled) -> Optional[Dict[str, int]]:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    return {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+
+
+def hlo_phase_ops(hlo_text: str) -> Tuple[int, Dict[str, int]]:
+    """(total instruction count, per-phase instruction counts) from one
+    optimized-HLO module text.  An instruction belongs to the phase its
+    ``op_name`` metadata names via the ``PHASE_SCOPE_PREFIX`` scope;
+    instructions without a phase scope (scan plumbing, netmodel
+    delivery, parameter shuffling) are simply not attributed."""
+    total = 0
+    per_phase: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        total += 1
+        om = _OPNAME_RE.search(line)
+        if om is None:
+            continue
+        pm = _PHASE_RE.search(om.group(1))
+        if pm is not None:
+            per_phase[pm.group(1)] = per_phase.get(pm.group(1), 0) + 1
+    return total, dict(sorted(per_phase.items()))
+
+
+def hlo_op_phase_map(hlo_text: str) -> Tuple[Optional[str], Dict[str, str]]:
+    """(module name, {instruction name -> phase}) — the join table for
+    profiler trace events, whose ``args.hlo_op`` is the defining
+    instruction's name.  Fusions carry their root op's scope, so a
+    fusion straddling two phases attributes wholly to one of them; the
+    residue is reported as ``unattributed`` rather than guessed."""
+    mm = _MODULE_RE.search(hlo_text)
+    module = mm.group(1) if mm else None
+    opmap: Dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        om = _OPNAME_RE.search(line)
+        if om is None:
+            continue
+        pm = _PHASE_RE.search(om.group(1))
+        if pm is not None:
+            opmap[m.group(1)] = pm.group(1)
+    return module, opmap
+
+
+def attribute_trace_events(
+    events: List[dict], opmap: Dict[str, str], module: Optional[str] = None
+) -> Dict[str, float]:
+    """Sum complete-event (``ph == "X"``) durations per phase.  Events
+    whose ``hlo_op`` has no phase scope land in ``unattributed``; events
+    from other modules (when ``module`` is given) are skipped."""
+    acc: Dict[str, float] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        op = args.get("hlo_op")
+        if op is None:
+            continue
+        if module is not None and args.get("hlo_module") not in (
+            None, module
+        ):
+            continue
+        phase = opmap.get(op, "unattributed")
+        acc[phase] = acc.get(phase, 0.0) + float(ev.get("dur", 0.0))
+    return acc
+
+
+def capture_phase_walltime(
+    compiled_text: str, run_fn, ticks: int
+) -> Optional[Dict[str, float]]:
+    """Measured device time per phase, in us/tick: run ``run_fn`` under
+    ``jax.profiler.trace`` and attribute the captured per-op events via
+    the compiled module's op->phase table.  Returns ``None`` when the
+    backend's profiler is unavailable (the analytic metrics still
+    stand); callers record that rather than failing."""
+    module, opmap = hlo_op_phase_map(compiled_text)
+    tmp = tempfile.mkdtemp(prefix="graftprof_")
+    try:
+        try:
+            with jax.profiler.trace(tmp):
+                run_fn()
+        except Exception:
+            return None
+        files = glob.glob(
+            os.path.join(tmp, "**", "*.trace.json.gz"), recursive=True
+        )
+        if not files:
+            return None
+        with gzip.open(files[0], "rt") as f:
+            doc = json.load(f)
+        acc = attribute_trace_events(
+            doc.get("traceEvents", []), opmap, module
+        )
+        return {
+            k: round(v / ticks, 3) for k, v in sorted(acc.items())
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def measure_steady_tick(
+    compiled, state, ns, ticks: int, reps: int = CANONICAL_REPS
+):
+    """Best-of-N steady-state seconds/tick for one AOT-compiled
+    ``run_synthetic`` executable, plus the committed-slot rate over the
+    best window and the final (state, ns) for further capture.
+
+    Warmup discipline (PERF.md round 2): the executable is already
+    shape-matched by construction (it IS the timed callable), and two
+    untimed runs absorb the first-call transfer overhead and reach
+    steady state before the clock starts."""
+    import numpy as np
+
+    for _ in range(2):
+        state, ns = compiled(state, ns)
+        jax.block_until_ready(state["commit_bar"])
+    best = float("inf")
+    best_rate = 0.0
+    for _ in range(reps):
+        start = np.asarray(state["commit_bar"]).max(axis=1).sum()
+        t0 = time.perf_counter()
+        state, ns = compiled(state, ns)
+        jax.block_until_ready(state["commit_bar"])
+        dt = time.perf_counter() - t0
+        end = np.asarray(state["commit_bar"]).max(axis=1).sum()
+        if dt < best:
+            best = dt
+            best_rate = float(end - start) / dt
+    return best / ticks, best_rate, state, ns
+
+
+def profile_cell(
+    name: str,
+    variant: str = "device",
+    G: int = CANONICAL_SHAPE["G"],
+    R: int = CANONICAL_SHAPE["R"],
+    W: int = CANONICAL_SHAPE["W"],
+    ticks: int = CANONICAL_TICKS,
+    reps: int = CANONICAL_REPS,
+    with_device_trace: bool = True,
+    with_wall: bool = True,
+) -> Dict[str, Any]:
+    """One protocol x variant profile cell — the PROFILE.json unit."""
+    kernel = _build_cell_kernel(name, variant, G, R, W)
+    proposals = min(
+        4, getattr(kernel.config, "max_proposals_per_tick", 4)
+    )
+    eng = Engine(kernel)
+    state, ns = eng.init()
+
+    # analytic per-tick metrics from the TICK module (scan-length-free,
+    # so the strict gate compares like with like across shapes)
+    inputs = _synth_inputs(kernel, proposals)
+    t0 = time.perf_counter()
+    tick_comp = eng.lower_tick(state, ns, inputs).compile()
+    tick_compile_s = time.perf_counter() - t0
+    tick_text = tick_comp.as_text()
+    hlo_total, hlo_by_phase = hlo_phase_ops(tick_text)
+
+    cell: Dict[str, Any] = {
+        "protocol": name,
+        "variant": variant,
+        "shape": {"G": G, "R": R, "W": W, "P": proposals},
+        "phases": [ph for ph, _ in kernel.PHASES],
+        "analytic": dict(
+            _norm_cost(tick_comp),
+            hlo_instructions=hlo_total,
+            hlo_ops_by_phase=hlo_by_phase,
+        ),
+        "memory": _mem_stats(tick_comp),
+        "compile": {"tick_compile_s": round(tick_compile_s, 3)},
+        "ok": True,
+    }
+    if not with_wall:
+        return cell
+
+    # steady-state wall-clock on the scanned executable
+    t0 = time.perf_counter()
+    scan_low = eng.lower_synthetic(state, ns, ticks, proposals)
+    scan_comp = scan_low.compile()
+    cell["compile"]["scan_compile_s"] = round(
+        time.perf_counter() - t0, 3
+    )
+    s_per_tick, slots_per_s, state, ns = measure_steady_tick(
+        scan_comp, state, ns, ticks, reps
+    )
+    cell["wall"] = {
+        "s_per_tick": round(s_per_tick, 9),
+        "ticks": ticks,
+        "reps": reps,
+        "committed_slots_per_s": round(slots_per_s, 1),
+    }
+    cell["ok"] = slots_per_s > 0
+
+    if with_device_trace:
+        scan_text = scan_comp.as_text()
+
+        def run_once():
+            out = scan_comp(state, ns)
+            jax.block_until_ready(out[0]["commit_bar"])
+
+        cell["phase_wall_us_per_tick"] = capture_phase_walltime(
+            scan_text, run_once, ticks
+        )
+    return cell
+
+
+def measure_scope_overhead(
+    name: str = "multipaxos",
+    G: int = CANONICAL_SHAPE["G"],
+    R: int = CANONICAL_SHAPE["R"],
+    W: int = CANONICAL_SHAPE["W"],
+    ticks: int = CANONICAL_TICKS,
+    pairs: int = 2,
+    max_pairs: int = 4,
+    max_pct: float = 5.0,
+) -> Dict[str, Any]:
+    """Instrumentation-ablation A/B: steady tick cost with phase scopes
+    on vs compiled away (``set_phase_scopes``), as tightly interleaved
+    pairs with best-of-side comparison — the same discipline the
+    telemetry and tracing overhead gates use on this box.  Escalates
+    (more pairs) while the apparent overhead exceeds ``max_pct``, so a
+    single noisy window cannot fail CI by itself."""
+    prior = phase_scopes_enabled()
+    # the flag only matters at trace time: compile each side's scanned
+    # executable ONCE under its flag, then every escalation round just
+    # re-times the warm executables (no retrace/recompile per round)
+    sides: Dict[bool, tuple] = {}
+    try:
+        for enabled in (True, False):
+            set_phase_scopes(enabled)
+            kernel = _build_cell_kernel(name, "device", G, R, W)
+            proposals = min(
+                4, getattr(kernel.config, "max_proposals_per_tick", 4)
+            )
+            eng = Engine(kernel)
+            state, ns = eng.init()
+            comp = eng.lower_synthetic(
+                state, ns, ticks, proposals
+            ).compile()
+            sides[enabled] = (comp, state, ns)
+    finally:
+        set_phase_scopes(prior)
+
+    results = {True: float("inf"), False: float("inf")}
+    i = 0
+    while True:
+        i += 1
+        for enabled in (True, False):
+            comp, state, ns = sides[enabled]
+            s_per_tick, _, state, ns = measure_steady_tick(
+                comp, state, ns, ticks, reps=2
+            )
+            sides[enabled] = (comp, state, ns)
+            results[enabled] = min(results[enabled], s_per_tick)
+        pct = (
+            (results[True] - results[False]) / results[False] * 100.0
+            if results[False] > 0 else 0.0
+        )
+        if i >= pairs and (pct <= max_pct or i >= max_pairs):
+            break
+    return {
+        "pct": round(pct, 2),
+        "scopes_on_s_per_tick": round(results[True], 9),
+        "scopes_off_s_per_tick": round(results[False], 9),
+        "pairs": i,
+    }
+
+
+def analytic_block(
+    kernel, proposals: Optional[int] = None
+) -> Dict[str, Any]:
+    """The graftprof stamp bench artifacts attach (bench.py /
+    bench_tput_lat.py): analytic cost + memory + compile metrics for one
+    tick at the bench's own shape — trajectory signal that stays
+    meaningful even when the box's wall-clock is noisy."""
+    if proposals is None:
+        proposals = getattr(kernel.config, "max_proposals_per_tick", 4)
+    eng = Engine(kernel)
+    state, ns = eng.init()
+    inputs = _synth_inputs(kernel, proposals)
+    t0 = time.perf_counter()
+    comp = eng.lower_tick(state, ns, inputs).compile()
+    compile_s = time.perf_counter() - t0
+    total, by_phase = hlo_phase_ops(comp.as_text())
+    return {
+        "shape": {
+            "G": kernel.G, "R": kernel.R, "W": kernel.W, "P": proposals
+        },
+        "analytic": dict(
+            _norm_cost(comp),
+            hlo_instructions=total,
+            hlo_ops_by_phase=by_phase,
+        ),
+        "memory": _mem_stats(comp),
+        "tick_compile_s": round(compile_s, 3),
+    }
+
+
+def protocol_analytic_block(
+    name: str, variant: str, G: int, R: int, W: int
+) -> Dict[str, Any]:
+    """:func:`analytic_block` for a registered protocol by name — the
+    stamp the live-cluster bench artifacts (TPUTLAT/HOSTBENCH) attach,
+    built with the same variant-flipping rules as the profile cells."""
+    return analytic_block(_build_cell_kernel(name, variant, G, R, W))
+
+
+def g_sweep(
+    name: str = "multipaxos",
+    groups: Tuple[int, ...] = G_SWEEP,
+    R: int = CANONICAL_SHAPE["R"],
+    W: int = CANONICAL_SHAPE["W"],
+) -> Dict[str, Any]:
+    """Analytic-only sweep over the group axis: how flops / bytes /
+    temp memory scale with G — the curve the pod-scale sharding PR will
+    be judged against (strictly gateable; no wall-clock noise)."""
+    points = []
+    for G in groups:
+        cell = profile_cell(
+            name, "device", G=G, R=R, W=W,
+            with_device_trace=False, with_wall=False,
+        )
+        points.append({
+            "G": G,
+            "flops": cell["analytic"].get("flops"),
+            "bytes_accessed": cell["analytic"].get("bytes_accessed"),
+            "hlo_instructions": cell["analytic"]["hlo_instructions"],
+            "temp_bytes": (cell["memory"] or {}).get("temp_bytes"),
+        })
+    return {"protocol": name, "variant": "device", "points": points}
+
+
+def build_profile(
+    protocols: Tuple[str, ...] = CANONICAL_PROTOCOLS,
+    variants: Tuple[str, ...] = CANONICAL_VARIANTS,
+    G: int = CANONICAL_SHAPE["G"],
+    R: int = CANONICAL_SHAPE["R"],
+    W: int = CANONICAL_SHAPE["W"],
+    ticks: int = CANONICAL_TICKS,
+    reps: int = CANONICAL_REPS,
+    with_overhead: bool = True,
+    with_sweep: bool = True,
+    log=print,
+) -> Dict[str, Any]:
+    """The full PROFILE.json document (see scripts/profile_run.py)."""
+    from ..protocols import protocol_display_name
+
+    doc: Dict[str, Any] = {
+        "version": PROFILE_VERSION,
+        "generated_by": "scripts/profile_run.py",
+        "backend": jax.devices()[0].platform,
+        "jax_version": jax.__version__,
+        "shape": {"G": G, "R": R, "W": W,
+                  "ticks": ticks, "reps": reps},
+        "protocols": {},
+    }
+    for name in protocols:
+        disp = protocol_display_name(name)
+        doc["protocols"][disp] = {}
+        for variant in variants:
+            log(f"profiling {disp} [{variant}] ...")
+            cell = profile_cell(
+                name, variant, G=G, R=R, W=W, ticks=ticks, reps=reps
+            )
+            doc["protocols"][disp][variant] = cell
+    if with_sweep:
+        log("g-sweep (analytic) ...")
+        doc["g_sweep"] = g_sweep(protocols[0], R=R, W=W)
+    if with_overhead:
+        log("phase-scope overhead ablation A/B ...")
+        doc["scope_overhead"] = measure_scope_overhead(
+            protocols[0], G=G, R=R, W=W, ticks=ticks
+        )
+    doc["profiler_available"] = any(
+        cell.get("phase_wall_us_per_tick") is not None
+        for per in doc["protocols"].values() for cell in per.values()
+    )
+    return doc
+
+
+def phase_table_markdown(doc: Dict[str, Any]) -> str:
+    """The PERF.md breakdown table, generated from a PROFILE.json doc
+    (rounds >= 9 are produced by this, not by hand)."""
+    lines = [
+        "| Protocol (variant) | ms/tick | top phases by measured device "
+        "time (us/tick) | HLO ops |",
+        "|---|---|---|---|",
+    ]
+    for proto, per in sorted(doc.get("protocols", {}).items()):
+        for variant, cell in sorted(per.items()):
+            wall = cell.get("wall") or {}
+            ms = (wall.get("s_per_tick") or 0.0) * 1e3
+            pw = cell.get("phase_wall_us_per_tick") or {}
+            top = sorted(
+                ((k, v) for k, v in pw.items() if k != "unattributed"),
+                key=lambda kv: -kv[1],
+            )[:3]
+            tops = ", ".join(f"{k} {v:.0f}" for k, v in top) or "n/a"
+            lines.append(
+                f"| {proto} ({variant}) | {ms:.3f} | {tops} | "
+                f"{cell['analytic']['hlo_instructions']} |"
+            )
+    return "\n".join(lines)

@@ -98,82 +98,125 @@ class ChainRepKernel(ProtocolKernel):
             "bw_val": jnp.zeros((G, R, W), i32),
         }
 
+    # graftprof phase registry (core/protocol.py): tuple order is
+    # execution order — the pre-registry monolithic step, split at its
+    # own section comments.
+    PHASES: Tuple[Tuple[str, str], ...] = (
+        ("ingest_prop", "_ingest_prop"),
+        ("ingest_ack", "_ingest_ack"),
+        ("intake", "_intake"),
+        ("advance_bars", "_advance_bars"),
+        ("build_outbox", "_phase_build_outbox"),
+        ("telemetry", "_phase_telemetry"),
+    )
+
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
-        G, R, W = self.G, self.R, self.W
-        cfg = self.config
+        G, R = self.G, self.R
         i32 = jnp.int32
         s = dict(state)
-        flags = inbox["flags"]
-        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
-        is_head = rid == 0
-        is_tail = rid == R - 1
+        c = SimpleNamespace(
+            inbox=inbox, inputs=inputs, flags=inbox["flags"], old=state
+        )
+        c.rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+        c.is_head = c.rid == 0
+        c.is_tail = c.rid == R - 1
+        self._run_phases(s, c)
+        fx = StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": c.n_new,
+                "is_leader": c.is_head,
+                "snap_bar": s["exec_bar"],
+            },
+        )
+        return s, c.out, fx
 
-        # ---- PROP ingest (from predecessor): contiguous range accept
-        p_valid = (flags & PROP) != 0
+    # ---- PROP ingest (from predecessor): contiguous range accept
+    def _ingest_prop(self, s, c):
+        i32 = jnp.int32
+        p_valid = (c.flags & PROP) != 0
         p_src = jnp.argmax(p_valid, axis=2).astype(i32)
-        p_ok = p_valid.any(axis=2) & ~is_head & (p_src == rid - 1)
-        p_lo = take_src(inbox["pp_lo"], p_src)
-        p_hi = take_src(inbox["pp_hi"], p_src)
+        p_ok = p_valid.any(axis=2) & ~c.is_head & (p_src == c.rid - 1)
+        p_lo = take_src(c.inbox["pp_lo"], p_src)
+        p_hi = take_src(c.inbox["pp_hi"], p_src)
         acc = p_ok & (p_lo <= s["prop_bar"]) & (p_hi > s["prop_bar"])
-        m_acc, abs_acc = range_cover(p_lo, p_hi, W)
+        m_acc, abs_acc = range_cover(p_lo, p_hi, self.W)
         m_acc &= acc[..., None]
-        lane_val = take_lane(inbox["bw_val"], p_src)
+        lane_val = take_lane(c.inbox["bw_val"], p_src)
         s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
         s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
         s["prop_bar"] = jnp.where(
             acc, jnp.maximum(s["prop_bar"], p_hi), s["prop_bar"]
         )
 
-        # ---- ACK ingest (from successor): acked frontier + commit ripple
-        a_valid = (flags & ACK) != 0
+    # ---- ACK ingest (from successor): acked frontier + commit ripple
+    def _ingest_ack(self, s, c):
+        cfg = self.config
+        i32 = jnp.int32
+        a_valid = (c.flags & ACK) != 0
         a_src = jnp.argmax(a_valid, axis=2).astype(i32)
-        a_ok = a_valid.any(axis=2) & ~is_tail & (a_src == rid + 1)
-        a_f = take_src(inbox["ak_f"], a_src)
-        a_cbar = take_src(inbox["ak_cbar"], a_src)
+        a_ok = a_valid.any(axis=2) & ~c.is_tail & (a_src == c.rid + 1)
+        a_f = take_src(c.inbox["ak_f"], a_src)
+        a_cbar = take_src(c.inbox["ak_cbar"], a_src)
         prog = a_ok & (a_f > s["match_f"])
-        s["match_f"] = jnp.where(a_ok, jnp.maximum(s["match_f"], a_f), s["match_f"])
+        s["match_f"] = jnp.where(
+            a_ok, jnp.maximum(s["match_f"], a_f), s["match_f"]
+        )
         s["retry_cnt"] = jnp.where(prog, cfg.retry_interval, s["retry_cnt"])
-        up_commit = jnp.where(a_ok, a_cbar, 0)
+        c.up_commit = jnp.where(a_ok, a_cbar, 0)
 
-        # ---- head proposals
+    # ---- head proposals
+    def _intake(self, s, c):
+        cfg = self.config
         n_new, m_new, abs_new, new_vals = client_intake(
-            s, inputs, is_head, cfg.max_proposals_per_tick, W,
+            s, c.inputs, c.is_head, cfg.max_proposals_per_tick, self.W,
             frontier="prop_bar",
         )
         s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
         s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
         s["prop_bar"] = s["prop_bar"] + n_new
+        c.n_new = n_new
 
-        # ---- durability + commit
+    # ---- durability + commit
+    def _advance_bars(self, s, c):
+        cfg = self.config
         s["dur_bar"] = advance_durability(s, cfg.dur_lag, frontier="prop_bar")
         # tail: everything durable at the tail is committed (it has passed
         # every chain node); others: commit ripples up via ACKs
         s["commit_bar"] = jnp.where(
-            is_tail,
+            c.is_tail,
             s["dur_bar"],
-            jnp.maximum(s["commit_bar"], jnp.minimum(up_commit, s["prop_bar"])),
+            jnp.maximum(
+                s["commit_bar"], jnp.minimum(c.up_commit, s["prop_bar"])
+            ),
         )
+        s["exec_bar"] = advance_exec(s, c.inputs, cfg.exec_follows_commit)
 
-        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
-
-        # ---- outbox
+    # ---- outbox
+    def _build_outbox(self, s, c):
+        G, R = self.G, self.R
+        cfg = self.config
+        i32 = jnp.int32
         out = self.zero_outbox()
         oflags = out["flags"]
         succ = jnp.broadcast_to(
             (jnp.arange(R, dtype=i32)[None, None, :] ==
-             (rid + 1)[..., None]),
+             (c.rid + 1)[..., None]),
             (G, R, R),
-        ) & ~is_tail[..., None]
+        ) & ~c.is_tail[..., None]
 
-        stale = ~is_tail & (s["next_idx"] > s["match_f"])
-        s["retry_cnt"] = jnp.where(stale, s["retry_cnt"] - 1, cfg.retry_interval)
+        stale = ~c.is_tail & (s["next_idx"] > s["match_f"])
+        s["retry_cnt"] = jnp.where(
+            stale, s["retry_cnt"] - 1, cfg.retry_interval
+        )
         rewind = stale & (s["retry_cnt"] <= 0)
         s["next_idx"] = jnp.where(rewind, s["match_f"], s["next_idx"])
         s["retry_cnt"] = jnp.where(rewind, cfg.retry_interval, s["retry_cnt"])
 
         snd_lo = s["next_idx"]
         snd_hi = jnp.minimum(s["dur_bar"], snd_lo + self._chunk)
-        do_prop = (snd_hi > snd_lo) & ~is_tail
+        do_prop = (snd_hi > snd_lo) & ~c.is_tail
         oflags = oflags | jnp.where(
             do_prop[..., None] & succ, jnp.uint32(PROP), 0
         )
@@ -184,9 +227,9 @@ class ChainRepKernel(ProtocolKernel):
         # ACK to predecessor every tick: durable frontier + commit bar
         pred = jnp.broadcast_to(
             (jnp.arange(R, dtype=i32)[None, None, :] ==
-             (rid - 1)[..., None]),
+             (c.rid - 1)[..., None]),
             (G, R, R),
-        ) & ~is_head[..., None]
+        ) & ~c.is_head[..., None]
         oflags = oflags | jnp.where(pred, jnp.uint32(ACK), 0)
         out["ak_f"] = jnp.where(pred, s["dur_bar"][..., None], 0)
         out["ak_cbar"] = jnp.where(pred, s["commit_bar"][..., None], 0)
@@ -194,17 +237,4 @@ class ChainRepKernel(ProtocolKernel):
         out["bw_abs"] = s["win_abs"]
         out["bw_val"] = s["win_val"]
         out["flags"] = oflags
-
-        self._accumulate_telemetry(
-            state, s, SimpleNamespace(n_new=n_new)
-        )
-        fx = StepEffects(
-            commit_bar=s["commit_bar"],
-            exec_bar=s["exec_bar"],
-            extra={
-                "n_accepted": n_new,
-                "is_leader": is_head,
-                "snap_bar": s["exec_bar"],
-            },
-        )
-        return s, out, fx
+        return out

@@ -277,9 +277,27 @@ class EPaxosKernel(ProtocolKernel):
         s["it_seq"] = jnp.maximum(s["it_seq"], seq_c)
 
     # ------------------------------------------------------------------ step
+    # graftprof phase registry (core/protocol.py): tuple order is
+    # execution order.
+    PHASES: Tuple[Tuple[str, str], ...] = (
+        ("liveness", "_liveness"),
+        ("ingest_erp", "_ingest_erp"),
+        ("ingest_recovery_drive", "_ingest_recovery_drive"),
+        ("ingest_own_streams", "_ingest_own_streams"),
+        ("leader_decide", "_leader_decide"),
+        ("recovery_control", "_recovery_control"),
+        ("propose", "_propose"),
+        ("advance_commit_rows", "_advance_commit_rows"),
+        ("execute", "_execute"),
+        ("telemetry", "_phase_telemetry"),
+        ("build_outbox", "_phase_build_outbox"),
+    )
+
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
         s = dict(state)
-        c = SimpleNamespace(inbox=inbox, inputs=inputs, flags=inbox["flags"])
+        c = SimpleNamespace(
+            inbox=inbox, inputs=inputs, flags=inbox["flags"], old=state
+        )
         G, R = self.G, self.R
         c.rid = jnp.broadcast_to(
             jnp.arange(R, dtype=jnp.int32)[None, :], (G, R)
@@ -287,19 +305,9 @@ class EPaxosKernel(ProtocolKernel):
         c.eye = jnp.eye(R, dtype=jnp.bool_)[None]
         c.heard = c.flags != 0
 
-        self._liveness(s, c)
-        self._ingest_erp(s, c)
-        self._ingest_recovery_drive(s, c)
-        self._ingest_own_streams(s, c)
-        self._leader_decide(s, c)
-        self._recovery_control(s, c)
-        self._propose(s, c)
-        self._advance_commit_rows(s, c)
-        self._execute(s, c)
-        self._accumulate_telemetry(state, s, c)
-        out = self._build_outbox(s, c)
+        self._run_phases(s, c)
         fx = self._effects(s, c)
-        return s, out, fx
+        return s, c.out, fx
 
     # ========== liveness
     def _liveness(self, s, c):

@@ -295,29 +295,40 @@ class MultiPaxosKernel(ProtocolKernel):
         return out
 
     # ------------------------------------------------------------------ step
+    # The graftprof phase registry (core/protocol.py): execution order is
+    # the tuple order, method overrides in the variant family (RSPaxos /
+    # Crossword / QuorumLeases / Bodega tally, adoption and send hooks)
+    # keep their phase attribution.  ``telemetry`` runs after
+    # ``build_outbox`` on purpose: send-side hooks (_extra_sends) mutate
+    # state too — lease grants live there — and telemetry reads
+    # old-vs-new.
+    PHASES: Tuple[Tuple[str, str], ...] = (
+        ("ingest_heartbeat", "_ingest_heartbeat"),
+        ("ingest_prepare", "_ingest_prepare"),
+        ("ingest_snapshot", "_ingest_snapshot"),
+        ("ingest_accept", "_ingest_accept"),
+        ("ingest_accept_reply", "_ingest_accept_reply"),
+        ("ingest_hb_reply", "_ingest_hb_reply"),
+        ("ingest_prepare_reply", "_gated_prepare_reply"),
+        ("election", "_election"),
+        ("try_step_up", "_try_step_up"),
+        ("leader_propose", "_leader_propose"),
+        ("advance_bars", "_advance_bars"),
+        ("build_outbox", "_phase_build_outbox"),
+        ("telemetry", "_phase_telemetry"),
+    )
+
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
         s = dict(state)
-        c = SimpleNamespace(inbox=inbox, inputs=inputs, flags=inbox["flags"])
+        c = SimpleNamespace(
+            inbox=inbox, inputs=inputs, flags=inbox["flags"], old=state
+        )
         c.rid = jnp.broadcast_to(
             jnp.arange(self.R, dtype=jnp.int32)[None, :], (self.G, self.R)
         )
-        self._ingest_heartbeat(s, c)
-        self._ingest_prepare(s, c)
-        self._ingest_snapshot(s, c)
-        self._ingest_accept(s, c)
-        self._ingest_accept_reply(s, c)
-        self._ingest_hb_reply(s, c)
-        self._gated_prepare_reply(s, c)
-        self._election(s, c)
-        self._try_step_up(s, c)
-        self._leader_propose(s, c)
-        self._advance_bars(s, c)
-        out = self._build_outbox(s, c)
-        # after the outbox: send-side hooks (_extra_sends) mutate state
-        # too — lease grants live there — and telemetry reads old-vs-new
-        self._accumulate_telemetry(state, s, c)
+        self._run_phases(s, c)
         fx = self._effects(s, c)
-        return s, out, fx
+        return s, c.out, fx
 
     # ========== 1. HEARTBEAT ingest (leader liveness + commit notice)
     def _ingest_heartbeat(self, s, c):

@@ -238,9 +238,30 @@ class RaftKernel(ProtocolKernel):
         return out
 
     # ------------------------------------------------------------------ step
+    # graftprof phase registry (core/protocol.py): tuple order is
+    # execution order; CRaft inherits the table with its tally/adoption
+    # method overrides keeping their attribution.  ``telemetry`` sits
+    # before ``build_outbox`` — Raft's send path does not mutate state
+    # the lanes read, and the pre-refactor accumulate ran here.
+    PHASES: Tuple[Tuple[str, str], ...] = (
+        ("ingest_reqvote", "_ingest_reqvote"),
+        ("ingest_vote_reply", "_ingest_vote_reply"),
+        ("ingest_ae", "_ingest_ae"),
+        ("ingest_snapshot", "_ingest_snapshot"),
+        ("ingest_ae_reply", "_ingest_ae_reply"),
+        ("election", "_election"),
+        ("try_win", "_try_win"),
+        ("leader_append", "_leader_append"),
+        ("advance_bars", "_advance_bars"),
+        ("telemetry", "_phase_telemetry"),
+        ("build_outbox", "_phase_build_outbox"),
+    )
+
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
         s = dict(state)
-        c = SimpleNamespace(inbox=inbox, inputs=inputs, flags=inbox["flags"])
+        c = SimpleNamespace(
+            inbox=inbox, inputs=inputs, flags=inbox["flags"], old=state
+        )
         c.rid = jnp.broadcast_to(
             jnp.arange(self.R, dtype=jnp.int32)[None, :], (self.G, self.R)
         )
@@ -250,19 +271,9 @@ class RaftKernel(ProtocolKernel):
         s["rng"], c.reload = prng.uniform_int(
             s["rng"], self.config.hear_timeout_lo, self.config.hear_timeout_hi
         )
-        self._ingest_reqvote(s, c)
-        self._ingest_vote_reply(s, c)
-        self._ingest_ae(s, c)
-        self._ingest_snapshot(s, c)
-        self._ingest_ae_reply(s, c)
-        self._election(s, c)
-        self._try_win(s, c)
-        self._leader_append(s, c)
-        self._advance_bars(s, c)
-        self._accumulate_telemetry(state, s, c)
-        out = self._build_outbox(s, c)
+        self._run_phases(s, c)
         fx = self._effects(s, c)
-        return s, out, fx
+        return s, c.out, fx
 
     # ========== 1. REQVOTE ingest (vote granting; may bump term)
     def _ingest_reqvote(self, s, c):

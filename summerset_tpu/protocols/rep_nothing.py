@@ -66,35 +66,51 @@ class RepNothingKernel(ProtocolKernel):
         G, R = self.G, self.R
         return {"flags": jnp.zeros((G, R, R), jnp.uint32)}
 
+    # graftprof phase registry (core/protocol.py): tuple order is
+    # execution order.
+    PHASES: Tuple[Tuple[str, str], ...] = (
+        ("intake", "_intake"),
+        ("advance_bars", "_advance_bars"),
+        ("build_outbox", "_phase_build_outbox"),
+        ("telemetry", "_phase_telemetry"),
+    )
+
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
-        G, R, W = self.G, self.R, self.W
-        cfg = self.config
+        G, R = self.G, self.R
         i32 = jnp.int32
         s = dict(state)
-        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
-
-        serving = rid == 0
-        n_new, m_new, abs_new, new_vals = client_intake(
-            s, inputs, serving, cfg.max_proposals_per_tick, W
+        c = SimpleNamespace(
+            inbox=inbox, inputs=inputs, flags=inbox["flags"], old=state
         )
-        s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
-        s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
-        s["next_slot"] = s["next_slot"] + n_new
-
-        s["dur_bar"] = advance_durability(s, cfg.dur_lag)
-        s["commit_bar"] = s["dur_bar"]
-        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
-
-        self._accumulate_telemetry(
-            state, s, SimpleNamespace(n_new=n_new)
-        )
+        c.rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+        c.serving = c.rid == 0
+        self._run_phases(s, c)
         fx = StepEffects(
             commit_bar=s["commit_bar"],
             exec_bar=s["exec_bar"],
             extra={
-                "n_accepted": n_new,
-                "is_leader": serving,
+                "n_accepted": c.n_new,
+                "is_leader": c.serving,
                 "snap_bar": s["exec_bar"],
             },
         )
-        return s, self.zero_outbox(), fx
+        return s, c.out, fx
+
+    def _intake(self, s, c):
+        cfg = self.config
+        n_new, m_new, abs_new, new_vals = client_intake(
+            s, c.inputs, c.serving, cfg.max_proposals_per_tick, self.W
+        )
+        s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
+        s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
+        s["next_slot"] = s["next_slot"] + n_new
+        c.n_new = n_new
+
+    def _advance_bars(self, s, c):
+        cfg = self.config
+        s["dur_bar"] = advance_durability(s, cfg.dur_lag)
+        s["commit_bar"] = s["dur_bar"]
+        s["exec_bar"] = advance_exec(s, c.inputs, cfg.exec_follows_commit)
+
+    def _build_outbox(self, s, c):
+        return self.zero_outbox()

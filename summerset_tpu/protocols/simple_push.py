@@ -102,92 +102,133 @@ class SimplePushKernel(ProtocolKernel):
             "bw_val": jnp.zeros((G, R, W), i32),
         }
 
+    # graftprof phase registry (core/protocol.py): tuple order is
+    # execution order — the pre-registry monolithic step, split at its
+    # own section comments.
+    PHASES: Tuple[Tuple[str, str], ...] = (
+        ("ingest_push", "_ingest_push"),
+        ("ingest_push_reply", "_ingest_push_reply"),
+        ("intake", "_intake"),
+        ("advance_bars", "_advance_bars"),
+        ("build_outbox", "_phase_build_outbox"),
+        ("telemetry", "_phase_telemetry"),
+    )
+
     def step(self, state, inbox, inputs) -> Tuple[Any, Any, StepEffects]:
-        G, R, W = self.G, self.R, self.W
-        cfg = self.config
+        G, R = self.G, self.R
         i32 = jnp.int32
         s = dict(state)
-        flags = inbox["flags"]
-        rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
-        serving = rid == 0
+        c = SimpleNamespace(
+            inbox=inbox, inputs=inputs, flags=inbox["flags"], old=state
+        )
+        c.rid = jnp.broadcast_to(jnp.arange(R, dtype=i32)[None, :], (G, R))
+        c.serving = c.rid == 0
         # pushed peer set: replicas 1..degree (deterministic, like the
         # reference's fixed peer selection)
-        pushed = (rid >= 1) & (rid <= self._degree)
+        c.pushed = (c.rid >= 1) & (c.rid <= self._degree)
+        self._run_phases(s, c)
+        fx = StepEffects(
+            commit_bar=s["commit_bar"],
+            exec_bar=s["exec_bar"],
+            extra={
+                "n_accepted": c.n_new,
+                "is_leader": c.serving,
+                "snap_bar": s["exec_bar"],
+            },
+        )
+        return s, c.out, fx
 
-        # ---- PUSH ingest (peers): contiguous range accept
-        p_valid = (flags & PUSH) != 0
+    # ---- PUSH ingest (peers): contiguous range accept
+    def _ingest_push(self, s, c):
+        i32 = jnp.int32
+        p_valid = (c.flags & PUSH) != 0
         p_src = jnp.argmax(p_valid, axis=2).astype(i32)
-        p_ok = p_valid.any(axis=2) & ~serving
-        p_lo = take_src(inbox["ps_lo"], p_src)
-        p_hi = take_src(inbox["ps_hi"], p_src)
-        p_cbar = take_src(inbox["ps_cbar"], p_src)
+        p_ok = p_valid.any(axis=2) & ~c.serving
+        p_lo = take_src(c.inbox["ps_lo"], p_src)
+        p_hi = take_src(c.inbox["ps_hi"], p_src)
+        p_cbar = take_src(c.inbox["ps_cbar"], p_src)
         acc = p_ok & (p_lo <= s["next_slot"]) & (p_hi > s["next_slot"])
-        m_acc, abs_acc = range_cover(p_lo, p_hi, W)
+        m_acc, abs_acc = range_cover(p_lo, p_hi, self.W)
         m_acc &= acc[..., None]
-        lane_val = take_lane(inbox["bw_val"], p_src)
+        lane_val = take_lane(c.inbox["bw_val"], p_src)
         s["win_abs"] = jnp.where(m_acc, abs_acc, s["win_abs"])
         s["win_val"] = jnp.where(m_acc, lane_val, s["win_val"])
         s["next_slot"] = jnp.where(
             acc, jnp.maximum(s["next_slot"], p_hi), s["next_slot"]
         )
-        peer_commit = p_ok & ~serving
-        new_cbar = jnp.minimum(p_cbar, s["next_slot"])
+        c.peer_commit = p_ok & ~c.serving
+        c.new_cbar = jnp.minimum(p_cbar, s["next_slot"])
 
-        # ---- PUSH_REPLY ingest (serving node): cumulative ack frontiers
-        r_valid = (flags & PUSH_REPLY) != 0
-        prog = r_valid & (inbox["pr_f"] > s["match_f"])
+    # ---- PUSH_REPLY ingest (serving node): cumulative ack frontiers
+    def _ingest_push_reply(self, s, c):
+        cfg = self.config
+        r_valid = (c.flags & PUSH_REPLY) != 0
+        prog = r_valid & (c.inbox["pr_f"] > s["match_f"])
         s["match_f"] = jnp.where(
-            r_valid, jnp.maximum(s["match_f"], inbox["pr_f"]), s["match_f"]
+            r_valid, jnp.maximum(s["match_f"], c.inbox["pr_f"]), s["match_f"]
         )
         s["retry_cnt"] = jnp.where(prog, cfg.retry_interval, s["retry_cnt"])
 
-        # ---- serving node proposals
+    # ---- serving node proposals
+    def _intake(self, s, c):
+        cfg = self.config
         n_new, m_new, abs_new, new_vals = client_intake(
-            s, inputs, serving, cfg.max_proposals_per_tick, W
+            s, c.inputs, c.serving, cfg.max_proposals_per_tick, self.W
         )
         s["win_abs"] = jnp.where(m_new, abs_new, s["win_abs"])
         s["win_val"] = jnp.where(m_new, new_vals, s["win_val"])
         s["next_slot"] = s["next_slot"] + n_new
+        c.n_new = n_new
 
-        # ---- durability + commit
+    # ---- durability + commit
+    def _advance_bars(self, s, c):
+        cfg = self.config
         s["dur_bar"] = advance_durability(s, cfg.dur_lag)
         # serving commit: all pushed peers acked (min over pushed frontiers)
-        pushed_row = pushed[:, None, :]  # [G, 1, R_dst] as seen by serving
+        pushed_row = c.pushed[:, None, :]  # [G, 1, R_dst] seen by serving
         acked_min = jnp.min(
             jnp.where(pushed_row, s["match_f"], jnp.iinfo(jnp.int32).max),
             axis=2,
         )
         srv_commit = jnp.minimum(
-            s["dur_bar"], jnp.where(self._degree > 0, acked_min, s["dur_bar"])
+            s["dur_bar"],
+            jnp.where(self._degree > 0, acked_min, s["dur_bar"]),
         )
         s["commit_bar"] = jnp.where(
-            serving,
+            c.serving,
             jnp.maximum(s["commit_bar"], srv_commit),
             jnp.where(
-                peer_commit,
-                jnp.maximum(s["commit_bar"], new_cbar),
+                c.peer_commit,
+                jnp.maximum(s["commit_bar"], c.new_cbar),
                 s["commit_bar"],
             ),
         )
+        s["exec_bar"] = advance_exec(s, c.inputs, cfg.exec_follows_commit)
 
-        s["exec_bar"] = advance_exec(s, inputs, cfg.exec_follows_commit)
-
-        # ---- outbox
+    # ---- outbox
+    def _build_outbox(self, s, c):
+        G, R = self.G, self.R
+        cfg = self.config
+        i32 = jnp.int32
         out = self.zero_outbox()
         oflags = out["flags"]
-        dst_pushed = jnp.broadcast_to(pushed[:, None, :], (G, R, R))
+        dst_pushed = jnp.broadcast_to(c.pushed[:, None, :], (G, R, R))
 
-        stale = serving[..., None] & dst_pushed & (s["next_idx"] > s["match_f"])
-        s["retry_cnt"] = jnp.where(stale, s["retry_cnt"] - 1, cfg.retry_interval)
+        stale = c.serving[..., None] & dst_pushed & (
+            s["next_idx"] > s["match_f"]
+        )
+        s["retry_cnt"] = jnp.where(
+            stale, s["retry_cnt"] - 1, cfg.retry_interval
+        )
         rewind = stale & (s["retry_cnt"] <= 0)
         s["next_idx"] = jnp.where(rewind, s["match_f"], s["next_idx"])
         s["retry_cnt"] = jnp.where(rewind, cfg.retry_interval, s["retry_cnt"])
 
         snd_lo = s["next_idx"]
         snd_hi = jnp.minimum(s["next_slot"][..., None], snd_lo + self._chunk)
-        do_push = serving[..., None] & dst_pushed & (snd_hi > snd_lo)
+        do_push = c.serving[..., None] & dst_pushed & (snd_hi > snd_lo)
         # heartbeat-style empty push keeps peer commit bars advancing
-        do_note = serving[..., None] & dst_pushed & ~do_push
+        do_note = c.serving[..., None] & dst_pushed & ~do_push
         oflags = oflags | jnp.where(do_push | do_note, jnp.uint32(PUSH), 0)
         out["ps_lo"] = jnp.where(do_push, snd_lo, s["next_slot"][..., None])
         out["ps_hi"] = jnp.where(do_push, snd_hi, s["next_slot"][..., None])
@@ -197,7 +238,7 @@ class SimplePushKernel(ProtocolKernel):
         s["next_idx"] = jnp.where(do_push, snd_hi, s["next_idx"])
 
         # peers ack their durable contiguous frontier to the serving node
-        do_reply = pushed[..., None] & (
+        do_reply = c.pushed[..., None] & (
             jnp.arange(R, dtype=i32)[None, None, :] == 0
         )
         oflags = oflags | jnp.where(do_reply, jnp.uint32(PUSH_REPLY), 0)
@@ -208,17 +249,4 @@ class SimplePushKernel(ProtocolKernel):
         out["bw_abs"] = s["win_abs"]
         out["bw_val"] = s["win_val"]
         out["flags"] = oflags
-
-        self._accumulate_telemetry(
-            state, s, SimpleNamespace(n_new=n_new)
-        )
-        fx = StepEffects(
-            commit_bar=s["commit_bar"],
-            exec_bar=s["exec_bar"],
-            extra={
-                "n_accepted": n_new,
-                "is_leader": serving,
-                "snap_bar": s["exec_bar"],
-            },
-        )
-        return s, out, fx
+        return out

@@ -173,8 +173,49 @@ class UndeclaredInputKernel(GoodKernel):
         )
 
 
+class BrokenForwarderKernel(GoodKernel):
+    """T1 (outbox sink): relays an inbox lane verbatim into an outbox
+    lane without a flags gate — the ungated relay hop.  The receiver's
+    own flags gate only vouches for ITS inbound link, so dead-link
+    garbage from one partition upstream would transit this forwarder
+    invisibly; making outbox leaves sinks is what catches it."""
+
+    name = "FixtureBrokenForwarder"
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        s["exec_bar"] = s["commit_bar"]
+        out = self.zero_outbox()
+        # the violation: store-and-forward without the store (the
+        # gated-window relay the real chain/push kernels do); raw
+        # inbound bytes go straight back onto the wire
+        out["data"] = jnp.swapaxes(inbox["data"], 1, 2)
+        out["flags"] = jnp.full(
+            (self.G, self.R, self.R), 1, jnp.uint32
+        )
+        return s, out, StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
+class AllowedForwarderKernel(BrokenForwarderKernel):
+    """The same relay hop, declared: a TAINT_ALLOW entry naming the
+    outbox sink suppresses the T1 (and is NOT stale, so no T9) —
+    proving the allowlist covers ``outbox.*`` sinks like it covers
+    state and effects."""
+
+    name = "FixtureAllowedForwarder"
+    TAINT_ALLOW = (
+        ("data", "outbox.data",
+         "fixture: deliberate relay lane, receiver re-validates"),
+    )
+
+
 FIXTURES = {
     "fixturegood": GoodKernel,
+    "fixturebrokenforwarder": BrokenForwarderKernel,
+    "fixtureallowedforwarder": AllowedForwarderKernel,
     "fixtureunflagged": UnflaggedInboxReadKernel,
     "fixtureunflaggedeffects": UnflaggedEffectsKernel,
     "fixturestaleallow": StaleAllowKernel,

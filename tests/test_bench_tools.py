@@ -195,3 +195,10 @@ class TestBenchBackendFallback:
         assert doc["backend"] == "cpu"
         assert "fallback" in doc["backend_note"]
         assert doc["value"] > 0
+        # the artifact judges itself (graftprof satellite): a live
+        # capture carries ok=true and the analytic stamp at its shape
+        assert doc["ok"] is True
+        gp = doc["graftprof"]
+        assert gp["shape"]["G"] == 8
+        assert gp["analytic"]["hlo_instructions"] > 0
+        assert gp["analytic"]["hlo_ops_by_phase"]["ingest_accept"] > 0

@@ -54,6 +54,9 @@ def test_good_fixture_is_clean():
         ("fixtureunflagged", verify_kernel_taint, ["229c835e7ed6"]),
         ("fixtureunflaggedeffects", verify_kernel_taint,
          ["670193535ccb"]),
+        # the ungated relay hop: outbox leaves are sinks too
+        ("fixturebrokenforwarder", verify_kernel_taint,
+         ["6ffff174820c"]),
         ("fixturestaleallow", verify_kernel_taint, ["c6fab01b5c86"]),
         ("fixturefloatstate", verify_kernel, ["aec22b6e38a8"]),
         ("fixturemissingflags", verify_kernel, ["c746d187a51b"]),
@@ -73,9 +76,21 @@ def test_broken_fixtures_fail_only_their_rule():
     """The planted violation is the only one: the other pass stays clean."""
     assert verify_kernel(make_fixture, "fixtureunflagged").ok
     assert verify_kernel(make_fixture, "fixtureunflaggedeffects").ok
+    assert verify_kernel(make_fixture, "fixturebrokenforwarder").ok
     assert verify_kernel_taint(make_fixture, "fixturefloatstate").ok
     assert verify_kernel_taint(make_fixture, "fixturebogusdurable").ok
     assert verify_kernel_taint(make_fixture, "fixtureundeclaredinput").ok
+
+
+def test_allowed_forwarder_suppresses_outbox_sink():
+    """A TAINT_ALLOW entry naming an ``outbox.*`` sink suppresses the
+    relay-hop T1 — and is live (no stale-suppression T9)."""
+    res = verify_kernel_taint(make_fixture, "fixtureallowedforwarder")
+    assert res.ok, [f.render() for f in res.findings]
+    assert len(res.suppressed) == 1
+    f, reason = res.suppressed[0]
+    assert f.scope == "data->outbox.data"
+    assert "relay" in reason
 
 
 def test_taint_while_cond_is_an_implicit_flow():
