@@ -14,7 +14,12 @@ Asserts, WITHOUT bringing up clusters (pure plan regeneration):
    ``conf_change``, and ``take_snapshot`` each occur in at least one
    scheduled event across the matrix seeds, and the QuorumLeases row
    (the only conf-plane protocol in the matrix) is present;
-4. end-of-soak boundedness was recorded: WAL sizes under the bound.
+4. end-of-soak boundedness was recorded: WAL sizes under the bound;
+5. the gray-failure rows cover every fail-slow class x protocol as a
+   mitigated/unmitigated twin pair: every cell ok against the canonical
+   ``FaultPlan.failslow`` digest, the mitigated twin demoted its
+   limping leader, and its fault-window throughput beat the
+   unmitigated twin by the committed ratio bar.
 
 Usage:  python scripts/nemesis_gate.py [--json NEMESIS.json]
 """
@@ -30,8 +35,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from nemesis_soak import (  # noqa: E402  (scripts/ sibling import)
-    DEFAULT_BUDGET_TICKS, DEFAULT_TICKS, MATRIX_EXTRA, MATRIX_PROTOCOLS,
-    MATRIX_SEEDS, SOAK_CLASSES, WAL_BOUND_BYTES,
+    DEFAULT_BUDGET_TICKS, DEFAULT_TICKS, FAILSLOW_CLASSES,
+    FAILSLOW_PROTOCOLS, FAILSLOW_SEED, FAILSLOW_TICKS,
+    FAILSLOW_TPUT_RATIO, MATRIX_EXTRA, MATRIX_PROTOCOLS, MATRIX_SEEDS,
+    SOAK_CLASSES, WAL_BOUND_BYTES,
 )
 
 from summerset_tpu.host.nemesis import FaultPlan  # noqa: E402
@@ -46,6 +53,9 @@ def main() -> int:
     args = ap.parse_args()
     with open(args.json) as f:
         rows = json.load(f)
+
+    failslow_rows = [r for r in rows if r.get("failslow")]
+    rows = [r for r in rows if not r.get("failslow")]
 
     failures = []
     by_seed = {
@@ -96,15 +106,75 @@ def main() -> int:
                 f"{MATRIX_SEEDS} — widen the horizon or reseed"
             )
 
+    # ---- gray-failure (fail-slow) rows ---------------------------------
+    # every class x protocol cell present as a mitigated/unmitigated twin
+    # pair, every cell ok, digests byte-identical to the canonical
+    # FaultPlan.failslow per (class, seed), the mitigated twin demoted at
+    # least once, and its fault-window throughput >= the ratio bar
+    fs = {}
+    for r in failslow_rows:
+        fs[(r.get("protocol"), r.get("class"),
+            bool(r.get("mitigated")))] = r
+    for cls in FAILSLOW_CLASSES:
+        want_digest = FaultPlan.failslow(
+            cls, FAILSLOW_SEED, DEFAULT_REPLICAS, FAILSLOW_TICKS
+        ).digest()
+        for proto in FAILSLOW_PROTOCOLS:
+            pair = {}
+            for mit in (True, False):
+                tag = (f"failslow {proto}/{cls}/"
+                       f"{'mit' if mit else 'unmit'}")
+                row = fs.get((proto, cls, mit))
+                if row is None:
+                    failures.append(f"{tag}: cell missing — rerun "
+                                    "scripts/nemesis_soak.py "
+                                    "--failslow-matrix")
+                    continue
+                pair[mit] = row
+                if not row.get("ok"):
+                    failures.append(f"{tag}: not ok ({row.get('error')})")
+                if row.get("digest") != want_digest:
+                    failures.append(
+                        f"{tag}: digest drift — committed "
+                        f"{row.get('digest')} vs canonical {want_digest}"
+                    )
+                rt = row.get("recovery_ticks")
+                if rt is None or rt > DEFAULT_BUDGET_TICKS:
+                    failures.append(f"{tag}: recovery unbounded ({rt})")
+            mitr = pair.get(True)
+            if mitr is not None:
+                if (mitr.get("demotions") or 0) < 1:
+                    failures.append(
+                        f"failslow {proto}/{cls}: mitigated twin never "
+                        "demoted the limping leader"
+                    )
+                unmit = pair.get(False)
+                if unmit is not None and unmit.get("tput_fault"):
+                    ratio = (
+                        (mitr.get("tput_fault") or 0.0)
+                        / max(unmit["tput_fault"], 1e-9)
+                    )
+                    if ratio < FAILSLOW_TPUT_RATIO:
+                        failures.append(
+                            f"failslow {proto}/{cls}: mitigated "
+                            f"throughput only {ratio:.2f}x the "
+                            f"unmitigated twin "
+                            f"(need >= {FAILSLOW_TPUT_RATIO}x)"
+                        )
+
     if failures:
         print("NEMESIS gate FAIL:")
         for f_ in failures:
             print(f"  - {f_}")
         return 1
     print(
-        f"NEMESIS gate OK: {len(rows)} cells linearizable, digests "
-        f"byte-identical per seed, recovery <= {DEFAULT_BUDGET_TICKS} "
-        f"ticks, long-lived classes {LONG_LIVED} all scheduled"
+        f"NEMESIS gate OK: {len(rows)} matrix cells linearizable, "
+        f"digests byte-identical per seed, recovery <= "
+        f"{DEFAULT_BUDGET_TICKS} ticks, long-lived classes {LONG_LIVED} "
+        f"all scheduled; {len(failslow_rows)} fail-slow cells "
+        f"({FAILSLOW_CLASSES} x {FAILSLOW_PROTOCOLS} twin pairs) ok "
+        f"with mitigated recovered throughput >= "
+        f"{FAILSLOW_TPUT_RATIO}x the unmitigated twin"
     )
     return 0
 

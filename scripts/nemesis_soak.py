@@ -73,6 +73,25 @@ WAL_BOUND_BYTES = 8 << 20
 DEFAULT_TICKS = 120
 DEFAULT_BUDGET_TICKS = 4000
 
+# ---- gray-failure (fail-slow) matrix -----------------------------------
+# Each fail-slow class runs against every protocol row TWICE: with the
+# health plane's mitigation (leader demotion + read steering) armed, and
+# a mitigation-disabled twin that only observes.  The victim is the LIVE
+# leader at fire time (the placement that makes fail-slow a group-wide
+# outage); both twins share the canonical FaultPlan.failslow digest.
+# The headline assertion: the mitigated twin's recovered throughput —
+# measured while the victim is STILL limping, after a detection budget —
+# must beat the unmitigated twin by FAILSLOW_TPUT_RATIO.
+FAILSLOW_CLASSES = ("slow_disk", "slow_peer", "mem_pressure")
+FAILSLOW_PROTOCOLS = ("MultiPaxos", "Raft", "QuorumLeases")
+FAILSLOW_SEED = 1
+FAILSLOW_TICKS = 80
+FAILSLOW_TPUT_RATIO = 2.0
+# wall-clock phases of one fail-slow cell (seconds)
+FAILSLOW_STEADY_S = 2.5    # pre-fault throughput baseline
+FAILSLOW_DETECT_S = 10.0   # detection + demotion budget after onset
+FAILSLOW_MEASURE_S = 8.0   # fault-active throughput window
+
 
 def protocol_config(protocol: str) -> dict:
     if protocol in ("RSPaxos", "CRaft", "Crossword"):
@@ -289,6 +308,257 @@ def run_one(protocol: str, seed: int, args) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _failslow_spec(ev) -> dict:
+    """The ``inject_faults`` payload for one fail-slow event (the same
+    lowering ``FaultPlan.host_actions`` uses, keyed for a single
+    retargeted victim)."""
+    from summerset_tpu.host.nemesis import SLOW_PEER_BW
+
+    # soak cells pin the per-op cost floors so the limp dominates the
+    # box's natural tick (the >= 2x ratio's denominator) even on
+    # tmpfs-backed test dirs, while staying under election timeouts on
+    # fast boxes — gray, not dead.  The generated matrix keeps the
+    # storage defaults (those cells assert survival, not a ratio).
+    if ev.kind == "slow_disk":
+        return {"wal": {"slow": ev.arg, "slow_floor": 0.002}}
+    if ev.kind == "mem_pressure":
+        return {"wal": {"mem": int(ev.arg), "mem_stall": 0.15}}
+    # the raised stall_cap binds only when per-tick WORK is large (slow
+    # boxes), where election timeouts are proportionally long in wall
+    # time too — on fast boxes the starve share stays far below it
+    return {"net": {"bw": SLOW_PEER_BW, "starve": ev.arg,
+                    "stall_cap": 0.25}}
+
+
+def _acked_in_window(ops, t0: float, t1: float) -> int:
+    """Acked ops whose response landed inside [t0, t1] — the recorded
+    clients' throughput meter (list append is atomic; a snapshot copy
+    is safe against the live recorders)."""
+    n = 0
+    for o in list(ops):
+        if o.acked and o.t_resp != float("inf") and t0 <= o.t_resp <= t1:
+            n += 1
+    return n
+
+
+def run_failslow(protocol: str, cls: str, mitigated: bool, args) -> dict:
+    """One gray-failure cell: inject ``cls`` at the live leader, give
+    the health plane a detection budget, then measure throughput WHILE
+    the victim limps.  Asserts linearizability + bounded recovery; the
+    mitigated/unmitigated ratio is asserted by the caller across the
+    twin pair."""
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import GenericEndpoint
+    from summerset_tpu.client.tester import start_recorded_clients
+    from summerset_tpu.host.messages import CtrlRequest
+    from summerset_tpu.host.nemesis import FaultPlan
+    from summerset_tpu.utils.linearize import check_history
+
+    # pinned to the gate's canonical contract (FAILSLOW_SEED, 3
+    # replicas, FAILSLOW_TICKS): nemesis_gate.py recomputes digests at
+    # exactly these, so honoring --seed/--replicas here would write
+    # rows the gate permanently rejects as drift
+    seed = FAILSLOW_SEED
+    replicas = 3
+    plan = FaultPlan.failslow(cls, seed, replicas, FAILSLOW_TICKS)
+    again = FaultPlan.failslow(cls, seed, replicas, FAILSLOW_TICKS)
+    assert plan.timeline() == again.timeline(), "non-deterministic plan!"
+    ev = plan.events[0]
+    tag = f"{protocol}/{cls}/{'mitigated' if mitigated else 'unmitigated'}"
+    print(f"--- failslow {tag} seed={seed} digest={plan.digest()}")
+    print(plan.timeline(), end="")
+
+    tmp = tempfile.mkdtemp(prefix=f"failslow_{cls}_{int(mitigated)}_")
+    result = {
+        "failslow": True, "protocol": protocol, "seed": seed,
+        "class": cls, "mitigated": mitigated, "digest": plan.digest(),
+        "ok": False,
+    }
+    cluster = None
+    stop = threading.Event()
+    ops: list = []
+    threads = []
+    ep = None
+    try:
+        cfg = dict(protocol_config(protocol))
+        cfg["health_mitigation"] = mitigated
+        cluster = Cluster(
+            protocol, replicas, tmp, config=cfg, tick=args.tick,
+        )
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        drv = DriverClosedLoop(ep, timeout=10.0)
+        drv.checked_put("warm", "1")
+        if protocol == "QuorumLeases":
+            # grant read leases to everyone first, so the mitigated
+            # twin's demotion actually exercises the revoke-then-adopt
+            # barrier (an empty-responders ConfChange) before abdicating
+            drv.conf_change(
+                {"responders": list(range(replicas))}, retries=4
+            )
+        threads = start_recorded_clients(
+            cluster.manager_addr, args.clients,
+            [f"fs{i}" for i in range(3)], stop, ops, seed=seed,
+        )
+        t0 = time.monotonic()
+        time.sleep(FAILSLOW_STEADY_S)
+        t1 = time.monotonic()
+        tput_steady = _acked_in_window(ops, t0, t1) / (t1 - t0)
+
+        info = ep.ctrl.request(CtrlRequest("query_info"))
+        victim = info.leader if info.leader is not None else 0
+        result["victim"] = victim
+        ep.ctrl.request(CtrlRequest(
+            "inject_faults", servers=[victim], payload=_failslow_spec(ev),
+        ))
+        # detection budget: the mitigated twin should demote AND hand
+        # leadership to a healthy successor within it (the measure
+        # window reads RECOVERED throughput, so it must not start while
+        # clients are still failing over); the unmitigated twin just
+        # waits the budget out, limping the whole time
+        t_deadline = time.monotonic() + FAILSLOW_DETECT_S
+        while time.monotonic() < t_deadline:
+            time.sleep(0.5)
+            vic = cluster.replicas.get(victim)
+            if mitigated and vic is not None and vic.metrics.counter_value(
+                "leader_demotions"
+            ) > 0:
+                cur = ep.ctrl.request(CtrlRequest("query_info")).leader
+                if cur is not None and cur != victim:
+                    break
+        t2 = time.monotonic()
+        time.sleep(FAILSLOW_MEASURE_S)
+        t3 = time.monotonic()
+        tput_fault = _acked_in_window(ops, t2, t3) / (t3 - t2)
+
+        vic = cluster.replicas.get(victim)
+        result["demotions"] = (
+            0 if vic is None
+            else vic.metrics.counter_value("leader_demotions")
+        )
+        result["health_score_victim"] = (
+            None if vic is None
+            else vic.metrics.gauge_value("health_score", None)
+        )
+        post = ep.ctrl.request(CtrlRequest("query_info"))
+        result["leader_after"] = post.leader
+        result["tput_steady"] = round(tput_steady, 2)
+        result["tput_fault"] = round(tput_fault, 2)
+
+        # heal + bounded recovery (same discipline as run_one)
+        ep.ctrl.request(CtrlRequest(
+            "inject_faults", servers=[victim],
+            payload={"net": None, "wal": None},
+        ))
+        t_heal = time.monotonic()
+        budget_s = args.budget_ticks * args.tick
+        rdrv = DriverClosedLoop(ep, timeout=min(5.0, budget_s))
+        recovered = False
+        while time.monotonic() - t_heal < budget_s:
+            r = rdrv.put("fs_recovery", f"s{seed}")
+            if r.kind == "success":
+                recovered = True
+                break
+            rdrv._failover(r)
+        result["recovery_ticks"] = int(
+            (time.monotonic() - t_heal) / args.tick
+        )
+        if not recovered:
+            result["error"] = "no recovery after heal"
+            return result
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        result["num_ops"] = len(ops)
+        if mitigated:
+            if result["demotions"] < 1:
+                result["error"] = "mitigation armed but no demotion fired"
+                return result
+            if result["leader_after"] == victim:
+                result["error"] = (
+                    "demotion fired but the limping leader still leads"
+                )
+                return result
+        ok, diag = check_history(ops)
+        result["ok"] = bool(ok)
+        if not ok:
+            result["error"] = diag
+        return result
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if ep is not None:
+            try:
+                ep.leave()
+            except Exception:
+                pass
+        if cluster is not None:
+            cluster.stop()
+        if not result["ok"]:
+            dump = os.path.splitext(args.out)[0] + (
+                f"_failslow_{protocol}_{cls}_"
+                f"{'m' if mitigated else 'u'}_fail.json"
+            )
+            with open(dump, "w") as f:
+                json.dump(fail_bundle_doc(result, plan, None, ops),
+                          f, indent=1)
+            print(f"FAIL bundle -> {dump}")
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_failslow_pairs(pairs, args) -> list:
+    """Run (protocol, class) twin pairs and assert the mitigated twin
+    recovers >= FAILSLOW_TPUT_RATIO x the unmitigated throughput."""
+    rows = []
+    for protocol, cls in pairs:
+        mit = run_failslow(protocol, cls, True, args)
+        unmit = run_failslow(protocol, cls, False, args)
+        ratio = None
+        if mit.get("tput_fault") is not None \
+                and unmit.get("tput_fault") is not None:
+            ratio = round(
+                mit["tput_fault"] / max(unmit["tput_fault"], 1e-9), 2
+            )
+            mit["tput_ratio"] = ratio
+            if mit["ok"] and ratio < FAILSLOW_TPUT_RATIO:
+                mit["ok"] = False
+                mit["error"] = (
+                    f"mitigated throughput only {ratio}x the unmitigated "
+                    f"twin (need >= {FAILSLOW_TPUT_RATIO}x)"
+                )
+        for r in (mit, unmit):
+            status = "PASS" if r["ok"] else f"FAIL ({r.get('error')})"
+            print(f"=== failslow {r['protocol']}/{r['class']}/"
+                  f"{'mit' if r['mitigated'] else 'unmit'}: {status} "
+                  f"(steady={r.get('tput_steady')} fault="
+                  f"{r.get('tput_fault')} ratio={ratio} "
+                  f"demotions={r.get('demotions')})")
+        rows += [mit, unmit]
+    return rows
+
+
+def merge_rows(path: str, new_rows: list, replace_failslow: bool) -> list:
+    """Merge into an existing artifact: ``--failslow*`` runs replace the
+    fail-slow rows and keep the committed 12-cell matrix; ``--matrix``
+    does the reverse — so the two halves regenerate independently."""
+    old: list = []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                old = json.load(f)
+        except Exception:
+            old = []
+    kept = [
+        r for r in old
+        if bool(r.get("failslow")) != replace_failslow
+    ]
+    return kept + new_rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--protocol", default="MultiPaxos")
@@ -313,24 +583,47 @@ def main():
                     default=DEFAULT_BUDGET_TICKS,
                     help="recovery budget in server ticks after heal")
     ap.add_argument("--min-ops", type=int, default=20)
+    ap.add_argument("--failslow", default=None, metavar="CLASS",
+                    help="run ONE gray-failure twin pair (mitigated + "
+                         "mitigation-disabled) of this fail-slow class "
+                         f"({FAILSLOW_CLASSES}) against --protocol")
+    ap.add_argument("--failslow-matrix", action="store_true",
+                    help="run the full gray-failure matrix: "
+                         f"{FAILSLOW_CLASSES} x {FAILSLOW_PROTOCOLS}, "
+                         "each as a mitigated/unmitigated twin pair; "
+                         "rows merge into --out beside the fault matrix")
     ap.add_argument("--out", default=os.path.join(REPO, "NEMESIS.json"))
     args = ap.parse_args()
 
-    runs = (
-        [(p, s)
-         for p in MATRIX_PROTOCOLS + MATRIX_EXTRA for s in MATRIX_SEEDS]
-        if args.matrix else [(args.protocol, args.seed)]
-    )
-    results = []
-    for protocol, seed in runs:
-        r = run_one(protocol, seed, args)
-        status = "PASS" if r["ok"] else f"FAIL ({r.get('error')})"
-        print(f"=== {protocol} seed={seed}: {status} "
-              f"(ops={r.get('num_ops')}, "
-              f"recovery={r.get('recovery_ticks')} ticks)")
-        results.append(r)
+    if args.failslow or args.failslow_matrix:
+        pairs = (
+            [(p, c) for c in FAILSLOW_CLASSES for p in FAILSLOW_PROTOCOLS]
+            if args.failslow_matrix
+            else [(args.protocol, args.failslow)]
+        )
+        for _p, c in pairs:
+            if c not in FAILSLOW_CLASSES:
+                ap.error(f"unknown fail-slow class {c!r}")
+        results = run_failslow_pairs(pairs, args)
+        merged = merge_rows(args.out, results, replace_failslow=True)
+    else:
+        runs = (
+            [(p, s)
+             for p in MATRIX_PROTOCOLS + MATRIX_EXTRA
+             for s in MATRIX_SEEDS]
+            if args.matrix else [(args.protocol, args.seed)]
+        )
+        results = []
+        for protocol, seed in runs:
+            r = run_one(protocol, seed, args)
+            status = "PASS" if r["ok"] else f"FAIL ({r.get('error')})"
+            print(f"=== {protocol} seed={seed}: {status} "
+                  f"(ops={r.get('num_ops')}, "
+                  f"recovery={r.get('recovery_ticks')} ticks)")
+            results.append(r)
+        merged = merge_rows(args.out, results, replace_failslow=False)
     with open(args.out, "w") as f:
-        json.dump(results, f, indent=1)
+        json.dump(merged, f, indent=1)
     print(f"wrote {args.out}")
     sys.stdout.flush()
     sys.stderr.flush()

@@ -46,13 +46,26 @@ allow entries: both forward from their flags-gated window STATE —
 store-and-forward where the store is the gate — which this pass now
 verifies rather than assumes.)
 
-Known limitation (ROADMAP): the gate rules are polarity-insensitive — a
-*flags-derived* predicate clears taint regardless of which branch the
-dead-link (``flags == 0``) case selects, so an inverted gate like
-``jnp.where(valid, 0, inbox_lane)`` launders the lane.  Tracking gate
-polarity through comparisons / ``~`` / bit ops would close this; until
-then the pass is a high-signal lint over the idiomatic gating patterns,
-not a verified proof.
+Polarity (closes the ROADMAP polarity-insensitivity debt): every
+abstract value carries, besides ``sources``/``guard``, its ``dead``-
+world class — the value it takes in the all-links-dead world where the
+netmodel has zeroed ``flags``: a concrete number (``0`` for the flags
+leaf and anything arithmetically forced to zero), ``"nz"`` (known
+nonzero of unknown magnitude), or ``None`` (unknown).  The class is
+propagated through comparisons, ``~``/``not``, bit ops
+(``and``/``or``/``xor``), mask-multiplies, selects, and the structural/
+reduction primitives gates actually flow through.  Wherever the
+polarity IS tracked the gate rules are strict: a ``select_n`` whose
+flags-derived predicate is dead-world *zero* clears only the branches
+selected when the link is ALIVE — the dead-selected branch's sources
+survive — so an inverted gate like ``jnp.where(valid, 0, inbox_lane)``
+(which hands the lane to the dead-link case) no longer launders taint,
+and a provably-inverted mask (``~valid & lane``, dead-world nonzero)
+clears nothing.  A flags-derived gate whose dead-world class mixes with
+*state* (``tick_bal > s["prep_pbal"]`` — deciding it would need runtime
+invariants like ballot nonnegativity) keeps the prior optimistic
+clearing, documented weakening: over the tracked classes the pass is a
+proof, over state-entangled predicates it remains a high-signal lint.
 """
 
 from __future__ import annotations
@@ -77,10 +90,16 @@ EMPTY: FrozenSet[str] = frozenset()
 class Taint:
     sources: FrozenSet[str] = EMPTY
     guard: bool = False
+    # dead-world value class: what this value is in the all-links-dead
+    # world (the netmodel zeroed every flags element).  A concrete
+    # number means "provably equal to this", "nz" means "provably
+    # nonzero, magnitude unknown", None means unknown.  Gates clear
+    # taint ONLY when the polarity is tracked (see module docstring).
+    dead: Any = None
 
 
 CLEAN = Taint()
-GUARD = Taint(EMPTY, True)
+GUARD = Taint(EMPTY, True, 0)
 
 # primitives whose first operand selects among the rest
 _SELECT_PRIMS = frozenset({"select_n"})
@@ -88,12 +107,51 @@ _SELECT_PRIMS = frozenset({"select_n"})
 # ``or`` is deliberately NOT here — ``x | mask`` passes ``x`` through
 # when the mask is zero, which is exactly the dead-link case.
 _MASK_PRIMS = frozenset({"mul", "and"})
+# comparison primitives: polarity is decided by evaluating the compare
+# in the dead world when both operands' dead classes allow it
+_CMP_PRIMS = frozenset({"eq", "ne", "lt", "le", "gt", "ge"})
+# structural primitives that move a (uniform) value without changing it:
+# the dead class passes straight through
+_PRESERVE_PRIMS = frozenset({
+    "reshape", "broadcast_in_dim", "squeeze", "expand_dims", "transpose",
+    "rev", "copy", "stop_gradient", "convert_element_type", "slice",
+    "reduce_precision",
+})
+# reductions over a uniform dead-world value: or/and/max/min of v-with-
+# itself is v; sum/prod are only pinned when the value is zero
+_REDUCE_KEEP = frozenset({
+    "reduce_or", "reduce_and", "reduce_max", "reduce_min",
+})
+_REDUCE_ZERO = frozenset({"reduce_sum", "reduce_prod"})
 
 # loop-carry fixpoints converge because each round joins the carry with
 # its previous value (nondecreasing in a finite lattice); this cap only
 # backstops analysis bugs, and hitting it is itself reported as a pass
-# error rather than silently returning an under-approximation
+# error rather than silently returning an under-approximation.  The
+# ``dead`` component keeps the lattice finite: joins either agree (keep
+# the class) or collapse to None, a height-2 chain.
 _FIXPOINT_CAP = 10_000
+
+
+def _dead_zero(t: Taint) -> bool:
+    """Is this value provably zero in the dead world?  (`==` would let
+    False/0.0 sneak through "nz" — compare the class explicitly.)"""
+    return t.dead is not None and not isinstance(t.dead, str) and t.dead == 0
+
+
+def _dead_nonzero(t: Taint) -> bool:
+    return t.dead == "nz" or (
+        t.dead is not None and not isinstance(t.dead, str) and t.dead != 0
+    )
+
+
+def _join_dead(*deads):
+    """Value join: agreement keeps the class, disagreement is unknown."""
+    first = deads[0] if deads else None
+    for d in deads[1:]:
+        if d is None or first is None or d != first:
+            return None
+    return first
 
 
 def _join(*ts: Taint) -> Taint:
@@ -102,7 +160,50 @@ def _join(*ts: Taint) -> Taint:
     for t in ts:
         src |= t.sources
         guard |= t.guard
-    return Taint(frozenset(src), guard)
+    return Taint(frozenset(src), guard, _join_dead(*[t.dead for t in ts]))
+
+
+def _literal_dead(v):
+    """Dead-world class of a jaxpr literal: a literal is the same value
+    in every world, so a uniform array pins the class exactly."""
+    import numpy as np
+
+    try:
+        val = np.asarray(v.val)
+    except Exception:
+        return None
+    if val.size == 0:
+        return None
+    u = np.unique(val)
+    if len(u) != 1:
+        return None
+    x = u[0].item()
+    if isinstance(x, bool):
+        return int(x)
+    if isinstance(x, (int, float)) and x == x:  # not NaN
+        return x
+    return None
+
+
+def _cmp_dead(name: str, da, db):
+    """Evaluate a comparison in the dead world, or None if undecidable.
+    ``"nz"`` operands only decide equality against a concrete zero."""
+    import operator as op
+
+    fns = {"eq": op.eq, "ne": op.ne, "lt": op.lt, "le": op.le,
+           "gt": op.gt, "ge": op.ge}
+    if da is None or db is None:
+        return None
+    a_nz, b_nz = da == "nz", db == "nz"
+    if a_nz or b_nz:
+        other = db if a_nz else da
+        if not (a_nz and b_nz) and not isinstance(other, str) and other == 0:
+            if name == "eq":
+                return 0  # nonzero == 0 is False
+            if name == "ne":
+                return 1
+        return None
+    return int(fns[name](da, db))
 
 
 def _sub_jaxpr(obj):
@@ -127,7 +228,7 @@ class _Walker:
 
         def read(v) -> Taint:
             if isinstance(v, _Literal):
-                return CLEAN
+                return Taint(EMPTY, False, _literal_dead(v))
             return env.get(v, CLEAN)
 
         def write(v, t: Taint) -> None:
@@ -152,29 +253,146 @@ class _Walker:
         n_out = len(eqn.outvars)
         if name in _SELECT_PRIMS and ins:
             pred, cases = ins[0], ins[1:]
-            if pred.guard:
-                out = Taint(pred.sources, True)
+            sel = None  # the case the DEAD world selects, if known
+            if pred.guard and cases:
+                if _dead_zero(pred):
+                    sel = cases[0]
+                elif len(cases) == 2 and _dead_nonzero(pred):
+                    sel = cases[1]
+                elif (pred.dead is not None
+                      and not isinstance(pred.dead, str)
+                      and 0 <= int(pred.dead) < len(cases)):
+                    sel = cases[int(pred.dead)]
+            if sel is not None:
+                # polarity TRACKED: the dead world selects `sel`, so only
+                # ITS sources are consumed on a dead link — the alive-
+                # selected branches are cleared (that is the gate), and an
+                # inverted gate keeps the lane's taint alive
+                out = Taint(
+                    frozenset(pred.sources | sel.sources), True, sel.dead
+                )
+            elif pred.guard:
+                # flags-derived predicate whose dead-world class mixes
+                # with state: the prior optimistic clearing (documented
+                # weakening — see module docstring)
+                out = Taint(pred.sources, True, None)
             else:
                 out = _join(pred, *cases)
             return [out] * n_out
         if name in _MASK_PRIMS and len(ins) >= 2:
-            # an operand is gated when some OTHER operand is
-            # flags-derived: `mask & data` clears data's sources, and
-            # `gate & tainted_cmp` (both guarded) clears both — but a
-            # guarded-and-tainted value combined with a clean one keeps
-            # its taint (no new gate was applied to it)
+            # an operand is gated when some OTHER operand is flags-
+            # derived and NOT provably inverted: a dead-world-zero mask
+            # (`valid & data`) forces the dead case to 0 and clears; a
+            # provably-inverted mask (`~valid & data`, dead-world
+            # nonzero) passes the lane exactly on dead links and clears
+            # nothing; unknown polarity keeps the optimistic clearing
             src: Set[str] = set()
             for i, t in enumerate(ins):
-                if any(o.guard for j, o in enumerate(ins) if j != i):
+                if any(
+                    o.guard and not _dead_nonzero(o)
+                    for j, o in enumerate(ins) if j != i
+                ):
                     continue
                 src |= t.sources
+            deads = [t.dead for t in ins]
+            if any(_dead_zero(t) for t in ins):
+                dead = 0  # 0 & x == 0 * x == 0
+            elif all(
+                d is not None and not isinstance(d, str) for d in deads
+            ):
+                a = 1
+                for d in deads:
+                    a = (a * d) if name == "mul" else (int(a) & int(d))
+                dead = a
+            else:
+                dead = None
             return [
-                Taint(frozenset(src), any(t.guard for t in ins))
+                Taint(frozenset(src), any(t.guard for t in ins), dead)
             ] * n_out
+        if name in _CMP_PRIMS and len(ins) == 2:
+            a, b = ins
+            return [Taint(
+                frozenset(a.sources | b.sources), a.guard or b.guard,
+                _cmp_dead(name, a.dead, b.dead),
+            )] * n_out
+        if name == "not" and len(ins) == 1:
+            t = ins[0]
+            import numpy as np
+
+            dt = getattr(eqn.outvars[0].aval, "dtype", None)
+            logical = dt is not None and np.issubdtype(dt, np.bool_)
+            if t.dead is None:
+                dead = None
+            elif t.dead == "nz":
+                dead = 0 if logical else None  # ~(-1) == 0 for ints
+            elif logical:
+                dead = int(not t.dead)
+            else:
+                dead = ~int(t.dead)
+            return [Taint(t.sources, t.guard, dead)] * n_out
+        if name in ("or", "xor", "add", "sub", "max", "min") and ins:
+            return [self._arith(name, ins)] * len(eqn.outvars)
+        if name in _PRESERVE_PRIMS and len(ins) == 1:
+            return [ins[0]] * n_out
+        if name in _REDUCE_KEEP and len(ins) >= 1:
+            t = _join(*ins)
+            return [Taint(t.sources, t.guard, ins[0].dead)] * n_out
+        if name in _REDUCE_ZERO and len(ins) >= 1:
+            t = _join(*ins)
+            dead = 0 if _dead_zero(ins[0]) else None
+            return [Taint(t.sources, t.guard, dead)] * n_out
+        if name in ("gather", "dynamic_slice") and ins:
+            # element selection: every element shares the operand's
+            # (uniform) dead class; indices contribute sources only
+            t = _join(*ins)
+            return [Taint(t.sources, t.guard, ins[0].dead)] * n_out
+        if name in ("concatenate", "pad") and ins:
+            return [_join(*ins)] * n_out
         sub = self._sub_transfer(name, eqn, ins)
         if sub is not None:
             return sub
-        return [_join(*ins)] * n_out if ins else [CLEAN] * n_out
+        if not ins:
+            return [CLEAN] * n_out
+        t = _join(*ins)
+        # unmodeled primitive: sources/guard join as before, but the
+        # dead-world class is NOT claimed (claiming one could wrongly
+        # clear taint downstream; dropping one only costs precision)
+        return [Taint(t.sources, t.guard, None)] * n_out
+
+    @staticmethod
+    def _arith(name: str, ins: List[Taint]) -> Taint:
+        """Dead-class transfer for the bit/arith ops gates flow through:
+        concrete operands fold, zeros are identities for or/xor/add, a
+        nonzero bit-or stays nonzero; anything else is unknown."""
+        t = _join(*ins)
+        deads = [i.dead for i in ins]
+        conc = [
+            d for d in deads if d is not None and not isinstance(d, str)
+        ]
+        dead = None
+        if len(conc) == len(deads):
+            import operator as op
+
+            fns = {
+                "or": lambda a, b: int(a) | int(b),
+                "xor": lambda a, b: int(a) ^ int(b),
+                "add": op.add, "sub": op.sub, "max": max, "min": min,
+            }
+            a = conc[0]
+            for d in conc[1:]:
+                a = fns[name](a, d)
+            dead = a
+        elif name in ("or", "xor", "add"):
+            # concrete zeros are identities; a single surviving operand
+            # keeps its class, and or of known-nonzeros stays nonzero
+            rest = [i for i in ins if not _dead_zero(i)]
+            if len(rest) == 1:
+                dead = rest[0].dead
+            elif name == "or" and rest and all(
+                _dead_nonzero(i) for i in rest
+            ):
+                dead = "nz"
+        return Taint(t.sources, t.guard, dead)
 
     def _sub_transfer(self, name: str, eqn, ins):
         params = eqn.params

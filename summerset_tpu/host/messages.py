@@ -120,6 +120,12 @@ class CtrlReply:
     leader: Optional[int] = None
     conf: Optional[dict] = None
     done: Optional[List[int]] = None
+    # gather fan-outs with a per-server deadline mark the servers that
+    # did NOT answer in time here (slow-but-alive under fail-slow): the
+    # caller gets partial results immediately instead of waiting the
+    # full fan-out window on one limping replica, and the slow server
+    # is visible instead of silently absent
+    missing: Optional[List[int]] = None
     # per-server reply payloads gathered by the fan-out (metrics_dump:
     # sid -> telemetry snapshot); None for ack-only orchestration kinds
     payloads: Optional[Dict[int, Any]] = None

@@ -77,7 +77,25 @@ ALL_CLASSES = (
                    # point between the snapshot write and the WAL
                    # truncate (recovery must reconcile new snapshot +
                    # old WAL)
+    # -- gray failures (fail-slow: the victim stays alive enough to hold
+    # leadership/leases while tanking the group; host/health.py is the
+    # detection plane, voluntary leader demotion the mitigation) --
+    "slow_disk",   # StorageHub fsync/append latency inflated x `arg`
+                   # on targets for `duration` (a limping disk)
+    "slow_peer",   # egress token-bucket bandwidth cap + CPU-starve duty
+                   # cycle `arg` on targets (a rate-limited NIC / a
+                   # CPU-starved host) — distinct from `delay`, which
+                   # models the LINK in the receiver's messenger thread
+                   # and leaves the sender at full speed
+    "mem_pressure",  # bounded WAL write-back buffer (`arg` bytes): group
+                   # commit degrades to constant forced fsyncs + reclaim
+                   # stalls (memory pressure on the durability path)
 )
+
+# slow_peer host-lowering constants: the bandwidth cap is sized so a
+# 3-replica localhost mesh limps (frames stall tens of ms/tick) without
+# looking dead — heartbeats still land well inside election timeouts
+SLOW_PEER_BW = 48_000.0  # bytes/second egress
 
 # classes with no device-plane lowering: frame-level delay/duplication are
 # netmodel *config* (delay line depth), not per-tick masks, the WAL /
@@ -87,6 +105,12 @@ ALL_CLASSES = (
 HOST_ONLY = (
     "delay", "dup", "wal_torn", "wal_fsync", "conf_change",
     "take_snapshot",
+    # fail-slow classes are host-only like wal_*: the lockstep device
+    # plane has no notion of a replica running SLOWER than the tick (the
+    # closest device analog, duty-cycled aliveness, is already
+    # clock_skew) — disk latency, egress bandwidth, and allocator
+    # pressure live in the host hubs
+    "slow_disk", "slow_peer", "mem_pressure",
 )
 # instantaneous events: no heal action at tick + duration
 INSTANT = ("crash", "wal_torn", "wal_fsync", "conf_change",
@@ -176,6 +200,20 @@ class FaultPlan:
                 arg = round(rng.uniform(0.3, 0.8), 3)
             elif kind == "wal_fsync":
                 arg = float(rng.randint(1, 3))
+            elif kind == "slow_disk":
+                # latency inflation factor: severe enough to tank the
+                # victim's tick loop (one group-commit fsync per busy
+                # tick) while staying far from fail-stop
+                arg = float(rng.randint(10, 30))
+            elif kind == "slow_peer":
+                # CPU-starve duty cycle; the egress bandwidth cap rides
+                # along at SLOW_PEER_BW in the lowering
+                arg = round(rng.uniform(0.5, 0.85), 3)
+            elif kind == "mem_pressure":
+                # write-back buffer cap in BYTES: smaller than one
+                # tick's WAL records, so nearly every append forces an
+                # inline fsync + reclaim stall
+                arg = float(rng.choice((256, 512)))
             elif kind == "take_snapshot":
                 # ~1/3 of snapshots crash between the snapshot write and
                 # the WAL truncate — the window where a half-finished
@@ -194,6 +232,54 @@ class FaultPlan:
             )
             t += max(dur, 1) + gap
         return FaultPlan(seed, population, ticks, tuple(events))
+
+    @staticmethod
+    def failslow(
+        kind: str,
+        seed: int,
+        population: int,
+        ticks: int,
+        arg: Optional[float] = None,
+    ) -> "FaultPlan":
+        """Canonical single-event gray-failure plan for the fail-slow
+        soak matrix: one ``kind`` event starting a few ticks in and
+        holding until a short heal tail — long enough for detection,
+        demotion, and a post-mitigation throughput window to play out
+        WHILE the victim is still limping.
+
+        The event targets replica 0 as a placeholder; the soak runner
+        retargets it to the LIVE leader at fire time (the victim that
+        makes fail-slow a group-wide outage), exactly like the workload
+        soak's mid-burst leader crash.  The digest covers this canonical
+        form, so committed NEMESIS.json fail-slow rows stay replayable
+        per seed while the victim stays a runtime decision.
+        """
+        import random
+
+        if kind not in ("slow_disk", "slow_peer", "mem_pressure"):
+            raise ValueError(f"not a fail-slow class: {kind!r}")
+        import zlib
+
+        # stable per-class stream: str.__hash__ is process-randomized
+        # and would break the byte-identical-per-seed digest contract
+        rng = random.Random((seed << 8) ^ (zlib.crc32(kind.encode()) % 251))
+        onset = rng.randint(4, 8)
+        if arg is None:
+            # severities above the generate() ranges: the soak's twin
+            # cells must make the victim's tick unambiguously dominated
+            # by the limp (the >= 2x mitigated-throughput assertion),
+            # while staying far under election timeouts — gray, not dead
+            arg = {
+                "slow_disk": float(rng.randint(40, 60)),
+                "slow_peer": round(rng.uniform(0.7, 0.85), 3),
+                # pathological allocator: smaller than ANY WAL record,
+                # so every append pays a direct-reclaim flush
+                "mem_pressure": float(rng.choice((64, 128))),
+            }[kind]
+        heal_tail = max(6, ticks // 8)
+        dur = max(4, ticks - onset - heal_tail)
+        ev = FaultEvent(onset, kind, (0,), dur, float(arg))
+        return FaultPlan(seed, population, ticks, (ev,))
 
     # ------------------------------------------------------- determinism
     def timeline(self) -> str:
@@ -354,6 +440,25 @@ class FaultPlan:
             elif ev.kind == "take_snapshot":
                 acts.append((ev.tick, "take_snapshot", ev.render(),
                              {"servers": ts, "crash": bool(ev.arg)}))
+            elif ev.kind == "slow_disk":
+                acts.append((ev.tick, "wal", ev.render(),
+                             {"servers": ts, "spec": {"slow": ev.arg}}))
+                acts.append((end, "wal", f"@{end:05d} slow_disk heal"
+                             f" targets={ts}",
+                             {"servers": ts, "spec": None}))
+            elif ev.kind == "mem_pressure":
+                acts.append((ev.tick, "wal", ev.render(),
+                             {"servers": ts,
+                              "spec": {"mem": int(ev.arg)}}))
+                acts.append((end, "wal", f"@{end:05d} mem_pressure heal"
+                             f" targets={ts}",
+                             {"servers": ts, "spec": None}))
+            elif ev.kind == "slow_peer":
+                spec = {"bw": SLOW_PEER_BW, "starve": ev.arg}
+                acts.append((ev.tick, "net", ev.render(),
+                             {"per": {r: spec for r in ts}}))
+                acts.append((end, "net_clear", f"@{end:05d} slow_peer "
+                             f"heal targets={ts}", {"servers": ts}))
             elif ev.kind == "wal_torn":
                 acts.append((ev.tick, "wal", ev.render(),
                              {"servers": ts, "spec": {"torn": 1}}))

@@ -54,6 +54,7 @@ from ..utils.logging import pf_info, pf_logger, pf_warn
 from .codeword import assigned_sids
 from .control import ControlHub
 from .external import ExternalApi
+from .health import HealthScorer
 from .messages import ApiReply, ApiRequest, CtrlMsg, ShardPayload
 from .payload import PayloadStore
 from .statemach import CommandResult, StateMachine, apply_command
@@ -179,6 +180,28 @@ class ServerReplica:
             flight=self.flight,
         )
         self._trace_replied: List[Tuple[int, int]] = []
+        # gray-failure plane (host/health.py): the quorum-median outlier
+        # scorer over signals the hubs already emit.  health_enabled
+        # compiles the whole plane out; health_mitigation gates only the
+        # ACTIONS (leader demotion, lease-read steering) so the soak can
+        # run an observe-only twin of every fail-slow cell.
+        self.health_enabled = bool(cfg.pop("health_enabled", True))
+        self.health_mitigation = bool(cfg.pop("health_mitigation", True))
+        self.health_eval_ticks = max(1, int(cfg.pop("health_eval_ticks", 10)))
+        # demotion pacing: the demote kernel input stays armed for
+        # health_demote_ticks (long enough for peers to observe the
+        # abdication), and a new demotion cannot fire for
+        # health_cooldown_ticks (anti-flap, on top of the scorer's own
+        # hysteresis)
+        self.health_demote_ticks = int(cfg.pop("health_demote_ticks", 40))
+        self.health_cooldown_ticks = int(
+            cfg.pop("health_cooldown_ticks", 800)
+        )
+        _health_kw = {
+            k: cfg.pop(f"health_{k}")
+            for k in ("ratio", "hysteresis", "clear_after", "stale_s")
+            if f"health_{k}" in cfg
+        }
         # nemesis clock-skew: wall-clock stretch factor on the tick
         # interval (fault_ctl {"skew": f}); 1.0 = healthy
         self._tick_scale = 1.0
@@ -201,6 +224,28 @@ class ServerReplica:
         self.me = self.ctrl.me
         self.population = self.ctrl.population
         self.flight.me = self.me
+
+        # gray-failure scorer (host/health.py): beacons ride the tick
+        # frames, every replica assembles the same signal table, and the
+        # indicted leader discovers its own indictment locally
+        self.health = (
+            HealthScorer(self.me, self.population, **_health_kw)
+            if self.health_enabled else None
+        )
+        self._health_self_bad = False
+        # demotion state machine: _demote_until arms the kernel demote
+        # input; "revoking" means a QL/Bodega lease revoke (an empty-
+        # responders ConfChange through the revoke-then-adopt barrier)
+        # must complete before the abdication
+        self._demote_until = 0
+        self._demote_cooldown_until = 0
+        self._demote_revoke_deadline: Optional[int] = None
+        # pre-revoke responders (bitmask-decoded list), restored if the
+        # indictment clears while the revoke ConfChange is in flight —
+        # a false alarm must not leave lease-local reads revoked forever
+        self._demote_restore_resp: Optional[List[int]] = None
+        self.metrics.counter_add("leader_demotions", 0)
+        self.metrics.gauge_set("health_score", 1.0)
 
         # protocol kernel over [G, R]; host applier drives the exec bar
         kercfg_cls = type(
@@ -231,6 +276,12 @@ class ServerReplica:
                 "contract; refusing to serve it without durability "
                 "(see ProtocolKernel.DURABLE_SCALARS)"
             )
+        # leader demotion is kernel-assisted: only families declaring the
+        # `demote` input (MultiPaxos + Raft and their variants) get the
+        # mitigation path; leaderless/static kernels keep scoring only
+        self._demote_supported = (
+            "demote" in {n for n, _ in self.kernel.EXTRA_INPUTS}
+        )
         self.state = self.kernel.init_state(seed=0)
         # device metric lanes ride the jitted step's state (row `me` of
         # the [G, R, K] block is this server's [G, K] matrix; peers'
@@ -250,6 +301,7 @@ class ServerReplica:
         self.wal = StorageHub(
             self.wal_path, registry=self.metrics, flight=self.flight
         )
+        self.wal.health = self.health
         self.statemach = StateMachine()
         self.payloads = PayloadStore(self.G)
         self.applied = [0] * self.G        # exec floor per group (own row)
@@ -399,6 +451,7 @@ class ServerReplica:
                 self.me, self.population, p2p_addr,
                 registry=self.metrics, flight=self.flight,
             )
+            self.transport.health = self.health
             join = CtrlMsg("new_server_join", {
                 "protocol": protocol,
                 "api_addr": api_addr,
@@ -835,6 +888,7 @@ class ServerReplica:
         self.wal = StorageHub(
             self.wal_path, registry=self.metrics, flight=self.flight
         )
+        self.wal.health = self.health
         self._logged_vids = new_logged
         self._rebuild_logged_keys()
         self._sig = None  # conservative: next tick re-logs any drift
@@ -900,6 +954,12 @@ class ServerReplica:
         is_local_reader / bodega localread.rs:8-26)."""
         ex = self._last_extra
         if not ex:
+            return False
+        if self._health_self_bad and self.health_mitigation:
+            # responder mitigation: a limping replica stops serving
+            # lease-local reads — clients get the leader redirect instead
+            # of queueing behind a gray disk/NIC (the lease itself stays
+            # valid; this is steering, not revocation)
             return False
         K = getattr(self.kernel.config, "num_key_buckets", 0)
         if "lease_held" in ex:      # QuorumLeases
@@ -1425,6 +1485,12 @@ class ServerReplica:
                 "kv_need": bool(self.kv_need),
                 "ts": time.monotonic(),  # adaptive delivery sampling
             }
+            if self.health is not None:
+                # health beacon: own signal EWMAs + my observations of
+                # every peer's frame delay — each replica assembles the
+                # same R-row table, so the indicted leader sees its own
+                # indictment without any extra protocol
+                payload_msg["hb"] = self.health.beacon()
             cw_need_by_dst: Dict[int, list] = {}
             # the full-payload "need" plane stays on in codeword mode:
             # CRaft full-copy-fallback values are never encoded into any
@@ -1535,6 +1601,11 @@ class ServerReplica:
                 ),
             }
             self._conf_inputs(inputs)
+            if self._demote_supported:
+                dem = np.zeros((self.G, self.population), bool)
+                if self.tick < self._demote_until:
+                    dem[:, self.me] = True
+                inputs["demote"] = jnp.asarray(dem)
             if self._epaxos:
                 floors = np.zeros(
                     (self.G, self.population, self.population), np.int32
@@ -1568,6 +1639,7 @@ class ServerReplica:
             self._qread_expire()
             self._conf_progress()
             self._leader_edges(fx)
+            self._health_tick()
             _stage("apply")  # apply + reply
             # per-tick flight event: the loop_stage_us stopwatches become
             # child spans of this tick at export (the `step` stage is the
@@ -1630,6 +1702,8 @@ class ServerReplica:
         # cumulative — skipping one could drop a served payload)
         for src, fl in got.items():
             for f in fl or ():
+                if self.health is not None and "hb" in f:
+                    self.health.ingest(src, f["hb"], time.monotonic())
                 for (g, vid), batch in f.get("pp", {}).items():
                     self.payloads.install(g, vid, batch, overwrite=False)
                     self.missing.discard((g, vid))
@@ -1958,6 +2032,111 @@ class ServerReplica:
         elif g0 and self.tick - getattr(self, "_lead_announced", 0) >= 200:
             self.ctrl.send_ctrl(CtrlMsg("leader_status", {"step_up": True}))
             self._lead_announced = self.tick
+
+    # ------------------------------------------------------ gray failure
+    def _health_tick(self) -> None:
+        """Feed the scorer, and every ``health_eval_ticks`` run the
+        quorum-median outlier round.  When the verdict indicts THIS
+        replica: as a leader, step down voluntarily through the kernel's
+        own election machinery (QuorumLeases/Bodega first revoke their
+        lease responders through the conf plane's revoke-then-adopt
+        barrier); as a lease responder, ``_can_local_read`` starts
+        steering reads back to the leader.  Mitigation-disabled servers
+        (the soak's observe-only twins) still score and export
+        ``health_score`` — they just never act."""
+        h = self.health
+        if h is None:
+            return
+        h.end_tick(self.metrics.gauge_value("api_queue_depth", 0.0))
+        if self.tick % self.health_eval_ticks:
+            return
+        verdict = h.evaluate(time.monotonic())
+        self.metrics.gauge_set(
+            "health_score", verdict.scores.get(self.me, 1.0)
+        )
+        self._health_self_bad = self.me in verdict.indicted
+        if not (self.health_mitigation and self._demote_supported):
+            return
+        if self._demote_revoke_deadline is not None:
+            # an in-flight lease-revoke must RESOLVE either way — a
+            # frozen deadline would both strand the revoked responders
+            # and let a much-later indictment skip the barrier entirely
+            conf_idle = self._conf_active is None and not self._conf_queue
+            if not conf_idle and self.tick <= self._demote_revoke_deadline:
+                return  # still installing
+            self._demote_revoke_deadline = None
+            restore = self._demote_restore_resp
+            self._demote_restore_resp = None
+            if verdict.evaluated and not self._health_self_bad:
+                # false alarm: the indictment cleared while revoking —
+                # cancel the demotion and restore the pre-revoke
+                # responders so lease-local reads come back
+                if restore:
+                    self._handle_conf_req(None, ApiRequest(
+                        "conf", conf_delta={"responders": restore},
+                    ))
+                return
+            # still indicted (or beacons starved — the limp itself can
+            # do that): abdicate; lease TTLs make a straggling revoke
+            # safe, same as a leader crash
+            self._arm_demotion(verdict)
+            return
+        if not (verdict.evaluated and self._health_self_bad):
+            return
+        if self.tick < max(self._demote_cooldown_until, self._demote_until):
+            return
+        if not self._is_leader.any():
+            return  # responder indictment: steering only, no demotion
+        if self._conf_kind is not None:
+            # QuorumLeases/Bodega: revoke the read-lease responders
+            # FIRST (an empty-responders ConfChange through the existing
+            # revoke-then-adopt barrier) so lease-local reads drain
+            # cleanly instead of riding TTL expiry under a gone leader
+            self._demote_restore_resp = self._current_responders()
+            self._handle_conf_req(None, ApiRequest(
+                "conf", conf_delta={"responders": []},
+            ))
+            self._demote_revoke_deadline = self.tick + 600
+            pf_warn(
+                logger,
+                f"health: replica {self.me} (leader) indicted "
+                f"{verdict.outliers.get(self.me)} — revoking leases "
+                "before demotion",
+            )
+            return
+        self._arm_demotion(verdict)
+
+    def _current_responders(self) -> List[int]:
+        """The currently installed lease responders (group 0's conf —
+        the manager-tracking convention), for restore-on-false-alarm."""
+        if self._conf_kind == "ql":
+            bits = int(np.asarray(self.state["conf_cur"])[0, self.me])
+        elif self._conf_kind == "bodega":
+            bits = int(np.asarray(self.state["conf_resp"])[0, self.me, 0])
+        else:
+            return []
+        if bits <= 0:
+            return []
+        return [r for r in range(self.population) if bits >> r & 1]
+
+    def _arm_demotion(self, verdict) -> None:
+        """Arm the kernel ``demote`` input for this replica's rows and
+        stamp the demotion everywhere it must be attributable."""
+        self._demote_until = self.tick + self.health_demote_ticks
+        self._demote_cooldown_until = (
+            self._demote_until + self.health_cooldown_ticks
+        )
+        self.metrics.counter_add("leader_demotions")
+        self.flight.record(
+            "demote", tick=self.tick,
+            signals=",".join(verdict.outliers.get(self.me, ())),
+            score=verdict.scores.get(self.me, 0.0),
+        )
+        pf_warn(
+            logger,
+            f"health: replica {self.me} stepping down "
+            f"(outlier on {verdict.outliers.get(self.me)})",
+        )
 
     # ----------------------------------------------------------- control
     def _handle_ctrl(self) -> Optional[bool]:

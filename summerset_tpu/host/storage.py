@@ -216,7 +216,12 @@ class StorageHub:
         # wal_fsync events on the logger thread — the storage track of
         # the exported timeline (fsync spans carry batch + duration)
         self.flight = flight
+        # gray-failure seam (host/health.py HealthScorer): per-sync
+        # durability latency feeds the scorer's slow_disk / mem_pressure
+        # signals (attached by the server after construction)
+        self.health = None
         self._since_sync = 0
+        self._unsynced_bytes = 0  # mem_pressure bounded-buffer meter
         # disk fault injection (host/nemesis.py): a mutable spec consulted
         # by the logger thread before each action.  None = no faults.
         self._faults: Optional[dict] = None
@@ -236,10 +241,25 @@ class StorageHub:
           (``server._recover_from_wal``).
         - ``{"fsync_fail": n}`` — the next ``n`` sync points fail (EIO-
           style); the durability gate turns this into a crash as well.
+        - ``{"slow": f}`` — fail-slow ``slow_disk``: every durability
+          point (and sync append) takes ``f``x its measured time (floor
+          500us), paid as a sleep INSIDE the timed region so the
+          ``wal_fsync_us`` histogram — the health scorer's slow_disk
+          signal — sees the limp.  Duration-armed by the nemesis heal
+          action, or count-armed with ``{"slow": f, "slow_count": n}``
+          (self-clears after ``n`` inflated sync points, like
+          ``wal_fsync``).
+        - ``{"mem": cap}`` — fail-slow ``mem_pressure``: a bounded
+          allocator for the WAL write-back buffer.  Un-synced appended
+          bytes beyond ``cap`` force an inline durability point plus a
+          direct-reclaim stall (``mem_stall`` seconds, default 40ms)
+          before the append proceeds — a tiny buffer turns group commit
+          into constant forced fsyncs, the classic memory-pressure limp.
 
         ``seed`` is accepted for interface symmetry with
-        ``TransportHub.set_faults`` (the WAL faults are count-armed, not
-        probabilistic — a tear either happens at a schedule point or not).
+        ``TransportHub.set_faults`` (the WAL faults are count- or
+        duration-armed, not probabilistic — a tear either happens at a
+        schedule point or not).
         """
         del seed
         self._faults = dict(spec) if spec else None
@@ -305,18 +325,52 @@ class StorageHub:
             f["fsync_fail"] -= 1
             raise OSError("injected: fsync failed (EIO)")
 
+    def _slow_stall(self, elapsed: float, floor: float,
+                    is_sync: bool = True) -> float:
+        """Seconds of injected ``slow_disk`` stall for an op that took
+        ``elapsed`` seconds (0.0 when the fault is unarmed).  Sync
+        points decrement the optional ``slow_count`` arm, which
+        self-clears at zero (count-armed like ``wal_fsync``)."""
+        f = self._faults
+        if not f:
+            return 0.0
+        factor = float(f.get("slow", 0.0) or 0.0)
+        if factor <= 1.0:
+            return 0.0
+        # optional floor override: on tmpfs-backed test dirs the
+        # measured fsync is ~100us, so a pure multiplicative limp would
+        # be invisible — "slow_floor" pins the limping disk's per-op
+        # cost the way a real degraded device pins its minimum latency
+        floor = float(f.get("slow_floor", floor) or floor)
+        cnt = f.get("slow_count")
+        if cnt is not None and is_sync:
+            if cnt <= 0:
+                f.pop("slow", None)
+                return 0.0
+            f["slow_count"] = cnt - 1
+        return (factor - 1.0) * max(elapsed, floor)
+
     def _sync_point(self, fn):
         """Run a durability point, timing it and closing out the group-
-        commit batch opened by the appends since the last sync."""
+        commit batch opened by the appends since the last sync.  The
+        injected slow_disk inflation sleeps INSIDE the timed region so
+        the wal_fsync_us histogram reports the disk the replica actually
+        has — that histogram is the health plane's slow_disk signal."""
         reg = self.registry
-        if reg is None and self.flight is None:
-            return fn()
         t0 = time.monotonic()
         res = fn()
+        stall = self._slow_stall(time.monotonic() - t0, 500e-6)
+        if stall > 0:
+            time.sleep(stall)
+        self._unsynced_bytes = 0
+        if reg is None and self.flight is None and self.health is None:
+            return res
         dur = time.monotonic() - t0
         if reg is not None:
             reg.observe_s("wal_fsync_us", dur)
             reg.observe("wal_group_commit_batch", self._since_sync)
+        if self.health is not None:
+            self.health.note_fsync(dur)
         if self.flight is not None:
             self.flight.record(
                 "wal_fsync", dur_us=int(dur * 1e6),
@@ -339,17 +393,33 @@ class StorageHub:
         if a.kind == "append":
             if self.registry is not None:
                 self.registry.counter_add("wal_appends_total")
-            if self.registry is not None or self.flight is not None:
-                self._since_sync += 1
+            self._since_sync += 1
             if self.flight is not None:
                 self.flight.record("wal_append", sync=bool(a.sync))
+            # serialize OUTSIDE the timed region: wal_fsync_us must
+            # measure durability (write + fsync), not pickling CPU
+            data = pickle.dumps(a.entry)
+            f = self._faults
+            cap = int(f.get("mem", 0) or 0) if f else 0
+            if cap > 0 and self._unsynced_bytes + len(data) > cap:
+                # mem_pressure: the bounded write-back buffer is full —
+                # reclaim by forcing an inline durability point, plus
+                # the allocator's direct-reclaim stall (tens of ms is
+                # what real memory pressure costs a dirty-page writer).
+                # Timed like any sync point, so the per-tick durability
+                # cost the health beacon reports reflects the limp.
+                stall = float(f.get("mem_stall", 0.04) or 0.0)
+                self._sync_point(
+                    lambda: (b.sync(), time.sleep(stall))
+                )
             if a.sync:
-                # serialize OUTSIDE the timed region: wal_fsync_us must
-                # measure durability (write + fsync), not pickling CPU
-                data = pickle.dumps(a.entry)
                 end = self._sync_point(lambda: b.append(data, True))
             else:
-                end = b.append(pickle.dumps(a.entry), False)
+                end = b.append(data, False)
+                self._unsynced_bytes += len(data)
+                stall = self._slow_stall(0.0, 50e-6, is_sync=False)
+                if stall > 0:
+                    time.sleep(stall)
             return LogResult("append", end_offset=end)
         if a.kind == "write":
             if a.offset > b.size:

@@ -160,6 +160,10 @@ class MetricsRegistry:
     def counter_value(self, name: str, **labels) -> int:
         return self._counters.get(_key(name, labels), 0)
 
+    def gauge_value(self, name: str, default: float = 0.0, **labels):
+        with self._lock:
+            return self._gauges.get(_key(name, labels), default)
+
     def names(self) -> set:
         """Base metric names present (label suffixes stripped)."""
         with self._lock:
@@ -298,6 +302,14 @@ DECLARED = (
     "transport_frames_recv",
     "transport_bytes_recv",
     "transport_connects",
+    # gray-failure plane (host/health.py): per-peer frame-delivery
+    # latency histograms (the slow_peer signal), the replica's own
+    # health verdict gauge (1.0 healthy .. 0.0 indicted), and the
+    # demotion counter — pre-registered so "never limped" reads as
+    # healthy values, not missing series
+    "peer_ack_delay_us",
+    "health_score",
+    "leader_demotions",
     "wal_fsync_us",
     "wal_group_commit_batch",
     "wal_appends_total",

@@ -68,6 +68,10 @@ EVENT_TYPES = (
     "commit",        # slot passed the commit bar (g, vid, slot, tick)
     "apply",         # slot applied to the KV (g, vid, slot, tick)
     "fault_ctl",     # nemesis fault_ctl received (planes touched)
+    "demote",        # health plane indicted THIS replica's leadership and
+                     # the server voluntarily stepped down (signals, the
+                     # quorum-median table row, mitigation path) — the
+                     # demotion instant on the exported ctrl track
     "crash",         # supervisor-observed crash (error)
     "restart",       # bring-up recovery completed (wal records, applied
                      # floor; cold=True means first boot, empty backer)

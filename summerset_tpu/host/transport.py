@@ -103,6 +103,10 @@ class TransportHub:
         # number — it already rides the wire in every frame, so tx and rx
         # pair across two servers' dumps with no wire-format change
         self.flight = flight
+        # gray-failure seam (host/health.py HealthScorer): per-peer
+        # delivery-delay observations feed the scorer's slow_peer signal
+        # (attached by the server after construction; None = off)
+        self.health = None
         self._conns: Dict[int, socket.socket] = {}
         self._wlocks: Dict[int, threading.Lock] = {}
         # live-cluster fault injection (host/nemesis.py): a FrameFaults
@@ -298,9 +302,19 @@ class TransportHub:
                 # cross-host samples are dropped, see _same_host above)
                 ts = payload.get("ts") if isinstance(payload, dict) else None
                 if ts is not None and self._same_host.get(peer, False):
-                    self.samples.append(
-                        (peer, nbytes, (time.monotonic() - ts) * 1e3)
-                    )
+                    delay_s = time.monotonic() - ts
+                    self.samples.append((peer, nbytes, delay_s * 1e3))
+                    # per-peer ack/heartbeat latency: the frame delay IS
+                    # the heartbeat-delivery latency on the tick mesh —
+                    # the health scorer's slow_peer signal, and a
+                    # DECLARED histogram so a limping peer is visible in
+                    # every metrics_dump scrape
+                    if self.registry is not None:
+                        self.registry.observe_s(
+                            "peer_ack_delay_us", delay_s, peer=peer
+                        )
+                    if self.health is not None:
+                        self.health.note_peer_delay(peer, delay_s)
         except Exception:
             pf_warn(logger, f"peer {peer} connection lost")
             if self._conns.get(peer) is sock:
@@ -310,6 +324,8 @@ class TransportHub:
     # ------------------------------------------------------------ tick I/O
     def send_tick(self, tick: int, per_peer: Dict[int, Any]) -> None:
         """Send this tick's outbox slice to each connected peer."""
+        import time
+
         faults = self._faults
         for peer, payload in per_peer.items():
             sock = self._conns.get(peer)
@@ -323,6 +339,18 @@ class TransportHub:
                 if verdict == "dup":
                     copies = 2
             buf = safetcp.encode_frame((tick, payload))
+            if faults is not None:
+                # fail-slow slow_peer: the egress token bucket / CPU
+                # starve duty stalls the SENDER's tick loop — the host is
+                # limping, unlike `delay` which only slows the link in
+                # the receiver's messenger thread.  Stalled strictly
+                # AFTER the frame was stamped (payload "ts"), so peers'
+                # delivery-delay samples see the injected limp.
+                stall = faults.host_stall(
+                    copies * len(buf), time.monotonic()
+                )
+                if stall > 0:
+                    time.sleep(stall)
             try:
                 # graftlint: disable=H101 -- the per-peer write lock exists to serialize frame writers on one socket; it guards nothing else, so blocking inside it cannot deadlock other state
                 with self._wlocks[peer]:

@@ -49,6 +49,12 @@ class ClusterManager:
         self.ack_timeout = 30.0
         self.rejoin_timeout = 120.0
         self.settle_delay = 0.5
+        # gather fan-outs (metrics_dump / flight_dump) run against a
+        # TOTAL deadline instead of a per-reply 15s wait: one slow-but-
+        # alive server (fail-slow: its ctrl handling rides its limping
+        # tick loop) must not stall every scrape for the full window —
+        # the reply returns partial with the straggler in ``missing``
+        self.gather_timeout = 5.0
         self.servers: Dict[int, _ServerConn] = {}
         self.leader: Optional[int] = None
         self.conf: Optional[dict] = None
@@ -240,34 +246,60 @@ class ClusterManager:
         payload = dict(extra or {})
         done = []
         gathered: Dict[int, Any] = {}
+        # gather kinds return per-sid payloads; orchestration kinds ack
+        gather_key = {
+            "metrics_dump": "snapshot", "flight_dump": "flight",
+        }.get(kind)
+        # gather kinds run under a TOTAL per-request deadline (a limping
+        # server's ctrl replies ride its slowed tick loop — the scrape
+        # returns partial, marking it); orchestration kinds keep the
+        # 15s PER-REPLY wait they always had (their acks gate real
+        # process control, and serial-but-live acks must not share one
+        # window)
+        deadline = (
+            asyncio.get_event_loop().time() + self.gather_timeout
+            if gather_key is not None else None
+        )
+        want = set()
+        failed = []
         try:
-            want = set()
             for s in targets:
                 try:
                     await safetcp.send_msg(s.writer, CtrlMsg(kind, payload))
                     want.add(s.sid)
                 except (ConnectionError, OSError):
-                    # this target died mid-fan-out; the rest still count
+                    # this target died mid-fan-out; the rest still
+                    # count, but the dead sid must stay VISIBLE in
+                    # `missing` (neither done nor silently absent)
+                    failed.append(s.sid)
                     pf_warn(logger, f"{kind}: send to {s.sid} failed")
             while want:
-                sid, rp = await asyncio.wait_for(q.get(), timeout=15.0)
+                if deadline is not None:
+                    budget = deadline - asyncio.get_event_loop().time()
+                    if budget <= 0:
+                        raise asyncio.TimeoutError
+                else:
+                    budget = 15.0
+                sid, rp = await asyncio.wait_for(q.get(), timeout=budget)
                 if sid in want:
                     want.discard(sid)
                     done.append(sid)
                     gathered[sid] = rp
         except asyncio.TimeoutError:
-            pf_warn(logger, f"{kind}: timed out waiting for replies")
+            pf_warn(
+                logger,
+                f"{kind}: deadline hit; missing {sorted(want)} — "
+                "returning partial",
+            )
         finally:
             self._pending_replies[reply_kind].remove(q)
-        # gather kinds return per-sid payloads; orchestration kinds ack
-        gather_key = {
-            "metrics_dump": "snapshot", "flight_dump": "flight",
-        }.get(kind)
+        missing = sorted(set(want) | set(failed))
         if gather_key is not None:
-            return CtrlReply(kind, done=done, payloads={
+            return CtrlReply(kind, done=done, missing=missing,
+                             payloads={
                 sid: rp.get(gather_key) for sid, rp in gathered.items()
             })
-        return CtrlReply(kind, done=done)
+        return CtrlReply(kind, done=done, missing=missing)
 
     async def _reset_servers(self, req: CtrlRequest) -> CtrlReply:
         """Reset targets ONE AT A TIME, each step waiting for the old

@@ -125,6 +125,13 @@ class ReplicaConfigMultiPaxos:
 class MultiPaxosKernel(ProtocolKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val"})
 
+    # voluntary leader demotion (gray-failure mitigation): a [G, R] bool
+    # mask from the host — rows the health plane indicted abandon their
+    # prepared leadership and hold off re-campaigning (host/health.py is
+    # the detector, host/server.py the driver; the whole variant family
+    # — RSPaxos/Crossword/QuorumLeases/Bodega — inherits the same path)
+    EXTRA_INPUTS: Tuple[Tuple[str, str], ...] = (("demote", "gr"),)
+
     # durable acceptor record (host WAL contract; parity: the reference
     # fsyncs PrepareBal/AcceptData before AcceptReply, durability.rs:85-216)
     DURABLE_SCALARS = ("bal_max", "vote_bal", "vote_from", "vote_bar")
@@ -655,11 +662,33 @@ class MultiPaxosKernel(ProtocolKernel):
             return jnp.ones((self.G, self.R), jnp.bool_)
         return s["ll_left"] <= 0
 
+    def _apply_demote(self, s, c):
+        """Voluntary step-down (the fail-slow mitigation; the same
+        abdication MultiPaxos crash-failover already tolerates, entered
+        deliberately): rows flagged in the host ``demote`` input drop
+        their prepared ballot and any in-flight candidacy, then reload
+        their election countdown to a LONG holdoff — the limping
+        ex-leader goes quiet, a healthy peer's jittered hear-timeout
+        fires first, and the existing election machinery does the rest.
+        Lease safety needs nothing new: a silent ex-leader's follower
+        promises (and its own granted leases) lapse by countdown before
+        anyone can campaign, exactly as if it had crashed."""
+        dem = c.inputs.get("demote")
+        if dem is None:
+            return
+        d = dem.astype(jnp.bool_)
+        holdoff = jnp.int32(8 * self.config.hear_timeout_hi)
+        s["bal_prepared"] = jnp.where(d, 0, s["bal_prepared"])
+        s["bal_prep_sent"] = jnp.where(d, 0, s["bal_prep_sent"])
+        s["leader"] = jnp.where(d & (s["leader"] == c.rid), -1, s["leader"])
+        s["hb_cnt"] = jnp.where(d, holdoff, s["hb_cnt"])
+
     # ========== 7. election timeout -> campaign
     def _election(self, s, c):
         cfg = self.config
         W = self.W
         rid = c.rid
+        self._apply_demote(s, c)
         i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (
             s["bal_prepared"] > 0
         )

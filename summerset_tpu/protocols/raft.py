@@ -114,6 +114,13 @@ def _gather_slot(win_abs, win_field, slot):
 class RaftKernel(ProtocolKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_term", "bw_val"})
 
+    # voluntary leader demotion (gray-failure mitigation): same contract
+    # as the MultiPaxos family — a [G, R] bool mask from the host; the
+    # indicted leader reverts to follower and holds off re-campaigning
+    # (stepping down is always safe in Raft: it is the same transition
+    # an AppendEntries at a higher term forces)
+    EXTRA_INPUTS: Tuple[Tuple[str, str], ...] = (("demote", "gr"),)
+
     # durable acceptor record: Raft persists curr_term/voted_for metadata
     # plus the appended log tail (parity: raft/mod.rs:144-176 pack_meta +
     # DurEntry log entries) — a restarted replica must not double-vote in
@@ -501,10 +508,27 @@ class RaftKernel(ProtocolKernel):
         s["cand_term"] = jnp.where(stepdown, -1, s["cand_term"])
         s["match_bar"] = jnp.where(stepdown, s["commit_bar"], s["match_bar"])
 
+    def _apply_demote(self, s, c):
+        """Voluntary step-down (fail-slow mitigation): flagged rows
+        revert to follower — the transition a higher-term AppendEntries
+        would force, entered deliberately — abandon any candidacy, and
+        reload their election countdown to a long holdoff so a healthy
+        peer's jittered timeout campaigns first."""
+        dem = c.inputs.get("demote")
+        if dem is None:
+            return
+        d = dem.astype(jnp.bool_)
+        holdoff = jnp.int32(8 * self.config.hear_timeout_hi)
+        s["is_leader"] &= ~d
+        s["cand_term"] = jnp.where(d, -1, s["cand_term"])
+        s["leader"] = jnp.where(d & (s["leader"] == c.rid), -1, s["leader"])
+        s["hb_cnt"] = jnp.where(d, holdoff, s["hb_cnt"])
+
     # ========== 5. election timeout -> campaign
     def _election(self, s, c):
         W = self.W
         rid = c.rid
+        self._apply_demote(s, c)
         s["hb_cnt"] = jnp.where(s["is_leader"], s["hb_cnt"], s["hb_cnt"] - 1)
         # viability guard (cf. multipaxos `viable`): a replica whose log tail
         # already fills its ring window could never append the current-term

@@ -48,6 +48,20 @@ class FrameFaults:
     - ``delay``: {peer or "*": seconds} — added one-way ingress delay
                  (applied in the per-peer receive thread, so per-link
                  FIFO order is preserved — a slow link, not reordering).
+    - ``bw``:    bytes/second — token-bucket cap on TOTAL egress
+                 bandwidth; the deficit is paid as a sleep in the
+                 SENDER's tick loop (``TransportHub.send_tick``), so a
+                 rate-limited NIC backpressures the host it sits in.
+                 This is the fail-slow ``slow_peer`` host model: unlike
+                 ``delay`` (which slows the LINK in the receiver's
+                 messenger thread and leaves the sender at full speed),
+                 a bandwidth cap limps the replica itself while it stays
+                 alive enough to keep leases and leadership.
+    - ``starve``: fraction in [0, 1) — CPU-starvation duty cycle: the
+                 victim's send path sleeps ``f / (1 - f)`` times the
+                 real work time elapsed since the last send, i.e. the
+                 host only gets ``1 - f`` of the CPU.  Rides the same
+                 ``slow_peer`` nemesis class as ``bw``.
 
     Verdict draws come from one seeded ``random.Random`` behind a lock:
     the verdict *sequence* is deterministic per (spec, seed), which is
@@ -71,6 +85,54 @@ class FrameFaults:
         }
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
+        # fail-slow host faults (slow_peer): egress token bucket + CPU
+        # starve duty cycle, both consulted by TransportHub.send_tick
+        self._bw = float(self.spec.get("bw", 0.0) or 0.0)
+        self._starve = min(0.95, max(
+            0.0, float(self.spec.get("starve", 0.0) or 0.0)
+        ))
+        # gray, not dead: the per-call stall cap keeps the victim's
+        # heartbeats landing inside its peers' election timeouts — the
+        # whole point of fail-slow is a leader that LIMPS while holding
+        # leadership, so the stall must slow the tick loop ~10-20x, not
+        # freeze it (an unbounded token-bucket deficit would read as
+        # fail-stop and the ordinary election machinery would mask it)
+        self._stall_cap = float(self.spec.get("stall_cap", 0.04) or 0.04)
+        self._tokens = self._bw  # one second of headroom at arm time
+        self._t_last: Optional[float] = None
+        self._last_stall = 0.0
+
+    def host_stall(self, nbytes: int, now: float) -> float:
+        """Seconds the SENDER must stall before putting ``nbytes`` more
+        on the wire: the token-bucket deficit at the ``bw`` cap plus the
+        CPU-starve share of the WORK time elapsed since the last call
+        (the previously injected stall is subtracted out — feeding the
+        sleep back into the duty cycle would compound exponentially and
+        freeze the victim).  0.0 when neither knob is armed."""
+        if self._bw <= 0.0 and self._starve <= 0.0:
+            return 0.0
+        stall = 0.0
+        with self._lock:
+            dt = 0.0 if self._t_last is None else max(0.0, now - self._t_last)
+            self._t_last = now
+            if self._bw > 0.0:
+                # the bucket refills over the FULL elapsed time (real
+                # seconds pass while the victim sleeps)
+                self._tokens = min(
+                    self._bw, self._tokens + dt * self._bw
+                ) - float(nbytes)
+                if self._tokens < 0.0:
+                    stall += -self._tokens / self._bw
+            if self._starve > 0.0:
+                work = max(0.0, dt - self._last_stall)
+                stall += work * self._starve / (1.0 - self._starve)
+            stall = min(stall, self._stall_cap)
+            if self._bw > 0.0:
+                # deficit beyond what the capped stall repays is
+                # forgiven, or it would accumulate into a freeze anyway
+                self._tokens = max(self._tokens, -stall * self._bw)
+            self._last_stall = stall
+        return stall
 
     @staticmethod
     def _rate(table: Dict[str, float], peer: int) -> float:

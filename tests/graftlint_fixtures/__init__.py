@@ -96,6 +96,36 @@ class UnflaggedEffectsKernel(GoodKernel):
         )
 
 
+class InvertedGateKernel(GoodKernel):
+    """T1 (polarity): gates the inbox lane on a flags-DERIVED predicate
+    but selects the lane in the dead-link branch —
+    ``jnp.where(valid, 0, lane)`` — a gate with the right provenance and
+    the wrong polarity.  A polarity-insensitive pass laundered this; the
+    dead-world lattice catches it because ``valid`` is dead-world zero,
+    so the dead case selects the lane."""
+
+    name = "FixtureInvertedGate"
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        valid = (inbox["flags"] & jnp.uint32(1)) != 0
+        # the violation: the fallback/lane arms are swapped, so the
+        # dead-link (valid == False) case consumes the raw lane
+        s["shadow"] = jnp.max(
+            jnp.where(valid, 0, inbox["data"]), axis=2
+        )
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+    def init_state(self, seed: int = 0):
+        st = super().init_state(seed)
+        st["shadow"] = jnp.zeros((self.G, self.R), jnp.int32)
+        return st
+
+
 class StaleAllowKernel(GoodKernel):
     """T9: declares a suppression for a flow that never occurs."""
 
@@ -216,6 +246,7 @@ FIXTURES = {
     "fixturegood": GoodKernel,
     "fixturebrokenforwarder": BrokenForwarderKernel,
     "fixtureallowedforwarder": AllowedForwarderKernel,
+    "fixtureinvertedgate": InvertedGateKernel,
     "fixtureunflagged": UnflaggedInboxReadKernel,
     "fixtureunflaggedeffects": UnflaggedEffectsKernel,
     "fixturestaleallow": StaleAllowKernel,

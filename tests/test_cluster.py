@@ -58,6 +58,7 @@ class Cluster:
             protocol, ("127.0.0.1", self.srv_port),
             ("127.0.0.1", self.cli_port), n,
         )
+        self.manager = man  # tests tune orchestration budgets directly
 
         def run_man():
             from summerset_tpu.utils.loops import drain_and_close

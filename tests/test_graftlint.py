@@ -52,6 +52,9 @@ def test_good_fixture_is_clean():
     [
         # each broken kernel -> exactly its one expected fingerprint
         ("fixtureunflagged", verify_kernel_taint, ["229c835e7ed6"]),
+        # the inverted gate: flags-derived predicate, wrong polarity —
+        # the dead-link branch selects the lane (polarity lattice)
+        ("fixtureinvertedgate", verify_kernel_taint, ["93543304ce05"]),
         ("fixtureunflaggedeffects", verify_kernel_taint,
          ["670193535ccb"]),
         # the ungated relay hop: outbox leaves are sinks too
@@ -75,6 +78,7 @@ def test_broken_fixture_fingerprint(name, passfn, expected):
 def test_broken_fixtures_fail_only_their_rule():
     """The planted violation is the only one: the other pass stays clean."""
     assert verify_kernel(make_fixture, "fixtureunflagged").ok
+    assert verify_kernel(make_fixture, "fixtureinvertedgate").ok
     assert verify_kernel(make_fixture, "fixtureunflaggedeffects").ok
     assert verify_kernel(make_fixture, "fixturebrokenforwarder").ok
     assert verify_kernel_taint(make_fixture, "fixturefloatstate").ok
@@ -91,6 +95,67 @@ def test_allowed_forwarder_suppresses_outbox_sink():
     f, reason = res.suppressed[0]
     assert f.scope == "data->outbox.data"
     assert "relay" in reason
+
+
+def test_taint_double_negation_gate_is_clean():
+    """``jnp.where(~valid, fallback, lane)`` is a CORRECT gate — the
+    dead-link case (``~valid`` nonzero) selects the fallback.  The
+    polarity lattice must track the ``~`` instead of flagging every
+    negated predicate."""
+    import jax.numpy as jnp
+
+    from graftlint_fixtures import GoodKernel
+    from summerset_tpu.core.protocol import StepEffects
+
+    class DoubleNeg(GoodKernel):
+        name = "FixtureDoubleNeg"
+
+        def step(self, state, inbox, inputs):
+            s = dict(state)
+            valid = (inbox["flags"] & jnp.uint32(1)) != 0
+            best = jnp.max(
+                jnp.where(~valid, 0, inbox["data"]), axis=2
+            )
+            s["commit_bar"] = jnp.maximum(s["commit_bar"], best)
+            s["exec_bar"] = s["commit_bar"]
+            return s, self.zero_outbox(), StepEffects(
+                commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+            )
+
+    res = verify_kernel_taint(
+        lambda _n, *a, **k: DoubleNeg(*a, **k), "fixturedoubleneg"
+    )
+    assert res.ok, [f.render() for f in res.findings]
+
+
+def test_taint_inverted_mask_is_caught():
+    """``lane * ~valid`` passes the lane exactly on dead links — a
+    provably-inverted mask-multiply must not clear taint."""
+    import jax.numpy as jnp
+
+    from graftlint_fixtures import GoodKernel
+    from summerset_tpu.core.protocol import StepEffects
+
+    class InvMask(GoodKernel):
+        name = "FixtureInvMask"
+
+        def step(self, state, inbox, inputs):
+            s = dict(state)
+            valid = (inbox["flags"] & jnp.uint32(1)) != 0
+            masked = inbox["data"] * (~valid).astype(jnp.int32)
+            s["commit_bar"] = jnp.maximum(
+                s["commit_bar"], jnp.max(masked, axis=2)
+            )
+            s["exec_bar"] = s["commit_bar"]
+            return s, self.zero_outbox(), StepEffects(
+                commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+            )
+
+    res = verify_kernel_taint(
+        lambda _n, *a, **k: InvMask(*a, **k), "fixtureinvmask"
+    )
+    assert not res.ok
+    assert "data->commit_bar" in {f.scope for f in res.findings}
 
 
 def test_taint_while_cond_is_an_implicit_flow():

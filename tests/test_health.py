@@ -1,0 +1,396 @@
+"""Gray-failure plane tests: the health scorer's decision table, the
+fail-slow injectors, the kernel demote input, and the manager's
+partial-gather deadline.
+
+The decision-table half runs the scorer against synthetic beacon
+streams — the contract under test is exactly the one the soak relies
+on: a single limping outlier is indicted within the hysteresis budget,
+while uniform slowness, clock skew, election churn, and oscillating
+slowness never demote anyone.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from summerset_tpu.host.health import HealthScorer
+from summerset_tpu.host.nemesis import FaultPlan
+from summerset_tpu.host.storage import LogAction, StorageHub
+from summerset_tpu.utils.safetcp import FrameFaults
+
+
+# ---------------------------------------------------------------- helpers
+def make_scorer(**kw):
+    kw.setdefault("hysteresis", 3)
+    kw.setdefault("clear_after", 2)
+    return HealthScorer(0, 3, **kw)
+
+
+def feed(scorer, now, fsync_us, peers=None, obs=None):
+    """One 'tick' of signals: own fsync sample + one beacon per peer.
+
+    ``peers``: {sid: fsync_us}; ``obs``: {observer: {subject: delay_ms}}
+    (observer 0's entries land as local transport observations).
+    """
+    scorer.note_fsync(fsync_us / 1e6)
+    for subj, d in ((obs or {}).get(0) or {}).items():
+        scorer.note_peer_delay(subj, d / 1e3)
+    scorer.end_tick(queue_depth=0)
+    for sid, f in (peers or {}).items():
+        scorer.ingest(sid, {
+            "f": f, "w": f, "q": 0.0,
+            "o": (obs or {}).get(sid, {}),
+        }, now)
+
+
+# ----------------------------------------------------- decision table --
+class TestDecisionTable:
+    def test_single_outlier_self_indicted_within_budget(self):
+        """The limping replica (us: 40ms fsyncs vs the quorum's ~1ms)
+        is indicted after exactly ``hysteresis`` consecutive bad
+        evaluations — the detection budget the soak's demotion rides."""
+        s = make_scorer()
+        rounds = 0
+        for i in range(10):
+            feed(s, float(i), 40_000.0, peers={1: 1000.0, 2: 1200.0})
+            v = s.evaluate(float(i))
+            assert v.evaluated
+            rounds += 1
+            if 0 in v.indicted:
+                break
+        assert s.self_indicted
+        assert rounds == s.hysteresis
+
+    def test_uniform_slowness_never_indicts(self):
+        """A loaded box slows EVERYONE: the quorum median moves with the
+        signal, so the relative outlier rule — explicitly not an
+        absolute threshold — stays quiet."""
+        s = make_scorer()
+        for i in range(10):
+            feed(s, float(i), 50_000.0,
+                 peers={1: 48_000.0, 2: 55_000.0})
+            v = s.evaluate(float(i))
+            assert v.evaluated
+            assert v.indicted == [], v.outliers
+        assert not s.self_indicted
+
+    def test_clock_skew_never_indicts(self):
+        """clock_skew stretches the victim's tick INTERVAL, not its
+        per-op latencies: fsync duration, frame stamp-to-delivery, and
+        queue depth all stay healthy — only its rate drops, which no
+        health signal measures.  Healthy per-op signals at skewed
+        cadence must never indict."""
+        s = make_scorer()
+        now = 0.0
+        for i in range(10):
+            # the skewed replica reports (and is observed) at a slower
+            # cadence, but every value is nominal
+            now += 3.0 if i % 3 == 0 else 0.2
+            feed(s, now, 900.0, peers={1: 1000.0, 2: 1100.0},
+                 obs={1: {0: 2.0, 2: 1.5}, 2: {0: 2.5, 1: 1.0},
+                      0: {1: 1.2, 2: 1.9}})
+            v = s.evaluate(now)
+            assert v.indicted == [], (v.outliers, v.table)
+
+    def test_no_quorum_no_verdict(self):
+        """A partition minority (or the churn window of an election
+        taking peers' frames away) starves the scorer of fresh beacons:
+        nothing is evaluated, nothing indicted — absence of evidence
+        never indicts, however loud our own signals are."""
+        s = make_scorer()
+        for i in range(8):
+            feed(s, float(i), 80_000.0)  # no peer beacons at all
+            v = s.evaluate(float(i))
+            assert not v.evaluated
+            assert v.indicted == []
+
+    def test_election_churn_resets_streak(self):
+        """Two bad rounds, then beacons vanish (a legitimate election's
+        frame churn): the streak resets, so the two pre-election rounds
+        can never combine with a post-election round into a demotion."""
+        s = make_scorer()
+        for i in range(2):
+            feed(s, float(i), 40_000.0, peers={1: 1000.0, 2: 1200.0})
+            assert s.evaluate(float(i)).indicted == []
+        # churn: stale beacons (no ingest for > stale_s)
+        v = s.evaluate(100.0)
+        assert not v.evaluated
+        # back to healthy signals: one more bad round must NOT indict
+        s._fsync_us = 0.0
+        feed(s, 101.0, 40_000.0, peers={1: 1000.0, 2: 1200.0})
+        v = s.evaluate(101.0)
+        assert v.evaluated and v.indicted == []
+
+    def test_oscillating_slowness_never_flaps(self):
+        """Slowness that clears between evaluations resets the bad
+        streak every healthy round: with hysteresis 3, alternating
+        bad/good rounds never reach an indictment."""
+        s = make_scorer()
+        for i in range(20):
+            bad = i % 2 == 0
+            # EWMAs are sticky; drive the own-signal directly so the
+            # oscillation is visible at evaluation granularity
+            s._fsync_us = 40_000.0 if bad else 900.0
+            s._wal_tick_us = 40_000.0 if bad else 900.0
+            s._have_own = True
+            s.ingest(1, {"f": 1000.0, "w": 1000.0, "q": 0, "o": {}},
+                     float(i))
+            s.ingest(2, {"f": 1200.0, "w": 1200.0, "q": 0, "o": {}},
+                     float(i))
+            v = s.evaluate(float(i))
+            assert v.indicted == [], f"flapped at round {i}"
+
+    def test_indictment_clears_after_recovery(self):
+        s = make_scorer()
+        for i in range(4):
+            feed(s, float(i), 40_000.0, peers={1: 1000.0, 2: 1200.0})
+            s.evaluate(float(i))
+        assert s.self_indicted
+        s._fsync_us = 900.0
+        s._wal_tick_us = 900.0
+        for i in range(4, 4 + s.clear_after):
+            s.ingest(1, {"f": 1000.0, "w": 1000.0, "q": 0, "o": {}},
+                     float(i))
+            s.ingest(2, {"f": 1200.0, "w": 1200.0, "q": 0, "o": {}},
+                     float(i))
+            s.end_tick(0)
+            v = s.evaluate(float(i))
+        assert not s.self_indicted
+        assert v.scores[0] == 1.0
+
+    def test_peer_delay_is_observer_median(self):
+        """delay_ms[r] comes from the OBSERVERS of r (median), so a
+        limping replica cannot hide its egress delay by self-reporting:
+        both peers see replica 0's frames arriving ~80ms late."""
+        s = make_scorer()
+        for i in range(6):
+            feed(s, float(i), 900.0, peers={1: 1000.0, 2: 1100.0},
+                 obs={1: {0: 80.0, 2: 2.0}, 2: {0: 90.0, 1: 1.5},
+                      0: {1: 1.0, 2: 1.0}})
+            v = s.evaluate(float(i))
+            if 0 in v.indicted:
+                break
+        assert s.self_indicted
+        assert "delay_ms" in v.outliers.get(0, [])
+
+
+# --------------------------------------------------- fail-slow injectors
+class TestFailSlowInjection:
+    def test_slow_disk_inflates_sync_latency(self, tmp_path):
+        hub = StorageHub(str(tmp_path / "w.wal"), prefer_native=False)
+        try:
+            t0 = time.monotonic()
+            hub.do_sync_action(LogAction("append", entry=b"x" * 64,
+                                         sync=True))
+            fast = time.monotonic() - t0
+            hub.set_faults({"slow": 6.0, "slow_floor": 0.01})
+            t0 = time.monotonic()
+            hub.do_sync_action(LogAction("append", entry=b"y" * 64,
+                                         sync=True))
+            slow = time.monotonic() - t0
+            # (factor - 1) * floor = 50ms of injected stall minimum
+            assert slow >= fast + 0.045, (fast, slow)
+            hub.set_faults(None)
+            t0 = time.monotonic()
+            hub.do_sync_action(LogAction("append", entry=b"z" * 64,
+                                         sync=True))
+            assert time.monotonic() - t0 < 0.045
+        finally:
+            hub.stop()
+
+    def test_slow_disk_count_armed_self_clears(self, tmp_path):
+        hub = StorageHub(str(tmp_path / "w.wal"), prefer_native=False)
+        try:
+            hub.set_faults({"slow": 6.0, "slow_floor": 0.01,
+                            "slow_count": 1})
+            t0 = time.monotonic()
+            hub.do_sync_action(LogAction("append", entry=b"a", sync=True))
+            assert time.monotonic() - t0 >= 0.045
+            t0 = time.monotonic()
+            hub.do_sync_action(LogAction("append", entry=b"b", sync=True))
+            assert time.monotonic() - t0 < 0.045  # count exhausted
+        finally:
+            hub.stop()
+
+    def test_mem_pressure_forces_reclaim_flushes(self, tmp_path):
+        from summerset_tpu.host.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        hub = StorageHub(str(tmp_path / "w.wal"), prefer_native=False,
+                         registry=reg)
+        try:
+            for i in range(4):
+                hub.do_sync_action(LogAction(
+                    "append", entry=b"q" * 300, sync=False))
+            base = reg.hist("wal_fsync_us")
+            base_n = 0 if base is None else base.count
+            hub.set_faults({"mem": 64, "mem_stall": 0.001})
+            for i in range(4):
+                hub.do_sync_action(LogAction(
+                    "append", entry=b"q" * 300, sync=False))
+            h = reg.hist("wal_fsync_us")
+            # every append overflowed the 64-byte buffer: 4 forced
+            # durability points where the unarmed run had none
+            assert h is not None and h.count >= base_n + 4
+        finally:
+            hub.stop()
+
+    def test_frame_faults_bw_token_bucket(self):
+        f = FrameFaults({"bw": 1000.0, "stall_cap": 10.0}, seed=0)
+        t = 100.0
+        assert f.host_stall(500, t) == 0.0       # within the bucket
+        s = f.host_stall(1000, t)                # 500 short @ 1000 B/s
+        assert 0.45 <= s <= 0.55
+        # the repaid deficit refills during the (simulated) sleep
+        assert f.host_stall(0, t + s) == pytest.approx(0.0, abs=1e-6)
+
+    def test_frame_faults_starve_excludes_own_sleep(self):
+        f = FrameFaults({"starve": 0.5, "stall_cap": 10.0}, seed=0)
+        assert f.host_stall(0, 0.0) == 0.0       # no elapsed work yet
+        s = f.host_stall(0, 1.0)                  # 1s of work at duty 0.5
+        assert s == pytest.approx(1.0, rel=0.01)
+        # next call after exactly the injected sleep: zero NEW work, so
+        # zero new stall — no exponential feedback
+        assert f.host_stall(0, 1.0 + s) == pytest.approx(0.0, abs=1e-3)
+
+    def test_frame_faults_stall_is_capped(self):
+        f = FrameFaults({"bw": 10.0, "starve": 0.9}, seed=0)
+        f.host_stall(0, 0.0)
+        assert f.host_stall(10_000, 50.0) <= f._stall_cap + 1e-9
+
+    def test_failslow_plan_classes_and_lowering(self):
+        plan = FaultPlan.generate(
+            11, 3, 120,
+            classes=("slow_disk", "slow_peer", "mem_pressure"),
+        )
+        assert plan.timeline() == FaultPlan.generate(
+            11, 3, 120,
+            classes=("slow_disk", "slow_peer", "mem_pressure"),
+        ).timeline()
+        acts = plan.host_actions()
+        kinds = {a for _t, a, _d, _s in acts}
+        assert kinds <= {"wal", "net", "net_clear"}
+        # every duration event heals: wal faults clear with spec None,
+        # net faults with net_clear
+        wal_sets = [s for _t, a, _d, s in acts
+                    if a == "wal" and s["spec"] is not None]
+        wal_clears = [s for _t, a, _d, s in acts
+                      if a == "wal" and s["spec"] is None]
+        assert len(wal_sets) == len(wal_clears)
+        # fail-slow classes never lower to device masks (host-only)
+        dev = plan.compile_device(2)
+        assert dev["alive"].all() and dev["link_up"].all()
+
+    def test_failslow_canonical_plan_digest(self):
+        a = FaultPlan.failslow("slow_disk", 1, 3, 80)
+        b = FaultPlan.failslow("slow_disk", 1, 3, 80)
+        assert a.digest() == b.digest()
+        assert a.events[0].kind == "slow_disk"
+        assert a.digest() != FaultPlan.failslow(
+            "slow_peer", 1, 3, 80
+        ).digest()
+        with pytest.raises(ValueError):
+            FaultPlan.failslow("crash", 1, 3, 80)
+
+
+# ------------------------------------------------------- kernel demote --
+@pytest.mark.parametrize("proto", ["multipaxos", "raft"])
+def test_kernel_demote_abdicates_and_successor_wins(proto):
+    """The shared demotion contract at the kernel level: arming the
+    ``demote`` input for the warm-start leader's rows makes it abandon
+    leadership, hold off re-campaigning, and a healthy peer wins the
+    ordinary election."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from summerset_tpu.core.engine import Engine
+    from summerset_tpu.protocols import make_protocol
+
+    k = make_protocol(proto, 1, 3, 32)
+    eng = Engine(k)
+    state, ns = eng.init()
+    G, R = 1, 3
+
+    def seq(ticks, demote_row=None):
+        s = {
+            "n_proposals": jnp.zeros((ticks, G), jnp.int32),
+            "value_base": jnp.zeros((ticks, G), jnp.int32),
+            "demote": jnp.zeros((ticks, G, R), bool),
+        }
+        if demote_row is not None:
+            d = np.zeros((ticks, G, R), bool)
+            d[:3, :, demote_row] = True
+            s["demote"] = jnp.asarray(d)
+        return s
+
+    state, ns, _ = eng.run_ticks(state, ns, seq(30))
+    assert int(np.asarray(state["leader"])[0, 0]) == 0
+    state, ns, _ = eng.run_ticks(state, ns, seq(250, demote_row=0))
+    lead = np.asarray(state["leader"])[0]
+    if "is_leader" in state:
+        isl = np.asarray(state["is_leader"])[0]
+    else:
+        isl = (
+            (np.asarray(state["bal_prepared"])[0]
+             == np.asarray(state["bal_max"])[0])
+            & (np.asarray(state["bal_prepared"])[0] > 0)
+        )
+    assert not isl[0], "demoted leader still leads"
+    assert isl.any(), "no successor elected"
+    assert (lead != 0).all(), lead
+
+
+# ----------------------------------------- manager partial gather (live)
+def test_gather_partial_results_under_slow_peer(tmp_path):
+    """``metrics_dump`` under a slow-but-alive server: the gather's
+    per-request deadline returns partial results with the straggler
+    marked in ``missing`` instead of stalling the scrape for the full
+    fan-out window (the limping server's ctrl replies ride its slowed
+    tick loop)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import GenericEndpoint
+    from summerset_tpu.host.messages import CtrlRequest
+
+    cluster = Cluster("MultiPaxos", 3, str(tmp_path), tick=0.005)
+    try:
+        cluster.manager.gather_timeout = 1.0
+        ep = GenericEndpoint(cluster.manager_addr)
+        ep.connect()
+        DriverClosedLoop(ep, timeout=10.0).checked_put("warm", "1")
+        victim = sorted(cluster.replicas)[-1]
+        # a brutal slow_peer: every send stalls seconds, so the victim's
+        # tick loop (and with it its ctrl handling) crawls
+        ep.ctrl.request(CtrlRequest(
+            "inject_faults", servers=[victim],
+            payload={"net": {"starve": 0.95, "stall_cap": 5.0,
+                             "bw": 1.0}},
+        ))
+        time.sleep(1.0)
+        t0 = time.monotonic()
+        rep = ep.ctrl.request(CtrlRequest("metrics_dump"), timeout=30.0)
+        took = time.monotonic() - t0
+        assert took < 6.0, f"gather stalled {took:.1f}s on the straggler"
+        healthy = {s for s in cluster.replicas if s != victim}
+        assert healthy <= set(rep.payloads or {}), rep.payloads
+        if victim not in (rep.payloads or {}):
+            assert victim in (rep.missing or []), rep.missing
+        ep.ctrl.request(CtrlRequest(
+            "inject_faults", servers=[victim], payload={"net": None},
+        ))
+        ep.leave()
+    finally:
+        cluster.stop()
