@@ -1,4 +1,4 @@
-"""Headline benchmark: batched MultiPaxos commit throughput on one chip.
+"""Headline benchmark: batched MultiPaxos commit throughput.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -12,8 +12,20 @@ frequency (summerset_client/src/clients/bench.rs) with the host I/O plane
 detached: every tick each group is offered `P` new commands; the measured
 quantity is committed consensus slots (quorum-replicated, in-order) per
 wall-clock second.
+
+Pod-scale mesh (``--mesh GxR`` / env ``BENCH_MESH``): shards the group
+axis (and optionally the replica axis) over a ``(group, replica)``
+device mesh (core/sharding.py) and runs the steady-state windows with
+the scan carry DONATED — ticks are device-resident, the host never
+round-trips the ``[G, R, ...]`` state.  The artifact stamps the mesh
+shape, per-device group count, and the donation introspection, and its
+``ok`` self-verdict fails a mesh run whose carry was not actually
+aliased.  On CPU, ``--mesh`` builds the virtual host-platform mesh
+(utils/jaxcompat set_cpu_devices) so the multi-device path stays
+reproducible while the TPU tunnel is down.
 """
 
+import argparse
 import json
 import os
 import subprocess
@@ -73,6 +85,8 @@ def _cpu_fallback(err: str) -> int:
     measurement."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env["BENCH_BACKEND_NOTE"] = f"cpu fallback: {err}"
+    # a requested mesh survives the fallback: the child builds the same
+    # GxR shape as a virtual CPU mesh (argparse defaults from BENCH_MESH)
     # explicit BENCH_* overrides still win; otherwise shrink to a shape a
     # CPU finishes in seconds rather than the 4096-group TPU headline
     env.setdefault("BENCH_GROUPS", "256")
@@ -108,6 +122,31 @@ def _cpu_fallback(err: str) -> int:
 
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--mesh", default=os.environ.get("BENCH_MESH", ""),
+        help="GxR (group_shards x replica_shards) device mesh, e.g. 4x2 "
+             "on a v5e-8; empty = the single-device legacy path.  On "
+             "CPU a virtual host-platform mesh of that size is built.",
+    )
+    args = ap.parse_args()
+    mesh_shape = None
+    if args.mesh:
+        # the canonical jax-free grammar (summerset_tpu.utils.jaxcompat
+        # — importing summerset_tpu.core here would initialize the
+        # backend and lock the device count): a malformed spec fails
+        # fast, before the probe/fallback machinery spins up
+        from summerset_tpu.utils.jaxcompat import parse_mesh
+
+        mesh_shape = parse_mesh(args.mesh)
+        # the fallback child re-execs without argv: carry the spec in env
+        os.environ["BENCH_MESH"] = args.mesh
+    else:
+        # an explicit --mesh "" must also override an inherited
+        # BENCH_MESH for the fallback child, or parent and child would
+        # disagree about the mesh
+        os.environ.pop("BENCH_MESH", None)
+
     # An explicit CPU run (A/B sweeps, verification) can't hang on the
     # tunnel — skip the probe and its extra interpreter+backend bring-up.
     err = None
@@ -116,10 +155,25 @@ def main():
     if err is not None:
         sys.exit(_cpu_fallback(err))
 
+    if mesh_shape is not None and os.environ.get(
+        "JAX_PLATFORMS", ""
+    ) in ("", "cpu"):
+        # grow the virtual CPU platform to the mesh size BEFORE anything
+        # initializes the backend.  Also applied when the platform is
+        # unset (a CPU-only host that passed the probe): the
+        # host-platform device count is harmless on a real accelerator
+        # backend and required on CPU.
+        from summerset_tpu.utils.jaxcompat import set_cpu_devices
+
+        need = mesh_shape[0] * mesh_shape[1]
+        if need > 1:
+            set_cpu_devices(need)
+
     import jax
     import numpy as np
 
     from summerset_tpu.core import Engine
+    from summerset_tpu.core import sharding as shardlib
     from summerset_tpu.protocols import make_protocol
     from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
 
@@ -132,28 +186,39 @@ def main():
         exec_follows_commit=False,
     )
     kernel = make_protocol("multipaxos", GROUPS, POPULATION, WINDOW, cfg)
-    eng = Engine(kernel)
+    mesh = None
+    if mesh_shape is not None:
+        mesh = shardlib.mesh_for(*mesh_shape)
+    eng = Engine(kernel, mesh=mesh)  # sharded mode donates the carry
     state, ns = eng.init()
+    carry_leaves = len(jax.tree.leaves((state, ns)))
 
-    # warmup with the SAME static (TICKS, P) so the timed calls below hit
-    # the compile cache (a different tick count would recompile the scan
-    # inside the timed region), and run reaches steady state
-    state, ns = eng.run_synthetic(state, ns, TICKS, PROPOSALS_PER_TICK)
+    # AOT-compile the scanned window ONCE and reuse the executable for
+    # warmup + every timed run: no recompile can land inside the timed
+    # region, and the compiled artifact is what the donation stamp
+    # introspects (profiling.donation_stats)
+    comp = eng.lower_synthetic(state, ns, TICKS, PROPOSALS_PER_TICK) \
+              .compile()
+    state, ns = comp(state, ns)
     jax.block_until_ready(state["commit_bar"])
 
     rate = 0.0
     for _ in range(RUNS):
         start = np.asarray(state["commit_bar"]).max(axis=1).sum()
         t0 = time.perf_counter()
-        state, ns = eng.run_synthetic(state, ns, TICKS, PROPOSALS_PER_TICK)
+        state, ns = comp(state, ns)
         jax.block_until_ready(state["commit_bar"])
         dt = time.perf_counter() - t0
         end = np.asarray(state["commit_bar"]).max(axis=1).sum()
         rate = max(rate, float(end - start) / dt)
+    ndev = (mesh_shape[0] * mesh_shape[1]) if mesh_shape else 1
     doc = {
         "metric": (
             f"committed slots/sec, MultiPaxos {POPULATION}-replica x "
-            f"{GROUPS} groups, 1 chip ({jax.devices()[0].platform})"
+            f"{GROUPS} groups, "
+            + (f"{ndev} device(s) mesh {mesh_shape[0]}x{mesh_shape[1]}"
+               if mesh_shape else "1 chip")
+            + f" ({jax.devices()[0].platform})"
         ),
         "value": round(rate, 1),
         "unit": "slots/sec",
@@ -164,6 +229,18 @@ def main():
         # lesson — rc=1 with 0 slots/s sat unnoticed in the trajectory)
         "ok": rate > 0,
     }
+    if mesh_shape is not None:
+        from summerset_tpu.host.profiling import donation_stats
+
+        gs, rs = mesh_shape
+        don = donation_stats(comp)
+        doc["mesh"] = dict(
+            shardlib.mesh_stamp(gs, rs, GROUPS),
+            donation=dict(don, carry_leaves=carry_leaves),
+        )
+        # a mesh capture whose carry was NOT donated is a failed capture:
+        # it silently re-ships the [G, R, ...] state every window
+        doc["ok"] = doc["ok"] and don["aliased_buffers"] == carry_leaves
     note = os.environ.get("BENCH_BACKEND_NOTE")
     if note:
         doc["backend_note"] = note

@@ -92,6 +92,12 @@ def main():
                          "bench mix, so default trajectories stay "
                          "comparable")
     ap.add_argument("--workload-seed", type=int, default=1)
+    ap.add_argument("--mesh", default="",
+                    help="GxR device mesh for every replica's serving "
+                         "state (ServerReplica device_mesh knob; the "
+                         "group axis shards across this host's "
+                         "devices — on CPU, the 8-virtual-device "
+                         "platform above).  Empty = single-device.")
     ap.add_argument("--out", default=os.path.join(REPO, "TPUTLAT.json"))
     args = ap.parse_args()
 
@@ -110,6 +116,21 @@ def main():
     for kv in filter(None, args.config.split(",")):
         k, v = kv.split("=", 1)
         config[k] = json.loads(v)
+    mesh_shape = None
+    if args.mesh:
+        # fail fast on an infeasible mesh — malformed spec, more devices
+        # than the (8-virtual-device) platform, or axes that don't
+        # divide this cluster's groups/replicas.  Without this the
+        # error would surface as every replica's bring-up retry loop
+        # timing out ~120s later with a generic "cluster failed to
+        # start".
+        from summerset_tpu.core.sharding import (
+            check_mesh, mesh_for, mesh_stamp, parse_mesh,
+        )
+
+        mesh_shape = parse_mesh(args.mesh)
+        check_mesh(mesh_for(*mesh_shape), args.groups, args.replicas)
+        config["device_mesh"] = args.mesh
 
     tmp = tempfile.mkdtemp(prefix="tput_lat_")
     t0 = time.time()
@@ -145,6 +166,13 @@ def main():
         "workload": args.workload,
         "workload_seed": args.workload_seed,
         "workload_digest": plan.digest() if plan is not None else None,
+        # serving-mesh stamp: which device mesh each replica's [G, R]
+        # state was sharded over (None = the single-device legacy path);
+        # the canonical block shared with bench.py and PROFILE.json
+        "mesh": (
+            mesh_stamp(mesh_shape[0], mesh_shape[1], args.groups)
+            if mesh_shape is not None else None
+        ),
         "points": points,
         # the artifact judges itself: a curve where nothing ever
         # committed is a failed capture even when the process exits 0

@@ -9,12 +9,13 @@ in a crash-restart while loop: ``run()`` returning True restarts
 from __future__ import annotations
 
 import argparse
+import os
+
 try:
     import tomllib
 except ModuleNotFoundError:  # Python < 3.11: tomli is API-compatible
     import tomli as tomllib
 
-from ..host.server import ServerReplica
 from ..utils.logging import logger_init, pf_info, pf_logger
 
 logger = pf_logger("server_main")
@@ -41,6 +42,23 @@ def main(argv=None) -> None:
         if args.config
         else {}
     )
+
+    mesh_spec = str(cfg.get("device_mesh", "") or "")
+    if mesh_spec and os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+        # grow the virtual CPU platform to the mesh size BEFORE the
+        # ServerReplica import below initializes the backend (after
+        # which the device count is locked and the mesh constructor can
+        # only fail).  Parsed from the REAL config dict via the one
+        # canonical grammar; harmless when a real accelerator backend
+        # ends up selected (the host-platform count is CPU-only), and
+        # on a real TPU host the devices simply exist.
+        from ..utils.jaxcompat import parse_mesh, set_cpu_devices
+
+        gs, rs = parse_mesh(mesh_spec)
+        if gs * rs > 1:
+            set_cpu_devices(gs * rs)
+
+    from ..host.server import ServerReplica
     boot_fails = 0
     while True:
         try:

@@ -20,6 +20,21 @@ host's crash-restart contract (``core/protocol.py``): boot
 ``init_state``, then ``restore_durable`` replays the WAL record — the
 durable leaves ARE that record (with applier floor 0), and everything
 else is exactly what a host crash loses.
+
+Pod-scale mesh mode (``mesh=``): the same tick compiled over a 2-D
+``(group, replica)`` device mesh (``core/sharding.py``).  ``init()``
+places the ``[G, R, ...]`` state with ``state_sharding``, every scan
+carry is re-constrained to the same specs (so GSPMD keeps placement
+stable across ticks and lowers in-group netmodel delivery to the
+replica-axis all-to-all), and the scan entry points **donate the
+carry** (``donate_argnums``) so steady-state windows run
+device-resident: the host feeds only per-window ``ControlInputs`` /
+api-batch arrays and drains effects — the ``[G, R, ...]`` state never
+round-trips.  Donation contract: after ``run_ticks``/``run_synthetic``
+returns, the state/netstate the caller passed IN are dead buffers
+(host reuse raises); hold onto the RETURNED carry only.  The
+single-tick path (``tick``) never donates — serving/test loops read
+the previous state between ticks.
 """
 
 from __future__ import annotations
@@ -30,6 +45,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import sharding as shardlib
 from . import telemetry
 from .netmodel import ControlInputs, NetConfig, NetModel
 from .protocol import ProtocolKernel, StepEffects
@@ -43,9 +59,21 @@ class Engine:
         kernel: ProtocolKernel,
         netcfg: NetConfig = NetConfig(),
         seed: int = 0,
+        mesh: Optional[Any] = None,
+        donate: Optional[bool] = None,
     ):
         self.kernel = kernel
         self.netcfg = netcfg
+        # pod-scale mesh mode: shard the [G, R, ...] plane over a
+        # (group, replica) device mesh; geometry the mesh cannot split
+        # evenly is refused here with the axis named (check_mesh)
+        self.mesh = mesh
+        if mesh is not None:
+            shardlib.check_mesh(mesh, kernel.G, kernel.R)
+        # scan-carry donation defaults on exactly when sharded (the
+        # device-resident steady-state contract); opt in/out explicitly
+        # with donate=True/False
+        self.donate = (mesh is not None) if donate is None else bool(donate)
         # Lease-plane safety is CLOCK-FREE only because a grantor's
         # countdown outlives the holder's belief by more than the maximum
         # one-way message delay (quorum_leases.py module doc;
@@ -81,16 +109,23 @@ class Engine:
         # the template and the boot state are the SAME arrays, so no
         # second copy of the [G, R, ...] pytree is ever held.
         self._boot = self.kernel.init_state(seed)
+        self._constrain = (
+            partial(_constrain_carry, mesh) if mesh is not None else None
+        )
+        donate_kw = {"donate_argnums": (0, 1)} if self.donate else {}
         self._tick_jit = jax.jit(
-            partial(_tick, self.kernel, self.net, self._boot)
+            partial(_tick, self.kernel, self.net, self._boot,
+                    self._constrain)
         )
         self._run_jit = jax.jit(
-            partial(_run_scan, self.kernel, self.net, self._boot),
-            static_argnums=3,
+            partial(_run_scan, self.kernel, self.net, self._boot,
+                    self._constrain),
+            static_argnums=3, **donate_kw,
         )
         self._synth_jit = jax.jit(
-            partial(_run_synth, self.kernel, self.net, self._boot),
-            static_argnums=(2, 3),
+            partial(_run_synth, self.kernel, self.net, self._boot,
+                    self._constrain),
+            static_argnums=(2, 3), **donate_kw,
         )
 
     def init(self) -> Tuple[Pytree, Pytree]:
@@ -101,6 +136,19 @@ class Engine:
         # leaf (state.pop("telem")) to compile the lane-free ablation
         telemetry.attach(state, self.kernel.G, self.kernel.R)
         netstate = self.net.init_netstate(self.kernel.zero_outbox(), self.seed)
+        if self.mesh is not None:
+            # place onto the mesh.  device_put COPIES: the boot template
+            # the jitted tick closes over (and hands out on a later
+            # init()) survives even when this carry is later donated.
+            state = shardlib.shard_pytree(self.mesh, state)
+            netstate = shardlib.shard_netstate(self.mesh, netstate)
+        elif self.donate:
+            # mesh-less donation (explicit donate=True) needs the same
+            # protection the mesh path gets from device_put: without a
+            # copy the handed-out carry IS the boot template's arrays,
+            # and donating it would delete the template under the jitted
+            # tick's closure and every later init()
+            state = {k: jnp.array(v) for k, v in state.items()}
         return state, netstate
 
     def tick(
@@ -123,6 +171,13 @@ class Engine:
         per-tick effects stacked over T when ``collect=True`` and ``None``
         otherwise (read final bars from the returned state).  Compile
         caching is by shapes, handled by jax.jit itself.
+
+        With ``donate`` on (the sharded default) the passed-in
+        state/netstate are DONATED: their buffers alias the returned
+        carry and reading them from the host afterwards raises.  This is
+        the per-window serving shape — the host feeds only the
+        ``inputs_seq`` arrays and drains ``fxs``; the ``[G, R, ...]``
+        carry never leaves the devices.
         """
         return self._run_jit(state, netstate, inputs_seq, collect)
 
@@ -207,14 +262,28 @@ def reset_durable_rows(
     return {k: rewind(k, v) for k, v in state.items()}
 
 
+def _constrain_carry(mesh, state: Pytree, netstate: Pytree):
+    """Pin the scan carry to its (group, replica) mesh layout — applied
+    every tick so GSPMD never migrates the carry off its shards (and the
+    netmodel's in-group delivery lowers to the replica-axis
+    all-to-all)."""
+    return (
+        shardlib.constrain_state(mesh, state),
+        shardlib.constrain_netstate(mesh, netstate),
+    )
+
+
 def _tick(
     kernel: ProtocolKernel,
     net: NetModel,
     boot: Pytree,
+    constrain,
     state: Pytree,
     netstate: Pytree,
     inputs: Dict[str, Any],
 ) -> Tuple[Pytree, Pytree, StepEffects]:
+    if constrain is not None:
+        state, netstate = constrain(state, netstate)
     ctrl = ControlInputs(
         alive=inputs.get("alive"), link_up=inputs.get("link_up"),
         reset=inputs.get("reset"),
@@ -261,20 +330,23 @@ def _tick(
         new_state = dict(new_state, **{telemetry.TELEM_KEY: tel})
     else:
         netstate = net.push(netstate, outbox, ctrl)
+    if constrain is not None:
+        new_state, netstate = constrain(new_state, netstate)
     return new_state, netstate, fx
 
 
-def _run_scan(kernel, net, boot, state, netstate, inputs_seq, collect):
+def _run_scan(kernel, net, boot, constrain, state, netstate, inputs_seq,
+              collect):
     def body(carry, inp):
         st, ns = carry
-        st, ns, fx = _tick(kernel, net, boot, st, ns, inp)
+        st, ns, fx = _tick(kernel, net, boot, constrain, st, ns, inp)
         return (st, ns), (fx if collect else None)
 
     (state_f, net_f), fxs = jax.lax.scan(body, (state, netstate), inputs_seq)
     return state_f, net_f, fxs
 
 
-def _run_synth(kernel, net, boot, state, netstate, num_ticks,
+def _run_synth(kernel, net, boot, constrain, state, netstate, num_ticks,
                proposals_per_tick):
     G = kernel.G
 
@@ -289,7 +361,7 @@ def _run_synth(kernel, net, boot, state, netstate, num_ticks,
             # exec_follows_commit=False still make progress
             "exec_floor": jnp.full((G, R), 1 << 30, jnp.int32),
         }
-        st, ns, fx = _tick(kernel, net, boot, st, ns, inputs)
+        st, ns, fx = _tick(kernel, net, boot, constrain, st, ns, inputs)
         return (st, ns), None
 
     (state_f, net_f), _ = jax.lax.scan(

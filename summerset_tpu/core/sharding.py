@@ -30,6 +30,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# the one mesh-spec grammar, defined in the jax-free pre-backend module
+# (drivers parse "GxR" before the backend initializes); re-exported here
+# because this module is where mesh consumers already look
+from ..utils.jaxcompat import parse_mesh  # noqa: F401
+
 Pytree = Any
 
 
@@ -49,6 +54,51 @@ def make_mesh(
         )
     arr = np.array(devices).reshape(group_shards, replica_shards)
     return Mesh(arr, ("group", "replica"))
+
+
+def mesh_for(
+    group_shards: int,
+    replica_shards: int,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """:func:`make_mesh` over the FIRST ``group_shards*replica_shards``
+    visible devices — the driver-facing form (``--mesh 4x2`` on a v5e-8
+    uses all 8 chips; ``--mesh 2x1`` on the same pod uses two), with a
+    clear error when the pod is too small."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = group_shards * replica_shards
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {group_shards}x{replica_shards} needs {need} devices "
+            f"but only {len(devices)} are visible (on CPU, request a "
+            "virtual mesh first: utils/jaxcompat.set_cpu_devices(N) / "
+            "--xla_force_host_platform_device_count=N)"
+        )
+    return make_mesh(group_shards, replica_shards, devices[:need])
+
+
+def check_mesh(mesh: Mesh, G: int, R: int) -> None:
+    """Refuse geometry the mesh cannot shard evenly, with an error that
+    names the offending axis (the raw XLA/reshape failure is cryptic).
+
+    Uneven sharding would need padding the state arrays — a correctness
+    hazard for the int32 consensus lanes (a padded phantom replica would
+    vote) — so the engine refuses it outright."""
+    gs = mesh.shape["group"]
+    rs = mesh.shape["replica"]
+    if G % gs != 0:
+        raise ValueError(
+            f"num_groups G={G} is not divisible by the mesh's "
+            f"group_shards={gs}: each device must own an equal slice of "
+            "the group axis (pick a mesh whose group axis divides G)"
+        )
+    if R % rs != 0:
+        raise ValueError(
+            f"population R={R} is not divisible by the mesh's "
+            f"replica_shards={rs}: replica rows cannot be split unevenly "
+            "across devices (pick replica_shards dividing R, e.g. "
+            f"{'1' if R % 2 else '1 or 2'})"
+        )
 
 
 def state_sharding(mesh: Mesh, state: Pytree) -> Pytree:
@@ -99,8 +149,25 @@ def netstate_sharding(mesh: Mesh, netstate: Pytree) -> Pytree:
     return out
 
 
+def mesh_stamp(group_shards: int, replica_shards: int, G: int) -> dict:
+    """The canonical mesh block every artifact stamps (bench.py mesh
+    runs, TPUTLAT curves, PROFILE.json mesh-sweep points) — one shared
+    schema so trajectory consumers never see divergent spellings."""
+    return {
+        "mesh": f"{group_shards}x{replica_shards}",
+        "group_shards": group_shards,
+        "replica_shards": replica_shards,
+        "devices": group_shards * replica_shards,
+        "groups_per_device": G // group_shards,
+    }
+
+
 def shard_pytree(mesh: Mesh, tree: Pytree) -> Pytree:
-    """Place a state pytree onto the mesh with the group/replica layout."""
+    """Place a state pytree onto the mesh with the group/replica layout.
+
+    Returns NEW arrays (``device_put`` copies): the caller's originals —
+    e.g. the engine's boot template, which the jitted tick also closes
+    over — stay valid even when the placed copies are later donated."""
     shardings = state_sharding(mesh, tree)
     return jax.tree.map(jax.device_put, tree, shardings)
 
@@ -109,3 +176,23 @@ def shard_netstate(mesh: Mesh, netstate: Pytree) -> Pytree:
     """Place a netstate onto the mesh (delay axis replicated)."""
     shardings = netstate_sharding(mesh, netstate)
     return jax.tree.map(jax.device_put, netstate, shardings)
+
+
+def constrain_state(mesh: Mesh, state: Pytree) -> Pytree:
+    """``with_sharding_constraint`` a state/outbox pytree to its
+    group/replica layout — the in-jit form of :func:`shard_pytree`,
+    applied at the ``lax.scan`` carry boundary so GSPMD keeps every
+    leaf's placement stable across ticks (and lowers the netmodel's
+    in-group ``swapaxes`` delivery to the replica-axis all-to-all
+    instead of gathering the world to one device)."""
+    return jax.lax.with_sharding_constraint(
+        state, state_sharding(mesh, state)
+    )
+
+
+def constrain_netstate(mesh: Mesh, netstate: Pytree) -> Pytree:
+    """In-jit sharding constraint for a NetModel netstate (see
+    :func:`constrain_state`)."""
+    return jax.lax.with_sharding_constraint(
+        netstate, netstate_sharding(mesh, netstate)
+    )

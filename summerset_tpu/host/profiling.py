@@ -82,6 +82,17 @@ CANONICAL_TICKS = 128
 CANONICAL_REPS = 3
 G_SWEEP = (16, 64, 256)
 
+#: the pod-scale judging curve's mesh axis: the analytic G-sweep's twin
+#: over mesh shapes at a FIXED global shape — per-device work should
+#: fall ~linearly with group_shards while the HLO op count stays ~flat
+#: (sharding changes WHERE the tick runs, not WHAT it computes), and
+#: every sharded point must show the scan carry fully donated.  R=4 so
+#: the 2x2 point truly splits the replica axis (in-group delivery
+#: becomes a cross-device collective).
+MESH_SWEEP = ("1x1", "2x1", "4x1", "2x2")
+MESH_SWEEP_SHAPE: Dict[str, int] = {"G": 64, "R": 4, "W": 16}
+MESH_SWEEP_TICKS = 32
+
 _PHASE_RE = re.compile(PHASE_SCOPE_PREFIX + r"(\w+)")
 # one optimized-HLO instruction definition: "%name = ..." (ROOT or not),
 # with its op_name metadata somewhere on the same line
@@ -143,6 +154,33 @@ def _mem_stats(compiled) -> Optional[Dict[str, int]]:
         "alias_bytes": int(ma.alias_size_in_bytes),
         "generated_code_bytes": int(ma.generated_code_size_in_bytes),
     }
+
+
+_ALIAS_PAIR_RE = re.compile(
+    r"\{(\d+)\}:\s*\((\d+),\s*\{\},\s*(?:may|must)-alias\)"
+)
+
+
+def donation_stats(compiled) -> Dict[str, Any]:
+    """Carry-donation introspection for one compiled executable.
+
+    ``aliased_buffers`` counts the ``input_output_alias`` pairs in the
+    optimized HLO — the donation ground truth, and it SURVIVES the
+    persistent compile cache.  The ``memory_analysis`` byte stats ride
+    along (donated carry bytes must not be double-counted against
+    output), but a cache-hit deserialized executable reports
+    ``alias_bytes`` 0 — callers gate on the HLO pairs and treat the
+    byte stats as fresh-compile-only corroboration."""
+    pairs = _ALIAS_PAIR_RE.findall(compiled.as_text())
+    out: Dict[str, Any] = {"aliased_buffers": len(pairs)}
+    mem = _mem_stats(compiled)
+    if mem is not None:
+        out.update(
+            argument_bytes=mem["argument_bytes"],
+            alias_bytes=mem["alias_bytes"],
+            output_bytes=mem["output_bytes"],
+        )
+    return out
 
 
 def hlo_phase_ops(hlo_text: str) -> Tuple[int, Dict[str, int]]:
@@ -453,6 +491,111 @@ def protocol_analytic_block(
     return analytic_block(_build_cell_kernel(name, variant, G, R, W))
 
 
+def mesh_cell(
+    name: str,
+    spec: str,
+    G: int = MESH_SWEEP_SHAPE["G"],
+    R: int = MESH_SWEEP_SHAPE["R"],
+    W: int = MESH_SWEEP_SHAPE["W"],
+    ticks: int = MESH_SWEEP_TICKS,
+    run_check: bool = True,
+) -> Dict[str, Any]:
+    """One mesh-shape point: the sharded engine's analytic tick metrics
+    plus the donation introspection of its scanned executable.
+
+    Everything recorded here is deterministic per backend (strictly
+    gateable) EXCEPT ``committed_slots``, which exists only to prove the
+    donated executable actually makes consensus progress — the gate
+    asserts it is > 0 rather than comparing it."""
+    import numpy as np
+
+    from ..core import sharding as _shard
+
+    gs, rs = _shard.parse_mesh(spec)
+    mesh = _shard.mesh_for(gs, rs)
+    kernel = _build_cell_kernel(name, "device", G, R, W)
+    proposals = min(
+        4, getattr(kernel.config, "max_proposals_per_tick", 4)
+    )
+    eng = Engine(kernel, mesh=mesh)  # sharded mode: carry donated
+    state, ns = eng.init()
+    carry_leaves = len(jax.tree.leaves((state, ns)))
+
+    inputs = _synth_inputs(kernel, proposals)
+    tick_comp = eng.lower_tick(state, ns, inputs).compile()
+    hlo_total, _ = hlo_phase_ops(tick_comp.as_text())
+    scan_comp = eng.lower_synthetic(state, ns, ticks, proposals).compile()
+    don = donation_stats(scan_comp)
+
+    cell: Dict[str, Any] = {
+        **_shard.mesh_stamp(gs, rs, G),
+        "analytic": dict(
+            _norm_cost(tick_comp), hlo_instructions=hlo_total
+        ),
+        "memory": _mem_stats(tick_comp),
+        # cache-stable donation facts only: alias BYTES read 0 on a
+        # persistent-cache-hit executable, so the strict gate compares
+        # the HLO alias-pair count against the carry leaf count instead
+        "donation": {
+            "aliased_buffers": don["aliased_buffers"],
+            "carry_leaves": carry_leaves,
+        },
+        "donated": don["aliased_buffers"] == carry_leaves,
+    }
+    if run_check:
+        state, ns = scan_comp(state, ns)
+        state, ns = scan_comp(state, ns)
+        jax.block_until_ready(state["commit_bar"])
+        slots = int(np.asarray(state["commit_bar"]).max(axis=1).sum())
+        cell["committed_slots"] = slots
+        cell["ok"] = cell["donated"] and slots > 0
+    else:
+        cell["ok"] = cell["donated"]
+    return cell
+
+
+def mesh_sweep(
+    name: str = "multipaxos",
+    meshes: Tuple[str, ...] = MESH_SWEEP,
+    G: int = MESH_SWEEP_SHAPE["G"],
+    R: int = MESH_SWEEP_SHAPE["R"],
+    W: int = MESH_SWEEP_SHAPE["W"],
+    ticks: int = MESH_SWEEP_TICKS,
+    run_check: bool = True,
+    log=lambda m: None,
+) -> Dict[str, Any]:
+    """The mesh-shape twin of :func:`g_sweep` — one :func:`mesh_cell`
+    per mesh spec at a fixed global shape, so the committed PROFILE.json
+    carries a regression-gated multi-device trajectory even while the
+    TPU tunnel is down (CPU runs use the virtual host-platform mesh).
+
+    Shapes the visible pod cannot fit are recorded under ``skipped``
+    (never silently dropped) rather than failing the sweep."""
+    points = []
+    skipped = []
+    ndev = len(jax.devices())
+    from ..core.sharding import parse_mesh
+
+    for spec in meshes:
+        gs, rs = parse_mesh(spec)
+        if gs * rs > ndev:
+            skipped.append({"mesh": spec, "reason": f"needs {gs * rs} "
+                            f"devices, {ndev} visible"})
+            continue
+        log(f"mesh sweep {name} @ {spec} ...")
+        points.append(
+            mesh_cell(name, spec, G=G, R=R, W=W, ticks=ticks,
+                      run_check=run_check)
+        )
+    return {
+        "protocol": name,
+        "variant": "device",
+        "shape": {"G": G, "R": R, "W": W, "ticks": ticks},
+        "points": points,
+        "skipped": skipped,
+    }
+
+
 def g_sweep(
     name: str = "multipaxos",
     groups: Tuple[int, ...] = G_SWEEP,
@@ -488,6 +631,8 @@ def build_profile(
     reps: int = CANONICAL_REPS,
     with_overhead: bool = True,
     with_sweep: bool = True,
+    with_mesh_sweep: bool = True,
+    mesh_shapes: Optional[Tuple[str, ...]] = None,
     log=print,
 ) -> Dict[str, Any]:
     """The full PROFILE.json document (see scripts/profile_run.py)."""
@@ -514,6 +659,11 @@ def build_profile(
     if with_sweep:
         log("g-sweep (analytic) ...")
         doc["g_sweep"] = g_sweep(protocols[0], R=R, W=W)
+    if with_mesh_sweep:
+        log("mesh sweep (analytic + donation) ...")
+        doc["mesh_sweep"] = mesh_sweep(
+            protocols[0], meshes=mesh_shapes or MESH_SWEEP, log=log
+        )
     if with_overhead:
         log("phase-scope overhead ablation A/B ...")
         doc["scope_overhead"] = measure_scope_overhead(

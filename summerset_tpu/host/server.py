@@ -120,12 +120,33 @@ def _sp_size(sp: ShardPayload) -> int:
 
 
 @functools.lru_cache(maxsize=64)
-def _shared_step(kernel):
+def _shared_step(kernel, mesh_shape=None):
     """One jitted step per (kernel class, geometry, config): kernels are
     hashable by static key, so a crash-restarted replica reuses the
     already-compiled executable instead of re-tracing — restarts come
-    back in milliseconds, which the reset/election tests depend on."""
-    return jax.jit(kernel.step)
+    back in milliseconds, which the reset/election tests depend on.
+
+    ``mesh_shape=(group_shards, replica_shards)`` compiles the pod-scale
+    serving variant (the ``device_mesh`` server knob): the ``[G, R,
+    ...]`` state is constrained to the ``(group, replica)`` device mesh
+    on entry and exit, so it stays sharded across this host's local
+    devices tick to tick while the inbox/outbox/effects seams (host
+    TCP + telemetry + flight) are untouched.  No donation here — the
+    serving loop feeds the inbox and drains effects every tick, so the
+    carry is rebound per call anyway and the host must be free to read
+    the previous state between ticks."""
+    if mesh_shape is None:
+        return jax.jit(kernel.step)
+    from ..core import sharding as shardlib
+
+    mesh = shardlib.mesh_for(*mesh_shape)
+
+    def sharded_step(state, inbox, inputs):
+        state = shardlib.constrain_state(mesh, state)
+        new_state, out, fx = kernel.step(state, inbox, inputs)
+        return shardlib.constrain_state(mesh, new_state), out, fx
+
+    return jax.jit(sharded_step)
 
 
 class ServerReplica:
@@ -159,6 +180,10 @@ class ServerReplica:
         # hint instead of buffered without bound
         self.api_max_batch = int(cfg.pop("api_max_batch", 5000))
         self.api_max_pending = int(cfg.pop("api_max_pending", 16384))
+        # pod-scale serving: "GxR" shards the [G, R, ...] device state
+        # over a (group, replica) mesh of this host's local devices
+        # (core/sharding.py); "" = the single-device legacy compile
+        self.device_mesh = str(cfg.pop("device_mesh", "") or "")
         self._bd_last_print = time.monotonic()
         self.near_quorum_reads = bool(cfg.pop("near_quorum_reads", False))
         # telemetry plane: one registry threaded through every hub seam
@@ -287,7 +312,18 @@ class ServerReplica:
         # the [G, R, K] block is this server's [G, K] matrix; peers'
         # rows stay zero — each server scrapes only its own)
         dev_telemetry.attach(self.state, self.G, self.population)
-        self._step = _shared_step(self.kernel)
+        # pod-scale serving mesh: validated here (axis-named errors),
+        # state placed onto it AFTER recovery restores acceptor rows
+        self._mesh = None
+        self._mesh_shape = None
+        if self.device_mesh:
+            from ..core import sharding as shardlib
+
+            gs, rs = shardlib.parse_mesh(self.device_mesh)
+            self._mesh = shardlib.mesh_for(gs, rs)
+            shardlib.check_mesh(self._mesh, self.G, self.population)
+            self._mesh_shape = (gs, rs)
+        self._step = _shared_step(self.kernel, self._mesh_shape)
 
         os.makedirs(backer_dir, exist_ok=True)
         self.wal_path = os.path.join(backer_dir, f"r{self.me}.wal")
@@ -433,6 +469,13 @@ class ServerReplica:
 
         self._recover_from_snapshot()
         self._recover_from_wal()
+        if self._mesh is not None:
+            # place the recovered state onto the serving mesh; every
+            # subsequent tick's output is constrained back to it, so the
+            # [G, R, ...] plane never migrates off its shards
+            from ..core.sharding import shard_pytree
+
+            self.state = shard_pytree(self._mesh, self.state)
         # flight event: bring-up recovery done.  cold=False (durable
         # state predated this boot) is the restarted-replica marker the
         # crash reports / repro bundles look for; cold=True is a first

@@ -1,15 +1,47 @@
-"""jax version compatibility shims.
+"""jax version compatibility shims + the jax-free pre-backend helpers.
 
 The repo targets the ``jax_num_cpu_devices`` config knob (jax >= 0.5) to
 build the 8-device virtual CPU mesh the driver contract specifies; older
 jax spells the same thing as an XLA flag that must be in the environment
 before the CPU backend initializes.  Callers here all run before any
 backend-initializing jax call, so the env-var fallback still takes effect.
+
+This module is deliberately import-light (no jax at module scope):
+drivers that must size the virtual CPU platform BEFORE anything
+initializes the backend (importing ``summerset_tpu.core`` does, via
+module-level device constants) import their helpers — including the
+canonical ``parse_mesh`` grammar — from here.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Tuple
+
+
+def parse_mesh(spec: str) -> Tuple[int, int]:
+    """Parse a ``"GxR"`` mesh spec (e.g. ``"4x2"``) into
+    ``(group_shards, replica_shards)``.
+
+    THE one definition of the mesh-spec grammar — every driver's
+    ``--mesh`` flag, the server's ``device_mesh`` knob, and
+    ``core/sharding.py`` (which re-exports it) parse through here, so
+    the accepted spelling cannot diverge.  Lives in this jax-free
+    module because drivers must parse the spec before the backend
+    initializes (to size the virtual CPU platform)."""
+    parts = str(spec).lower().split("x")
+    try:
+        gs, rs = (int(p) for p in parts)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"mesh spec {spec!r} is not of the form 'GxR' (e.g. '4x2': "
+            "4 group shards x 2 replica shards)"
+        ) from None
+    if gs < 1 or rs < 1:
+        raise ValueError(
+            f"mesh spec {spec!r}: both axes must be >= 1"
+        )
+    return gs, rs
 
 
 def set_cpu_devices(n: int) -> None:

@@ -202,3 +202,39 @@ class TestBenchBackendFallback:
         assert gp["shape"]["G"] == 8
         assert gp["analytic"]["hlo_instructions"] > 0
         assert gp["analytic"]["hlo_ops_by_phase"]["ingest_accept"] > 0
+
+    def test_mesh_survives_fallback_and_stamps_donation(self):
+        """`bench.py --mesh GxR` through the dead-backend fallback: the
+        re-exec'd CPU child rebuilds the SAME mesh shape as a virtual
+        CPU mesh (spec carried via BENCH_MESH), and the artifact stamps
+        the mesh block with a fully-donated carry — a mesh capture that
+        lost donation would fail its own ok verdict."""
+        import json
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)     # not an explicit CPU run
+        env["BENCH_BACKEND_TIMEOUT"] = "0"  # probe can never pass
+        env["BENCH_GROUPS"] = "8"
+        env["BENCH_TICKS"] = "32"
+        env["BENCH_RUNS"] = "1"
+        env["BENCH_PROPS"] = "8"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--mesh", "2x1"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert doc["backend"] == "cpu"
+        assert doc["ok"] is True and doc["value"] > 0
+        mesh = doc["mesh"]
+        assert mesh["mesh"] == "2x1"
+        assert mesh["devices"] == 2
+        assert mesh["groups_per_device"] == 4
+        don = mesh["donation"]
+        assert don["aliased_buffers"] == don["carry_leaves"] > 0
+        assert "mesh 2x1" in doc["metric"]

@@ -71,7 +71,9 @@ def test_packed_netstate_shards_onto_mesh():
         "value_base": jnp.ones((16,), jnp.int32),
     }
     fn = jax.jit(
-        lambda st, n, i: _tick(eng.kernel, eng.net, eng._boot, st, n, i)
+        lambda st, n, i: _tick(
+            eng.kernel, eng.net, eng._boot, None, st, n, i
+        )
     )
     for _ in range(3):
         state, ns, fx = fn(state, ns, inputs)

@@ -303,6 +303,123 @@ class TestPerfGateLogic:
                         for k, v in pw.items()
                     ), where
         assert doc["scope_overhead"]["pct"] < 5.0
+        # the pod-scale mesh trajectory is committed and self-judging:
+        # at least one genuinely multi-device point, every point donated
+        ms = doc["mesh_sweep"]
+        assert any(p["devices"] > 1 for p in ms["points"])
+        for p in ms["points"]:
+            assert p["ok"] and p["donated"], p["mesh"]
+            assert p["donation"]["aliased_buffers"] == \
+                p["donation"]["carry_leaves"], p["mesh"]
+            assert p["committed_slots"] > 0, p["mesh"]
+
+
+# --------------------------------------------------- mesh-sweep gate ----
+def _mesh_doc(points):
+    return {"mesh_sweep": {
+        "protocol": "multipaxos",
+        "variant": "device",
+        "shape": {"G": 8, "R": 4, "W": 8, "ticks": 4},
+        "points": points,
+        "skipped": [],
+    }}
+
+
+def _mesh_point(spec="2x1", devices=2, ok=True, donated=True, slots=100):
+    gs, rs = (int(x) for x in spec.split("x"))
+    return {
+        "mesh": spec, "group_shards": gs, "replica_shards": rs,
+        "devices": devices, "groups_per_device": 8 // gs,
+        "analytic": {"flops": 10.0, "hlo_instructions": 5},
+        "memory": {"argument_bytes": 64},
+        "donation": {"aliased_buffers": 52 if donated else 0,
+                     "carry_leaves": 52},
+        "donated": donated, "committed_slots": slots, "ok": ok,
+    }
+
+
+class TestMeshSweepGate:
+    def _run(self, doc, cur_points=None, monkeypatch=None):
+        import perf_gate
+
+        if cur_points is not None:
+            monkeypatch.setattr(
+                perf_gate.profiling, "mesh_sweep",
+                lambda *a, **k: {"points": cur_points, "skipped": []},
+            )
+        errors = []
+        perf_gate.check_mesh_sweep(doc, errors)
+        return errors
+
+    def test_match_passes(self, monkeypatch):
+        pts = [_mesh_point("1x1", 1), _mesh_point("2x1", 2)]
+        errors = self._run(
+            _mesh_doc(pts), json.loads(json.dumps(pts)), monkeypatch
+        )
+        assert errors == []
+
+    def test_no_multi_device_point_fails(self, monkeypatch):
+        errors = self._run(_mesh_doc([_mesh_point("1x1", 1)]))
+        assert len(errors) == 1 and "no multi-device" in errors[0]
+
+    def test_undonated_committed_point_fails(self, monkeypatch):
+        errors = self._run(
+            _mesh_doc([_mesh_point("2x1", 2, donated=False)])
+        )
+        assert any("undonated" in e for e in errors)
+
+    def test_dead_committed_capture_fails(self, monkeypatch):
+        errors = self._run(
+            _mesh_doc([_mesh_point("2x1", 2, ok=False, slots=0)])
+        )
+        assert any("ok=false" in e for e in errors)
+        assert any("no progress" in e for e in errors)
+
+    def test_analytic_drift_fails(self, monkeypatch):
+        pts = [_mesh_point("2x1", 2)]
+        cur = json.loads(json.dumps(pts))
+        cur[0]["analytic"]["flops"] = 11.0
+        errors = self._run(_mesh_doc(pts), cur, monkeypatch)
+        assert len(errors) == 1 and "drift in 'analytic'" in errors[0]
+
+    def test_donation_regression_fails(self, monkeypatch):
+        pts = [_mesh_point("2x1", 2)]
+        cur = json.loads(json.dumps(pts))
+        cur[0]["donation"]["aliased_buffers"] = 0
+        cur[0]["donated"] = False
+        cur[0]["ok"] = False
+        errors = self._run(_mesh_doc(pts), cur, monkeypatch)
+        assert any("lost carry donation" in e for e in errors)
+
+    def test_too_few_devices_fails(self, monkeypatch):
+        import perf_gate
+
+        pts = [_mesh_point("4x2", 8)]
+        monkeypatch.setattr(
+            perf_gate.profiling, "mesh_sweep",
+            lambda *a, **k: {
+                "points": [],
+                "skipped": [{"mesh": "4x2", "reason": "needs 8"}],
+            },
+        )
+        errors = []
+        perf_gate.check_mesh_sweep(_mesh_doc(pts), errors)
+        assert len(errors) == 1 and "fewer devices" in errors[0]
+
+
+def test_mesh_cell_live_small():
+    """One real mesh_cell on the virtual CPU mesh: donated carry,
+    deterministic analytic block, consensus progress."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual CPU mesh")
+    cell = profiling.mesh_cell("multipaxos", "2x1", G=8, R=3, W=8,
+                               ticks=8)
+    assert cell["devices"] == 2 and cell["groups_per_device"] == 4
+    assert cell["donated"] and cell["ok"]
+    assert cell["analytic"]["hlo_instructions"] > 0
+    assert cell["committed_slots"] > 0
 
 
 # ------------------------------------------------- device-phase merge ----

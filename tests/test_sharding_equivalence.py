@@ -1,15 +1,18 @@
-"""Sharded-vs-unsharded equivalence of the mesh path.
+"""Sharded-vs-unsharded equivalence of the mesh path (per-tick form).
 
 The multi-chip design claim is that sharding the [G groups, R replicas]
 state over a ``jax.sharding.Mesh`` changes WHERE the lockstep tick runs,
 never WHAT it computes (reference analog: the TransportHub mesh delivers
 the same messages whatever the process placement, transport.rs:258-276).
 This drives the same fault schedule tick-by-tick through the plain
-single-device engine and through the compiled sharded tick on the
-8-virtual-device CPU mesh (conftest), asserting bit-identical state
-trajectories at nontrivial shapes — including a mesh whose REPLICA axis
-is truly sharded, where in-group delivery must lower to a cross-device
-collective.
+single-device engine and through the engine's sharded compile mode
+(``Engine(mesh=...)``) on the 8-virtual-device CPU mesh (conftest),
+asserting bit-identical state trajectories at nontrivial shapes —
+including a mesh whose REPLICA axis is truly sharded, where in-group
+delivery must lower to a cross-device collective.
+
+The scan-path (windowed, donated) twin of this gate lives in
+``tests/test_mesh_engine.py``.
 """
 
 import jax
@@ -19,14 +22,7 @@ import pytest
 
 from summerset_tpu.core import Engine, NetConfig
 from summerset_tpu.core.netmodel import ControlInputs
-from summerset_tpu.core.engine import _tick
-from summerset_tpu.core.sharding import (
-    make_mesh,
-    netstate_sharding,
-    shard_netstate,
-    shard_pytree,
-    state_sharding,
-)
+from summerset_tpu.core.sharding import make_mesh
 from summerset_tpu.protocols import make_protocol
 from summerset_tpu.protocols.multipaxos import ReplicaConfigMultiPaxos
 
@@ -72,23 +68,19 @@ def _run_equivalence(G, R, W, P, group_shards, replica_shards, ticks):
         s, n, _ = eng.tick(s, n, inputs_at(t))
         base_states.append({k: np.asarray(v) for k, v in s.items()})
 
-    # sharded run from the same seed over the mesh
+    # sharded run from the same seed over the mesh: the engine's own
+    # sharded per-tick path (serving shape — host feeds every tick's
+    # inputs, so the single-tick jit must keep the carry on its shards)
     mesh = make_mesh(group_shards, replica_shards,
                      devices=jax.devices()[:group_shards * replica_shards])
-    eng2 = Engine(kernel, netcfg=net, seed=7)
+    eng2 = Engine(kernel, netcfg=net, seed=7, mesh=mesh)
     s2, n2 = eng2.init()
-    s2 = shard_pytree(mesh, s2)
-    n2 = shard_netstate(mesh, n2)
-    fn = lambda st, ns, i: _tick(  # noqa: E731
-        kernel, eng2.net, eng2._boot, st, ns, i
-    )
-    shapes = jax.eval_shape(fn, s2, n2, inputs_at(0))
-    out_sh = (state_sharding(mesh, shapes[0]),
-              netstate_sharding(mesh, shapes[1]),
-              state_sharding(mesh, shapes[2]))
-    tick = jax.jit(fn, out_shardings=out_sh)
+    assert all(
+        len(v.sharding.device_set) >= group_shards
+        for v in s2.values() if v.ndim >= 1 and v.shape[0] == G
+    ), "init() did not place the state on the mesh"
     for t in range(ticks):
-        s2, n2, _ = tick(s2, n2, inputs_at(t))
+        s2, n2, _ = eng2.tick(s2, n2, inputs_at(t))
         got = {k: np.asarray(v) for k, v in s2.items()}
         for k, ref in base_states[t].items():
             assert (got[k] == ref).all(), (
