@@ -129,7 +129,17 @@ def main():
              "on a v5e-8; empty = the single-device legacy path.  On "
              "CPU a virtual host-platform mesh of that size is built.",
     )
+    ap.add_argument(
+        "--tally", default=os.environ.get("BENCH_TALLY", "pairwise"),
+        choices=("pairwise", "collective"),
+        help="quorum-tally transport (core/quorum.py): 'pairwise' = the "
+             "R² accept-reply lanes through the delay line (digest-"
+             "compatible default); 'collective' = per-source [G, R] "
+             "tally records, one replica-axis gather on a sharded mesh",
+    )
     args = ap.parse_args()
+    # the fallback child re-execs without argv: carry the mode in env
+    os.environ["BENCH_TALLY"] = args.tally
     mesh_shape = None
     if args.mesh:
         # the canonical jax-free grammar (summerset_tpu.utils.jaxcompat
@@ -184,6 +194,7 @@ def main():
         max_proposals_per_tick=PROPOSALS_PER_TICK,
         chunk_size=PROPOSALS_PER_TICK * 2,
         exec_follows_commit=False,
+        tally=args.tally,
     )
     kernel = make_protocol("multipaxos", GROUPS, POPULATION, WINDOW, cfg)
     mesh = None
@@ -224,6 +235,9 @@ def main():
         "unit": "slots/sec",
         "vs_baseline": round(rate / BASELINE, 4),
         "backend": jax.devices()[0].platform,
+        # quorum-tally transport stamp (next to the mesh block): which
+        # tally plane produced this number (core/quorum.py)
+        "tally": args.tally,
         # the artifact judges itself: a capture that made no progress is
         # a FAILED capture even if the process exits 0 (the BENCH_r05
         # lesson — rc=1 with 0 slots/s sat unnoticed in the trajectory)

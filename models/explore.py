@@ -38,8 +38,16 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import os
+import sys
 from collections import deque
 from typing import Any, Dict, Iterable, List, Tuple
+
+# runnable as `python models/explore.py` from the repo root (script
+# mode puts models/ — not the repo root — on sys.path)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +130,9 @@ class ExploreResult:
     dedup_hits: int
     max_committed_slots: int
     violations: List[str]
+    # quorum-tally transport the kernel was compiled with
+    # (core/quorum.py): "pairwise" or "collective"
+    tally: str = "pairwise"
 
     def as_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -130,11 +141,22 @@ class ExploreResult:
 def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
             depth: int = 6, round_ticks: int = 2,
             config_overrides: Dict[str, Any] | None = None,
+            tally: str = "pairwise",
             progress: bool = False) -> ExploreResult:
     """Breadth-first exhaustion of all fault schedules of ``depth`` rounds."""
     # probe the config type at a wide window (tiny W would trip the
     # default max_proposals_per_tick guard before we can shrink it)
     base = make_protocol(protocol, G, R, 64)
+    overrides = dict(config_overrides or {})
+    if tally != "pairwise":
+        if not hasattr(base.config, "tally"):
+            # fail fast: a silently-downgraded exhaustion would let a
+            # MODELCHECK regen claim collective coverage that never ran
+            raise ValueError(
+                f"protocol {protocol!r} has no quorum-tally knob; "
+                f"cannot explore tally={tally!r}"
+            )
+        overrides.setdefault("tally", tally)
     cfg = dataclasses.replace(
         base.config,
         max_proposals_per_tick=1,
@@ -142,7 +164,7 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
         hear_timeout_lo=4,
         hear_timeout_hi=6,
         retry_interval=2,
-        **(config_overrides or {}),
+        **overrides,
     )
     kernel = make_protocol(protocol, G, R, W, cfg)
     eng = Engine(kernel, netcfg=NetConfig(delay_ticks=1), seed=0)
@@ -212,6 +234,7 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
         protocol=protocol, depth=depth, round_ticks=round_ticks,
         nodes_expanded=expanded, dedup_hits=dedup,
         max_committed_slots=max_committed, violations=violations,
+        tally=getattr(cfg, "tally", "pairwise"),
     )
 
 
@@ -234,12 +257,19 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--protocols",
-        default="multipaxos:6,raft:6,rspaxos:6,crossword:5",
-        help="comma list of name[:depth]; this default regenerates the "
-             "committed MODELCHECK.json in one invocation (crossword "
-             "runs one level shallower: its per-slot shard tallies give "
-             "it the largest per-node state, and depth 5 already covers "
-             "election + window-wrap + gossip under every schedule)",
+        default="multipaxos:6,raft:6,rspaxos:6,crossword:5,"
+                "multipaxos+collective:5,crossword+collective:5",
+        help="comma list of name[+collective][:depth]; this default "
+             "regenerates the committed MODELCHECK.json in one "
+             "invocation (crossword runs one level shallower: its "
+             "per-slot shard tallies give it the largest per-node "
+             "state, and depth 5 already covers election + window-wrap "
+             "+ gossip under every schedule; the +collective rows "
+             "exhaust the in-mesh tally transport of core/quorum.py "
+             "at depth 5 — the equivalence gate already proves "
+             "byte-identity with pairwise, so these rows are the "
+             "independent safety exhaustion, one level shallower to "
+             "bound the regen budget)",
     )
     ap.add_argument("--depth", type=int, default=6,
                     help="depth for entries without an explicit :depth")
@@ -249,9 +279,11 @@ def main() -> None:
     results = []
     for spec in args.protocols.split(","):
         name, _, d = spec.strip().partition(":")
+        name, _, mode = name.partition("+")
         r = explore(name, depth=int(d) if d else args.depth,
                     round_ticks=args.round_ticks,
                     config_overrides=CLI_PRESETS.get(name),
+                    tally=mode or "pairwise",
                     progress=True)
         print(json.dumps(r.as_json()))
         results.append(r.as_json())

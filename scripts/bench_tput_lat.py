@@ -92,6 +92,12 @@ def main():
                          "bench mix, so default trajectories stay "
                          "comparable")
     ap.add_argument("--workload-seed", type=int, default=1)
+    ap.add_argument("--tally", default="pairwise",
+                    choices=("pairwise", "collective"),
+                    help="quorum-tally transport for every replica's "
+                         "kernel (core/quorum.py): collective carries "
+                         "accept-reply records as per-source [G, R] "
+                         "broadcast lanes")
     ap.add_argument("--mesh", default="",
                     help="GxR device mesh for every replica's serving "
                          "state (ServerReplica device_mesh knob; the "
@@ -116,6 +122,10 @@ def main():
     for kv in filter(None, args.config.split(",")):
         k, v = kv.split("=", 1)
         config[k] = json.loads(v)
+    if args.tally != "pairwise":
+        # the kernel-config knob rides the server config dict (any key
+        # matching a config dataclass field passes through)
+        config["tally"] = args.tally
     mesh_shape = None
     if args.mesh:
         # fail fast on an infeasible mesh — malformed spec, more devices
@@ -166,6 +176,9 @@ def main():
         "workload": args.workload,
         "workload_seed": args.workload_seed,
         "workload_digest": plan.digest() if plan is not None else None,
+        # quorum-tally transport stamp (core/quorum.py), next to the
+        # mesh block like bench.py
+        "tally": args.tally,
         # serving-mesh stamp: which device mesh each replica's [G, R]
         # state was sharded over (None = the single-device legacy path);
         # the canonical block shared with bench.py and PROFILE.json

@@ -228,6 +228,124 @@ def check_mesh_sweep(doc: dict, errors: list) -> None:
                 )
 
 
+#: tally-sweep point fields compared strictly (deterministic per
+#: backend); ``committed_slots`` is re-proved > 0 AND equal across the
+#: two tally modes of a point instead of compared to the baseline, and
+#: the measured per-phase device time is never gated strictly
+TALLY_STRICT_FIELDS = (
+    "protocol", "tally", "mesh", "group_shards", "replica_shards",
+    "devices", "groups_per_device", "analytic", "hlo_ops_by_phase",
+    "memory", "tally_lane_shapes",
+)
+
+
+def check_tally_sweep(doc: dict, errors: list) -> None:
+    """The quorum-tally gate (core/quorum.py): the committed pairwise
+    vs collective cells must (a) reproduce exactly (analytic fields
+    strict), (b) show the collective cell of every (protocol, mesh)
+    point STRICTLY reducing the tally phase's HLO op count and the
+    tick's flops/bytes vs its pairwise twin, (c) prove the R² pairwise
+    lanes absent from the collective delay line (lane shapes [D, G, R],
+    not [D, G, R, R]), and (d) make identical consensus progress in
+    both modes — the analytic face of the byte-identical equivalence
+    gate in tests/test_quorum_tally.py."""
+    ts = doc.get("tally_sweep")
+    if not ts:
+        errors.append(
+            "tally_sweep: missing from the committed baseline — the "
+            "collective-tally trajectory is ungated (regenerate with "
+            "scripts/profile_run.py)"
+        )
+        return
+    points = ts.get("points", [])
+    by_key = {}
+    pre_errors = len(errors)
+    for p in points:
+        where = f"tally_sweep[{p.get('protocol')}@{p.get('mesh')}" \
+                f":{p.get('tally')}]"
+        if not p.get("ok", False) or p.get("committed_slots", 0) <= 0:
+            errors.append(f"{where}: committed point made no progress")
+        by_key.setdefault(
+            (p.get("protocol"), p.get("mesh")), {}
+        )[p.get("tally")] = p
+    for (proto, mesh), modes in sorted(by_key.items()):
+        where = f"tally_sweep[{proto}@{mesh}]"
+        pw, co = modes.get("pairwise"), modes.get("collective")
+        if pw is None or co is None:
+            errors.append(f"{where}: missing a tally mode "
+                          f"(have {sorted(modes)})")
+            continue
+        if pw["committed_slots"] != co["committed_slots"]:
+            errors.append(
+                f"{where}: collective progress diverges from pairwise "
+                f"({co['committed_slots']} vs {pw['committed_slots']} "
+                "slots) — the modes must be semantically identical"
+            )
+        for metric in ("tally_phase_ops", "flops", "bytes_accessed"):
+            pv = pw["analytic"].get(metric)
+            cv = co["analytic"].get(metric)
+            if pv is None or cv is None or not cv < pv:
+                errors.append(
+                    f"{where}: collective {metric} not strictly below "
+                    f"pairwise ({cv} vs {pv}) — the in-mesh tally "
+                    "stopped paying for itself"
+                )
+        # delay-line lane geometry ([D, ...]): collective = [D, G, R]
+        # per-source records; pairwise = [D, G, R, R] pair lanes
+        for lane, shape in sorted(co["tally_lane_shapes"].items()):
+            if len(shape) != 3:
+                errors.append(
+                    f"{where}: collective lane {lane} still pairwise-"
+                    f"shaped on the delay line: {shape}"
+                )
+        for lane, shape in sorted(pw["tally_lane_shapes"].items()):
+            if len(shape) != 4:
+                errors.append(
+                    f"{where}: pairwise lane {lane} has unexpected "
+                    f"delay-line shape {shape}"
+                )
+    if len(errors) > pre_errors:
+        return
+    print("analytic: tally sweep ...", flush=True)
+    shape = ts.get("shape", {})
+    cur = profiling.tally_sweep(
+        protocols=tuple(sorted({p["protocol"] for p in points})),
+        meshes=tuple(dict.fromkeys(p["mesh"] for p in points)),
+        G=shape.get("G", profiling.MESH_SWEEP_SHAPE["G"]),
+        R=shape.get("R", profiling.MESH_SWEEP_SHAPE["R"]),
+        W=shape.get("W", profiling.MESH_SWEEP_SHAPE["W"]),
+        ticks=shape.get("ticks", profiling.MESH_SWEEP_TICKS),
+        with_device_trace=False,
+    )
+    if cur["skipped"]:
+        errors.append(
+            f"tally_sweep: cannot re-derive {cur['skipped']} — fewer "
+            "devices visible than the committed baseline used"
+        )
+        return
+    cur_by = {
+        (p["protocol"], p["mesh"], p["tally"]): p for p in cur["points"]
+    }
+    for com in points:
+        key = (com["protocol"], com["mesh"], com["tally"])
+        where = f"tally_sweep[{key[0]}@{key[1]}:{key[2]}]"
+        new = cur_by.get(key)
+        if new is None:
+            errors.append(f"{where}: point missing from re-derived sweep")
+            continue
+        if new.get("committed_slots", 0) <= 0:
+            errors.append(f"{where}: re-derived run made no progress")
+        for field in TALLY_STRICT_FIELDS:
+            if com.get(field) != new.get(field):
+                errors.append(
+                    f"{where}: drift in {field!r}:\n"
+                    f"    committed: "
+                    f"{json.dumps(com.get(field), sort_keys=True)}\n"
+                    f"    current:   "
+                    f"{json.dumps(new.get(field), sort_keys=True)}"
+                )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--profile", default=os.path.join(REPO, "PROFILE.json"))
@@ -324,6 +442,7 @@ def main() -> int:
                 )
 
         check_mesh_sweep(doc, errors)
+        check_tally_sweep(doc, errors)
 
     if not errors and not args.skip_wall:
         for cell in cells:

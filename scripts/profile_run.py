@@ -59,6 +59,10 @@ def main() -> int:
                          "baseline unless CI also runs on that backend)")
     ap.add_argument("--no-overhead", action="store_true")
     ap.add_argument("--no-sweep", action="store_true")
+    ap.add_argument("--no-tally-sweep", action="store_true",
+                    help="skip the quorum-tally before/after sweep "
+                         "(pairwise vs collective per mesh shape — "
+                         "core/quorum.py)")
     ap.add_argument("--no-mesh-sweep", action="store_true",
                     help="skip the mesh-shape sweep (analytic + carry-"
                          "donation introspection per GxR mesh; on the "
@@ -109,6 +113,7 @@ def main() -> int:
         with_overhead=not args.no_overhead,
         with_sweep=not args.no_sweep,
         with_mesh_sweep=not args.no_mesh_sweep,
+        with_tally_sweep=not args.no_tally_sweep,
         mesh_shapes=tuple(
             m.strip() for m in args.mesh.split(",") if m.strip()
         ) or None,

@@ -67,6 +67,18 @@ FORBIDDEN_PRIMITIVES = frozenset({
     "rng_uniform",
 })
 
+# explicit mesh collectives: allowed ONLY inside the quorum_tally phase
+# scope (core/quorum.py) — the in-mesh tally plane is the one sanctioned
+# cross-replica aggregation point; a collective anywhere else in a step
+# is either a sharding leak or an ungated cross-replica read.  (The
+# GSPMD-inserted collectives of the sharded engine never appear in the
+# *traced* jaxpr — this rule governs hand-written lax.psum & friends,
+# e.g. a future shard_map-lowered tally.)
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "all_gather", "all_to_all",
+    "reduce_scatter", "ppermute", "pbroadcast",
+})
+
 _INPUT_SHAPES = {
     "g": lambda G, R: (G,),
     "gr": lambda G, R: (G, R),
@@ -82,7 +94,10 @@ def build_kernel(make_protocol, name: str, variant: str = "device",
 
     ``variant="device"`` is the defaults path; ``variant="host"`` flips
     the host-serving knobs the config exposes (``exec_follows_commit``
-    off, leader leases on) so the serving-mode branches trace too.
+    off, leader leases on) so the serving-mode branches trace too;
+    ``variant="collective"`` flips the quorum-tally transport
+    (``tally="collective"``, core/quorum.py) so the collective-mode
+    lane shapes and ingest views are a verified surface of their own.
     """
     probe = make_protocol(name, G, R, 64)
     cfg = getattr(probe, "config", None)
@@ -93,6 +108,8 @@ def build_kernel(make_protocol, name: str, variant: str = "device",
         overrides["max_proposals_per_tick"] = min(
             cfg.max_proposals_per_tick, W // 2
         )
+    if variant == "collective" and hasattr(cfg, "tally"):
+        overrides["tally"] = "collective"
     if variant == "host":
         if hasattr(cfg, "exec_follows_commit"):
             overrides["exec_follows_commit"] = False
@@ -116,6 +133,16 @@ def host_variant_differs(kernel: ProtocolKernel) -> bool:
     cfg = getattr(kernel, "config", None)
     return hasattr(cfg, "exec_follows_commit") or hasattr(
         cfg, "leader_leases"
+    )
+
+
+def collective_variant_differs(kernel: ProtocolKernel) -> bool:
+    """Kernels with a quorum-tally transport knob get a third verified
+    variant: the collective lane shapes + ingest views of
+    ``tally="collective"`` (core/quorum.py)."""
+    return (
+        hasattr(getattr(kernel, "config", None), "tally")
+        and bool(kernel.TALLY_LANES)
     )
 
 
@@ -173,11 +200,18 @@ def _trace_step(kernel: ProtocolKernel):
     def step_fn(st, ib, ins):
         return kernel.step(st, ib, ins)
 
-    closed = jax.make_jaxpr(step_fn)(state, inbox, inputs)
+    # the tally axis is bound so kernels (and broken-kernel fixtures)
+    # using explicit mesh collectives — lax.psum over TALLY_AXIS, the
+    # shard_map-lowered tally shape — still trace; size 1 makes the
+    # collective the identity for the abstract trace
+    from ..core.quorum import TALLY_AXIS
+
+    closed, out_shape = jax.make_jaxpr(
+        step_fn, axis_env=[(TALLY_AXIS, 1)], return_shape=True
+    )(state, inbox, inputs)
     in_leaves = jax.tree_util.tree_flatten_with_path(
         (state, inbox, inputs)
     )[0]
-    out_shape = jax.eval_shape(step_fn, state, inbox, inputs)
     out_leaves = jax.tree_util.tree_flatten_with_path(out_shape)[0]
 
     def name_of(path) -> Tuple[int, str]:
@@ -354,12 +388,29 @@ def _walk_jaxprs(closed):
                         stack.append(item)
 
 
+def _in_tally_scope(eqn) -> bool:
+    """Was this equation traced under the quorum_tally phase scope?
+    The scope rides each eqn's source_info name stack (the same
+    metadata graftprof's HLO attribution joins on)."""
+    from ..core.quorum import TALLY_SCOPE
+
+    stack = getattr(getattr(eqn, "source_info", None), "name_stack", None)
+    return stack is not None and TALLY_SCOPE in str(stack)
+
+
 def _check_purity(kernel, closed, what: str, out: List[Finding]) -> None:
     name = kernel.name
-    if closed.effects:
+    # NamedAxisEffect is the axis BINDING a mesh collective records —
+    # not host I/O; whether the collective itself is legal is decided
+    # by the scope rule below, not the effects check
+    real_effects = [
+        e for e in closed.effects
+        if type(e).__name__ != "NamedAxisEffect"
+    ]
+    if real_effects:
         out.append(rule_finding(
             "C6", name, what,
-            f"{what} jaxpr carries effects {sorted(map(str, closed.effects))}"
+            f"{what} jaxpr carries effects {sorted(map(str, real_effects))}"
             " (host I/O or ordered side effects inside the kernel)",
         ))
     hit = set()
@@ -371,6 +422,19 @@ def _check_purity(kernel, closed, what: str, out: List[Finding]) -> None:
                 out.append(rule_finding(
                     "C6", name, f"{what}:{pname}",
                     f"forbidden primitive {pname!r} in the {what} jaxpr",
+                ))
+            elif (
+                pname in COLLECTIVE_PRIMITIVES
+                and pname not in hit
+                and not _in_tally_scope(eqn)
+            ):
+                hit.add(pname)
+                out.append(rule_finding(
+                    "C6", name, f"{what}:{pname}",
+                    f"collective primitive {pname!r} outside the "
+                    "quorum_tally phase scope — cross-replica "
+                    "aggregation is sanctioned only inside the in-mesh "
+                    "tally plane (core/quorum.py)",
                 ))
 
 
@@ -605,6 +669,10 @@ def verify_kernel(make_protocol, name: str) -> PassResult:
         variants = [kernel]
         if host_variant_differs(kernel):
             variants.append(build_kernel(make_protocol, name, "host"))
+        if collective_variant_differs(kernel):
+            variants.append(
+                build_kernel(make_protocol, name, "collective")
+            )
         for k in variants:
             found: List[Finding] = []
             plain_state = k.init_state(seed=0)

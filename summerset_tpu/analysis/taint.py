@@ -79,7 +79,8 @@ except ImportError:  # pragma: no cover - older jax
     from jax.core import Literal as _Literal
 
 from .contract import (
-    build_kernel, host_variant_differs, rule_finding, trace_step,
+    build_kernel, collective_variant_differs, host_variant_differs,
+    rule_finding, trace_step,
 )
 from .report import PassResult
 
@@ -111,18 +112,25 @@ _MASK_PRIMS = frozenset({"mul", "and"})
 # in the dead world when both operands' dead classes allow it
 _CMP_PRIMS = frozenset({"eq", "ne", "lt", "le", "gt", "ge"})
 # structural primitives that move a (uniform) value without changing it:
-# the dead class passes straight through
+# the dead class passes straight through (all_gather replicates a
+# uniform value across the axis — still uniform)
 _PRESERVE_PRIMS = frozenset({
     "reshape", "broadcast_in_dim", "squeeze", "expand_dims", "transpose",
     "rev", "copy", "stop_gradient", "convert_element_type", "slice",
-    "reduce_precision",
+    "reduce_precision", "all_gather",
 })
 # reductions over a uniform dead-world value: or/and/max/min of v-with-
-# itself is v; sum/prod are only pinned when the value is zero
+# itself is v; sum/prod are only pinned when the value is zero.  The
+# mesh-collective reductions (the in-mesh quorum tally's segmented
+# forms, core/quorum.py) obey the same algebra: pmax/pmin of a uniform
+# dead-world value keep its class, psum of dead-world zeros is zero —
+# so the dead-world class propagates THROUGH a collective tally and an
+# ungated collective still carries its lane's taint to the sink
 _REDUCE_KEEP = frozenset({
     "reduce_or", "reduce_and", "reduce_max", "reduce_min",
+    "pmax", "pmin",
 })
-_REDUCE_ZERO = frozenset({"reduce_sum", "reduce_prod"})
+_REDUCE_ZERO = frozenset({"reduce_sum", "reduce_prod", "psum"})
 
 # loop-carry fixpoints converge because each round joins the carry with
 # its previous value (nondecreasing in a finite lattice); this cap only
@@ -526,6 +534,13 @@ def verify_kernel_taint(make_protocol, name: str) -> PassResult:
         if host_variant_differs(kernel):
             flows |= analyze_kernel_flows(
                 build_kernel(make_protocol, name, "host")
+            )
+        if collective_variant_differs(kernel):
+            # the collective tally's [G, R] lane views are their own
+            # taint surface: every tally-lane read must still pass the
+            # per-link flags gate (core/quorum.py equivalence argument)
+            flows |= analyze_kernel_flows(
+                build_kernel(make_protocol, name, "collective")
             )
         allow = {
             (src, dst): reason

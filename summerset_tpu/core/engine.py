@@ -100,7 +100,10 @@ class Engine:
                 "countdown lapsed"
             )
         self.seed = seed
-        self.net = NetModel(netcfg, kernel.G, kernel.R, kernel.broadcast_lanes)
+        self.net = NetModel(
+            netcfg, kernel.G, kernel.R, kernel.broadcast_lanes,
+            tally_lanes=kernel.tally_lanes,
+        )
         # the freshly-booted state template a device_reset rewinds
         # volatile rows to (the host analog boots init_state before
         # restore_durable; a ServerReplica always boots seed=0, the

@@ -25,6 +25,16 @@ code indexes axis 1 = self, axis 2 = sender.  Broadcast window lanes
 sender).  When the replica axis is sharded over the mesh, this transpose
 lowers to an all-to-all over ICI (see ``core/sharding.py``).
 
+Quorum-tally lanes (``core/quorum.py``): kernels compiled with
+``tally="collective"`` declare their accept-reply / reconstruct-request
+lanes in ``TALLY_LANES`` and emit them as per-source ``[G, R]``
+broadcast lanes — the pairwise R² fan-out of destination-independent
+records skips the pair-shaped delay-line enqueue entirely, and on a
+replica-sharded mesh their delivery is ONE all-gather instead of the
+all-to-all.  In both modes these lanes' delay-line handling runs under
+the ``quorum_tally`` phase scope so graftprof attributes the tally
+transport cost.
+
 Per-tick call order (driven by the engine):
 
 1. ``netstate, inbox = net.pop(netstate, ctrl)``   — messages due this tick
@@ -34,6 +44,7 @@ Per-tick call order (driven by the engine):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Optional, Tuple
 
@@ -42,8 +53,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import prng
+from .quorum import tally_scope
 
 Pytree = Any
+
+#: resolved value of ``NetConfig.pack_lanes=None`` on the uniform-1-tick
+#: path.  Landed "default off until measured" (PERF.md round 6);
+#: graftprof measured the A/B on the bench shape (PERF.md round 11:
+#: fewer delay-line HLO ops, steady tick within noise of the loose
+#: path), so the default bench/serving path now packs.  Deeper delay
+#: lines (jitter) always stay loose — the jittered enqueue is
+#: per-lane-shaped.
+PACK_LANES_DEFAULT_D1 = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,9 +78,14 @@ class NetConfig:
     # pack same-shape int32 lanes into single stacked tensors through the
     # delay line: one big buffer write/read/transpose instead of ~17
     # per-lane ops per tick — the per-op dispatch floor identified in
-    # PERF.md.  Semantically identical (equivalence-tested); default off
-    # until measured on the real chip.
-    pack_lanes: bool = False
+    # PERF.md.  Semantically identical (equivalence-tested).  None =
+    # the measured default: PACK_LANES_DEFAULT_D1 when the delay line
+    # is the uniform 1-tick swap, off for deeper delay lines.  The
+    # sentinel is KEPT in the field (resolution lives in
+    # ``lanes_packed``) so ``dataclasses.replace`` on a default config
+    # re-derives against the new delay depth instead of carrying a
+    # stale resolved True into a jittered variant and raising.
+    pack_lanes: Optional[bool] = None
 
     def __post_init__(self):
         if self.delay_ticks < 1:
@@ -70,8 +96,16 @@ class NetConfig:
             )
         if self.pack_lanes and self.max_delay_ticks != 1:
             # packing targets the uniform-1-tick bench path; the jittered
-            # delay-line enqueue is per-lane-shaped
+            # delay-line enqueue is per-lane-shaped (only an EXPLICIT
+            # True conflicts — the None default resolves to off here)
             raise ValueError("pack_lanes requires max_delay_ticks == 1")
+
+    @property
+    def lanes_packed(self) -> bool:
+        """The resolved packing decision (what NetModel consults)."""
+        if self.pack_lanes is None:
+            return PACK_LANES_DEFAULT_D1 and self.max_delay_ticks == 1
+        return bool(self.pack_lanes)
 
 
 @dataclasses.dataclass
@@ -184,25 +218,50 @@ class NetModel:
     """
 
     def __init__(self, cfg: NetConfig, num_groups: int, population: int,
-                 broadcast_lanes: frozenset):
+                 broadcast_lanes: frozenset,
+                 tally_lanes: frozenset = frozenset()):
         self.cfg = cfg
         self.G = num_groups
         self.R = population
         self.broadcast_lanes = broadcast_lanes
+        # quorum-tally lanes (core/quorum.py): their delay-line handling
+        # runs under the ``quorum_tally`` phase scope in BOTH tally
+        # modes, so graftprof attributes the tally transport cost
+        # head-to-head (pairwise [G, R, R] lanes vs the collective
+        # [G, R] per-source records).  They stay out of the packed
+        # stacks for the same reason: attribution needs them loose.
+        self.tally_lanes = tally_lanes
         # lane-packing plan: filled lazily from the outbox structure
         self._pack_pair: tuple = ()
         self._pack_bcast: tuple = ()
 
+    def _lane_scope(self, key: str):
+        """Tally lanes trace under the quorum_tally phase scope; every
+        other lane's transport stays unattributed scan plumbing."""
+        if key in self.tally_lanes:
+            return tally_scope()
+        return contextlib.nullcontext()
+
     def _plan_packing(self, zero_outbox: Pytree) -> None:
         """Group same-shape int32 lanes for stacked transport: per-pair
-        [G, R, R] lanes and per-window broadcast [G, R, W] lanes (uniform
-        W only).  ``flags`` (uint32, masked) and odd shapes stay loose."""
+        [G, R, R] lanes and per-window broadcast [G, R_src, W] lanes
+        (uniform W only).  ``flags`` (uint32, masked), odd shapes, and
+        the quorum-tally lanes (kept loose for phase attribution) stay
+        unpacked.  Broadcast lanes are grouped by their FULL shape, so
+        a [G, R] per-source lane (the collective tally records) can
+        never poison the [G, R, W] window-lane stack."""
         pair, bcast = [], []
         bshape = None
         for k, v in zero_outbox.items():
-            if k == "flags" or v.dtype != jnp.int32:
+            if (
+                k == "flags"
+                or v.dtype != jnp.int32
+                or k in self.tally_lanes
+            ):
                 continue
             if k in self.broadcast_lanes:
+                if v.ndim != 3:
+                    continue  # only [G, R_src, W] window lanes stack
                 if bshape is None:
                     bshape = v.shape
                 if v.shape == bshape:
@@ -242,7 +301,7 @@ class NetModel:
 
     def init_netstate(self, zero_outbox: Pytree, seed: int = 17) -> Pytree:
         D = self.cfg.max_delay_ticks
-        if self.cfg.pack_lanes:
+        if self.cfg.lanes_packed:
             self._plan_packing(zero_outbox)
             zero_outbox = self._pack(dict(zero_outbox))
         bufs = jax.tree.map(
@@ -265,14 +324,18 @@ class NetModel:
         D = self.cfg.max_delay_ticks
         cursor = netstate["cursor"]
         bufs = netstate["bufs"]
+        raw = {}
         if D == 1:
-            raw = {k: b[0] for k, b in bufs.items()}
+            for k, b in bufs.items():
+                with self._lane_scope(k):
+                    raw[k] = b[0]
         else:
-            raw = {k: b[cursor] for k, b in bufs.items()}
-            bufs = {
-                k: b.at[cursor].set(jnp.zeros_like(b[0]))
-                for k, b in bufs.items()
-            }
+            nbufs = {}
+            for k, b in bufs.items():
+                with self._lane_scope(k):
+                    raw[k] = b[cursor]
+                    nbufs[k] = b.at[cursor].set(jnp.zeros_like(b[0]))
+            bufs = nbufs
 
         # receiver-side mask: a replica paused *now* receives nothing
         flags = raw["flags"]
@@ -280,25 +343,26 @@ class NetModel:
             flags = jnp.where(ctrl.alive[:, None, :], flags, jnp.uint32(0))
         raw = dict(raw, flags=flags)
 
-        if self.cfg.pack_lanes:
+        if self.cfg.lanes_packed:
             # ONE transpose over the stacked pair tensor, then cheap
             # per-lane slices back into the dict the kernels consume
             inbox = {}
             for k, v in raw.items():
-                if k == "__pair__":
-                    v = jnp.swapaxes(v, 2, 3)
-                elif k != "__bcast__" and k not in self.broadcast_lanes:
-                    v = jnp.swapaxes(v, 1, 2)
-                inbox[k] = v
+                with self._lane_scope(k):
+                    if k == "__pair__":
+                        v = jnp.swapaxes(v, 2, 3)
+                    elif k != "__bcast__" and k not in self.broadcast_lanes:
+                        v = jnp.swapaxes(v, 1, 2)
+                    inbox[k] = v
             inbox = self._unpack(inbox)
         else:
-            inbox = {
-                k: (
-                    v if k in self.broadcast_lanes
-                    else jnp.swapaxes(v, 1, 2)
-                )
-                for k, v in raw.items()
-            }
+            inbox = {}
+            for k, v in raw.items():
+                with self._lane_scope(k):
+                    inbox[k] = (
+                        v if k in self.broadcast_lanes
+                        else jnp.swapaxes(v, 1, 2)
+                    )
         return dict(netstate, bufs=bufs), inbox
 
     def push(
@@ -350,13 +414,17 @@ class NetModel:
                 telem, "net_drops", jnp.sum(lost.astype(jnp.int32), axis=2)
             )
         outbox = dict(outbox, flags=jnp.where(mask, flags, jnp.uint32(0)))
-        if self.cfg.pack_lanes:
+        if self.cfg.lanes_packed:
             outbox = self._pack(outbox)
 
         tick = netstate["tick"]
         last_due = netstate["last_due"]
         if D == 1:
-            bufs = {k: b.at[0].set(outbox[k]) for k, b in bufs.items()}
+            nbufs = {}
+            for k, b in bufs.items():
+                with self._lane_scope(k):
+                    nbufs[k] = b.at[0].set(outbox[k])
+            bufs = nbufs
         else:
             # Jitter per (group, source) — not per link — so a source's
             # broadcast window lanes land in the same delay slot as its
@@ -397,7 +465,11 @@ class NetModel:
                 oh = oh.reshape(oh.shape + (1,) * (field.ndim - 2))
                 return jnp.where(oh, field[None], buf)
 
-            bufs = {k: enqueue(bufs[k], outbox[k]) for k in outbox}
+            nbufs = {}
+            for k in outbox:
+                with self._lane_scope(k):
+                    nbufs[k] = enqueue(bufs[k], outbox[k])
+            bufs = nbufs
 
         out = {
             "bufs": bufs,

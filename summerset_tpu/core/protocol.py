@@ -96,7 +96,11 @@ KERNEL_CONTRACT: Tuple[Tuple[str, str, str], ...] = (
     ("C6", "step-purity",
      "step traces to a jaxpr with no host callbacks, no effects, and "
      "no nondeterministic primitives (init_state runs eagerly on the "
-     "host exactly once and is exempt)"),
+     "host exactly once and is exempt); explicit mesh collectives "
+     "(psum / all_gather / reduce_scatter family) are permitted ONLY "
+     "inside the quorum_tally phase scope — the one place the "
+     "in-mesh tally plane (core/quorum.py) sanctions cross-replica "
+     "aggregation"),
     ("C7", "carry-stability",
      "step returns a state pytree structurally identical (keys, shapes, "
      "dtypes) to its input — the lax.scan carry contract"),
@@ -159,6 +163,20 @@ class ProtocolKernel:
     # AST-cross-checks every input-name literal the kernel's class
     # bodies read against this table.
     EXTRA_INPUTS: Tuple[Tuple[str, str], ...] = ()
+    # -- quorum-tally plane (core/quorum.py) --------------------------------
+    # Outbox lanes that carry per-source tally records (accept-reply
+    # frontiers, reconstruct-request ranges): values that do not depend
+    # on the destination, fanned out pairwise only because the lane
+    # shape demanded it.  Under ``config.tally == "collective"`` these
+    # lanes shrink from ``[G, R_src, R_dst]`` pair fields to per-source
+    # ``[G, R_src]`` broadcast lanes (delivery = the broadcast path; an
+    # all-gather over a sharded replica axis) while the ``flags``
+    # pair-field keeps per-link masking/visibility semantics — see the
+    # core/quorum.py module doc for the equivalence argument.  The
+    # netmodel tags these lanes' delay-line transport with the
+    # ``quorum_tally`` phase scope in BOTH modes so graftprof compares
+    # the tally cost head-to-head.
+    TALLY_LANES: Tuple[str, ...] = ()
     # -- phase registry (graftprof) -----------------------------------------
     # The kernel's named step phases, in execution order, as
     # (phase_name, method_name) pairs.  Each method has the uniform
@@ -252,6 +270,21 @@ class ProtocolKernel:
     @property
     def quorum(self) -> int:
         return self.population // 2 + 1
+
+    # -- quorum-tally plane shorthands ---------------------------------------
+    @property
+    def collective_tally(self) -> bool:
+        """True when this kernel's config selects the collective tally
+        (``tally="collective"``): TALLY_LANES ride the delay line as
+        per-source ``[G, R]`` broadcast lanes instead of R² pair lanes."""
+        return (
+            getattr(getattr(self, "config", None), "tally", "pairwise")
+            == "collective"
+        )
+
+    @property
+    def tally_lanes(self) -> FrozenSet[str]:
+        return frozenset(self.TALLY_LANES)
 
     # -- telemetry SPI -------------------------------------------------------
     # The engine attaches a [G, R, K] int32 metric-lane block to the state

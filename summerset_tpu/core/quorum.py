@@ -1,0 +1,148 @@
+"""Collective quorum-tally plane: consensus majority-counting as a
+replica-axis reduction instead of R² pairwise message traffic.
+
+NetPaxos ("Network Hardware-Accelerated Consensus") and "Paxos Made
+Switch-y" move vote counting into the programmable switch: acceptors
+emit votes, the *network* tallies them, and the coordinator reads one
+aggregated result.  The TPU-mesh analog: acceptor tally records are
+per-SOURCE ``[G, R]`` lanes instead of per-PAIR ``[G, R_src, R_dst]``
+lanes, delivery is the broadcast-lane path (an all-gather over the
+replica mesh axis when it is sharded — one collective per tick instead
+of the pairwise all-to-all), and the quorum frontier falls out of a
+segmented reduction over the gathered lanes
+(:func:`quorum_frontier` / :func:`coverage_frontier`).
+
+Two modes, selected by the ``tally`` field of the kernel config
+(``"pairwise"`` — the default, digest-compatible with every committed
+artifact — or ``"collective"``):
+
+- **pairwise**: the accept-reply lanes (``ar_*``; RSPaxos/Crossword add
+  the reconstruct-request lanes ``rq_*``) are ``[G, R, R]`` outbox
+  leaves: R² int32 values enqueued through the netmodel delay line per
+  lane per tick and transposed to receiver orientation on pop.
+- **collective**: the same lanes are declared in
+  ``ProtocolKernel.TALLY_LANES`` and shrink to per-source ``[G, R]``
+  broadcast lanes — a follower's tally record (vote ballot, run start,
+  durable frontier, nack hint) does not depend on the destination, so
+  the pairwise fan-out carried R copies of the same value.  The
+  ``flags`` pair-field still carries the ACCEPT_REPLY/nack bits per
+  link, so masking (drops, partitions, pauses), the delay model's
+  visibility semantics, and every receiver-side gate are EXACTLY the
+  pairwise ones: the collective reads the same D-tick-delayed vote
+  lanes the pairwise path would have delivered, and the equivalence
+  gate (tests/test_quorum_tally.py) holds state/effects/telemetry
+  byte-identical between the modes.
+
+Phase attribution: everything tally-shaped — the netmodel's delay-line
+handling of the declared tally lanes (both modes) and the kernels'
+frontier reductions — runs under the ``quorum_tally`` phase scope
+(:data:`PHASE_TALLY`), so graftprof's per-phase HLO/op/device-time
+attribution measures the pairwise-vs-collective cost head-to-head
+(PROFILE.json ``tally_sweep``, gated by scripts/perf_gate.py).
+
+Lint surface: hand-written mesh collectives (``lax.psum`` & friends —
+the shard_map lowering a future pod-scale tally may use) are permitted
+by graftlint rule C6 ONLY inside the ``quorum_tally`` scope;
+:data:`TALLY_AXIS` is the axis name the verifier's trace environment
+binds so such kernels remain traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable
+
+import jax.numpy as jnp
+
+from .protocol import PHASE_SCOPE_PREFIX, phase_scope
+
+Pytree = Any
+
+#: the phase name the tally plane is attributed to (kernels declare it
+#: in PHASES; the netmodel tags tally-lane transport with it)
+PHASE_TALLY = "quorum_tally"
+#: full scope string as it appears in jaxpr name stacks / HLO op_name
+TALLY_SCOPE = PHASE_SCOPE_PREFIX + PHASE_TALLY
+#: the mesh axis name bound by the graftlint trace environment so
+#: explicit in-kernel collectives (lax.psum over this axis) trace
+TALLY_AXIS = "tally"
+
+TALLY_MODES = ("pairwise", "collective")
+
+
+def check_tally(mode: str) -> str:
+    if mode not in TALLY_MODES:
+        raise ValueError(
+            f"unknown tally mode {mode!r}; pick one of {TALLY_MODES}"
+        )
+    return mode
+
+
+def tally_scope():
+    """The named scope all tally-plane work runs under (netmodel lane
+    transport + kernel frontier reductions); honors the graftprof
+    phase-scope ablation switch like every kernel phase."""
+    return phase_scope(PHASE_TALLY)
+
+
+def pair_views(
+    inbox: Pytree, names: Iterable[str], collective: bool
+) -> Dict[str, Any]:
+    """Receiver-oriented views of the tally lanes.
+
+    Pairwise mode: the lanes arrive transposed ``[G, R_dst, R_src]``
+    and are returned as-is.  Collective mode: the lanes arrive as
+    per-source ``[G, R_src]`` broadcasts and are viewed as
+    ``[G, 1, R_src]`` so every receiver-side expression broadcasts over
+    the destination axis unchanged.  At every position where the flags
+    pair-field carries the reply bit, the two views are value-identical
+    — which is the whole equivalence argument: all consumer code gates
+    on flags, so the modes produce byte-identical state.
+    """
+    if not collective:
+        return {k: inbox[k] for k in names}
+    return {k: inbox[k][:, None, :] for k in names}
+
+
+def source_lane(gate, value):
+    """Collective-mode outbox write: one per-source ``[G, R]`` record
+    (``value`` where ``gate``, else 0) replacing the pairwise
+    ``jnp.where(do_send, value[..., None], 0)`` R²-fan-out."""
+    return jnp.where(gate, value, 0)
+
+
+# ------------------------------------------------------ segmented tallies --
+_INF = jnp.int32(1 << 30)
+
+
+def quorum_frontier(frontiers, k: int):
+    """k-th largest cumulative frontier along the last (replica) axis —
+    the accept-quorum frontier of every group in ONE segmented
+    reduction: the highest slot bound that >= k replicas acked
+    everything below.  Under a replica-sharded mesh the sort/reduce
+    lowers to a replica-axis collective (GSPMD inserts it); this is the
+    in-mesh analog of the switch's vote counter."""
+    r = frontiers.shape[-1]
+    return jnp.sort(frontiers, axis=-1)[..., r - k]
+
+
+def coverage_frontier(cover, abs_w, need, slot_known, in_rng):
+    """First absolute slot whose coverage fails — the per-slot
+    (Crossword shard-coverage) quorum tally as one segmented reduction
+    over ``[G, R, R_peer, W]``.
+
+    ``cover``      [G, R, R_peer] cumulative per-peer frontiers;
+    ``abs_w``      [G, R, W] absolute slots of the ring window;
+    ``need``       [G, R, W] per-slot required count (assignment-width
+                   dependent);
+    ``slot_known`` [G, R, W] the window actually holds that slot;
+    ``in_rng``     [G, R, W] slots that must pass (below the target
+                   frontier).
+
+    Returns ``[G, R]``: the minimum failing absolute slot (INF when the
+    whole range passes); callers clip against their frontier bound.
+    """
+    cnt = (cover[..., :, None] > abs_w[..., None, :]).sum(
+        axis=2, dtype=jnp.int32
+    )
+    fail = in_rng & ~((cnt >= need) & slot_known)
+    return jnp.min(jnp.where(fail, abs_w, _INF), axis=2)

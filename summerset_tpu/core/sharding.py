@@ -11,6 +11,16 @@ insert collectives" recipe): a 2-D logical mesh with axes
                 ``swapaxes(1, 2)`` delivery lowers to an all-to-all over
                 ICI — the collective analog of the reference's full TCP
                 mesh among replicas (``src/server/transport.rs``).
+                The quorum-tally plane (``core/quorum.py``,
+                ``tally="collective"``) narrows this further: tally
+                records ride per-source ``[G, R]`` broadcast lanes whose
+                sharded delivery is ONE replica-axis all-gather — the
+                NetPaxos-style in-mesh vote tally — instead of the
+                pairwise lanes' all-to-all.  Both lane families shard
+                under the same ``state_sharding`` rule (leading
+                ``[G, R(, ...)]``; ``[D, G, R(, ...)]`` for delay-line
+                buffers), so no extra constraint spec is needed: GSPMD
+                derives the gather from the lane's receiver-side use.
 
 Multi-host scaling rides the same mesh: groups shard over DCN-connected
 hosts (no cross-group traffic crosses DCN), replica all-to-alls stay inside
@@ -162,20 +172,40 @@ def mesh_stamp(group_shards: int, replica_shards: int, G: int) -> dict:
     }
 
 
+def _place_copy(leaf, sharding):
+    """``device_put`` that GUARANTEES fresh buffers.
+
+    ``jax.device_put`` short-circuits when the array is already placed
+    compatibly — on a 1x1 mesh (or any placement matching the source)
+    it returns the SAME buffers, so a later donation of the "copy"
+    deletes the caller's original out from under it.  That bit for
+    real: the engine's boot template (closed over by the jitted tick
+    and reused by ``reset_durable_rows`` and later ``init()`` calls)
+    was deleted by the first donated window on a 1x1 mesh, and the
+    reset path read freed memory.  An explicit device-side copy first
+    makes the promise in the name unconditional; the extra copy is
+    init-time only, never on the tick path."""
+    import jax.numpy as jnp
+
+    return jax.device_put(jnp.array(leaf, copy=True), sharding)
+
+
 def shard_pytree(mesh: Mesh, tree: Pytree) -> Pytree:
     """Place a state pytree onto the mesh with the group/replica layout.
 
-    Returns NEW arrays (``device_put`` copies): the caller's originals —
-    e.g. the engine's boot template, which the jitted tick also closes
-    over — stay valid even when the placed copies are later donated."""
+    Returns NEW arrays (guaranteed — see :func:`_place_copy`): the
+    caller's originals — e.g. the engine's boot template, which the
+    jitted tick also closes over — stay valid even when the placed
+    copies are later donated."""
     shardings = state_sharding(mesh, tree)
-    return jax.tree.map(jax.device_put, tree, shardings)
+    return jax.tree.map(_place_copy, tree, shardings)
 
 
 def shard_netstate(mesh: Mesh, netstate: Pytree) -> Pytree:
-    """Place a netstate onto the mesh (delay axis replicated)."""
+    """Place a netstate onto the mesh (delay axis replicated; fresh
+    buffers guaranteed like :func:`shard_pytree`)."""
     shardings = netstate_sharding(mesh, netstate)
-    return jax.tree.map(jax.device_put, netstate, shardings)
+    return jax.tree.map(_place_copy, netstate, shardings)
 
 
 def constrain_state(mesh: Mesh, state: Pytree) -> Pytree:

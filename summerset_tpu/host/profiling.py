@@ -63,6 +63,7 @@ from ..core.protocol import (
     phase_scopes_enabled,
     set_phase_scopes,
 )
+from ..core.quorum import TALLY_MODES
 
 PROFILE_VERSION = 1
 
@@ -92,6 +93,18 @@ G_SWEEP = (16, 64, 256)
 MESH_SWEEP = ("1x1", "2x1", "4x1", "2x2")
 MESH_SWEEP_SHAPE: Dict[str, int] = {"G": 64, "R": 4, "W": 16}
 MESH_SWEEP_TICKS = 32
+
+#: the quorum-tally plane's before/after axis (core/quorum.py): per
+#: (protocol, mesh shape, tally mode) analytic cells at the mesh-sweep
+#: shape — the pairwise R² accept-reply lanes vs the collective
+#: per-source records, with the tally phase's op count and the delay
+#: line's lane shapes recorded so the perf gate can assert the
+#: collective cells strictly shrink.  2x2 splits the replica axis, so
+#: the collective point's lane delivery is a genuine cross-device
+#: gather.  Crossword rides along: its shard-coverage quorums are the
+#: largest win surface.
+TALLY_SWEEP_PROTOCOLS = ("multipaxos", "crossword")
+TALLY_SWEEP_MESHES = ("1x1", "2x2")
 
 _PHASE_RE = re.compile(PHASE_SCOPE_PREFIX + r"(\w+)")
 # one optimized-HLO instruction definition: "%name = ..." (ROOT or not),
@@ -596,6 +609,129 @@ def mesh_sweep(
     }
 
 
+def tally_cell(
+    name: str,
+    tally: str,
+    spec: str,
+    G: int = MESH_SWEEP_SHAPE["G"],
+    R: int = MESH_SWEEP_SHAPE["R"],
+    W: int = MESH_SWEEP_SHAPE["W"],
+    ticks: int = MESH_SWEEP_TICKS,
+    with_device_trace: bool = False,
+) -> Dict[str, Any]:
+    """One (protocol, tally mode, mesh shape) point of the quorum-tally
+    before/after (core/quorum.py).
+
+    Deterministic per backend except ``committed_slots`` (a progress
+    proof the gate re-asserts > 0 — and EQUAL across tally modes at the
+    same point, the analytic face of the byte-identical equivalence
+    gate) and the optional measured per-phase device time."""
+    import numpy as np
+
+    from ..core import sharding as _shard
+    from ..core.quorum import PHASE_TALLY
+
+    gs, rs = _shard.parse_mesh(spec)
+    variant = "collective" if tally == "collective" else "device"
+    kernel = _build_cell_kernel(name, variant, G, R, W)
+    proposals = min(
+        4, getattr(kernel.config, "max_proposals_per_tick", 4)
+    )
+    mesh = _shard.mesh_for(gs, rs) if gs * rs > 1 else None
+    eng = Engine(kernel, mesh=mesh)
+    state, ns = eng.init()
+    # the acceptance-criterion lane geometry, straight off the delay
+    # line: pairwise tally lanes are [D, G, R, R]; collective ones are
+    # [D, G, R] — the R² pair-shaped enqueue is ABSENT
+    lane_shapes = {
+        lane: list(ns["bufs"][lane].shape)
+        for lane in kernel.TALLY_LANES
+    }
+
+    inputs = _synth_inputs(kernel, proposals)
+    tick_comp = eng.lower_tick(state, ns, inputs).compile()
+    tick_text = tick_comp.as_text()
+    hlo_total, by_phase = hlo_phase_ops(tick_text)
+
+    cell: Dict[str, Any] = {
+        "protocol": name,
+        "tally": tally,
+        **_shard.mesh_stamp(gs, rs, G),
+        "analytic": dict(
+            _norm_cost(tick_comp),
+            hlo_instructions=hlo_total,
+            tally_phase_ops=by_phase.get(PHASE_TALLY, 0),
+        ),
+        "hlo_ops_by_phase": by_phase,
+        "memory": _mem_stats(tick_comp),
+        "tally_lane_shapes": lane_shapes,
+    }
+    scan_comp = eng.lower_synthetic(state, ns, ticks, proposals).compile()
+    state, ns = scan_comp(state, ns)
+    state, ns = scan_comp(state, ns)
+    jax.block_until_ready(state["commit_bar"])
+    slots = int(np.asarray(state["commit_bar"]).max(axis=1).sum())
+    cell["committed_slots"] = slots
+    cell["ok"] = slots > 0
+    if with_device_trace:
+        scan_text = scan_comp.as_text()
+
+        def run_once():
+            out = scan_comp(state, ns)
+            jax.block_until_ready(out[0]["commit_bar"])
+
+        pw = capture_phase_walltime(scan_text, run_once, ticks)
+        cell["phase_wall_us_per_tick"] = pw
+        if pw:
+            cell["tally_phase_wall_us"] = pw.get(PHASE_TALLY, 0.0)
+    return cell
+
+
+def tally_sweep(
+    protocols: Tuple[str, ...] = TALLY_SWEEP_PROTOCOLS,
+    meshes: Tuple[str, ...] = TALLY_SWEEP_MESHES,
+    G: int = MESH_SWEEP_SHAPE["G"],
+    R: int = MESH_SWEEP_SHAPE["R"],
+    W: int = MESH_SWEEP_SHAPE["W"],
+    ticks: int = MESH_SWEEP_TICKS,
+    with_device_trace: bool = True,
+    log=lambda m: None,
+) -> Dict[str, Any]:
+    """The quorum-tally before/after table (PROFILE.json
+    ``tally_sweep``): every (protocol, mesh, tally mode) cell at the
+    mesh-sweep shape.  Device-time capture runs on the single-device
+    points only (multi-device CPU trace attribution is not stable
+    enough to commit).  Shapes the pod cannot fit are recorded under
+    ``skipped`` — never silently dropped."""
+    from ..core.sharding import parse_mesh
+
+    points = []
+    skipped = []
+    ndev = len(jax.devices())
+    for name in protocols:
+        for spec in meshes:
+            gs, rs = parse_mesh(spec)
+            if gs * rs > ndev:
+                skipped.append({
+                    "protocol": name, "mesh": spec,
+                    "reason": f"needs {gs * rs} devices, {ndev} visible",
+                })
+                continue
+            for tally in TALLY_MODES:
+                log(f"tally sweep {name} @ {spec} [{tally}] ...")
+                points.append(tally_cell(
+                    name, tally, spec, G=G, R=R, W=W, ticks=ticks,
+                    with_device_trace=(
+                        with_device_trace and gs * rs == 1
+                    ),
+                ))
+    return {
+        "shape": {"G": G, "R": R, "W": W, "ticks": ticks},
+        "points": points,
+        "skipped": skipped,
+    }
+
+
 def g_sweep(
     name: str = "multipaxos",
     groups: Tuple[int, ...] = G_SWEEP,
@@ -632,6 +768,7 @@ def build_profile(
     with_overhead: bool = True,
     with_sweep: bool = True,
     with_mesh_sweep: bool = True,
+    with_tally_sweep: bool = True,
     mesh_shapes: Optional[Tuple[str, ...]] = None,
     log=print,
 ) -> Dict[str, Any]:
@@ -664,6 +801,9 @@ def build_profile(
         doc["mesh_sweep"] = mesh_sweep(
             protocols[0], meshes=mesh_shapes or MESH_SWEEP, log=log
         )
+    if with_tally_sweep:
+        log("quorum-tally sweep (pairwise vs collective) ...")
+        doc["tally_sweep"] = tally_sweep(log=log)
     if with_overhead:
         log("phase-scope overhead ablation A/B ...")
         doc["scope_overhead"] = measure_scope_overhead(

@@ -83,9 +83,13 @@ def kth_largest(values, k: int):
     highest slot bound such that >= quorum replicas acked everything below it
     — the vectorized form of the reference's per-slot quorum count
     (``multipaxos/messages.rs:370-442``) under FIFO range streams.
+    Delegates to the quorum-tally plane's canonical segmented reduction
+    (``core/quorum.py``), which is what lowers to a replica-axis
+    collective on a sharded mesh.
     """
-    r = values.shape[-1]
-    return jnp.sort(values, axis=-1)[..., r - k]
+    from ..core.quorum import quorum_frontier
+
+    return quorum_frontier(values, k)
 
 
 # --------------------------------------------------- shared lockstep blocks --

@@ -48,10 +48,9 @@ import dataclasses
 
 import jax.numpy as jnp
 
+from ..core import quorum as quorum_lib
 from . import register_protocol
 from .common import (
-    INF as _INF,
-    advance_durability,
     not_self,
     range_cover,
     take_lane,
@@ -219,43 +218,33 @@ class CrosswordKernel(RSPaxosKernel):
         # widened choice applies to slots proposed from now on.
 
     # ----------------------------------------------- per-slot commit tally
-    def _advance_bars(self, s, c):
+    def _tally_frontier(self, s, c, peer_f):
+        """Crossword's shard-coverage quorum as ONE segmented reduction
+        (core/quorum.py): per-slot coverage counting over the
+        ``[G, R, R_peer, W]`` ack-vs-slot bitmap, with the per-slot
+        required count derived from each instance's voted assignment
+        width.  Runs inside the ``quorum_tally`` phase the base class
+        declares, so graftprof attributes it alongside the transport."""
         W = self.W
-        s["dur_bar"] = advance_durability(
-            s, self.config.dur_lag, frontier="vote_bar"
-        )
-        peer_f = self._peer_frontiers(s)
         _, abs_w = range_cover(s["commit_bar"], s["commit_bar"] + W, W)
-        # cnt[g,r,w] = how many peers acked past slot w
-        cnt = (peer_f[..., :, None] > abs_w[..., None, :]).sum(
-            axis=2, dtype=jnp.int32
+        fail_abs = quorum_lib.coverage_frontier(
+            peer_f, abs_w,
+            need=self._commit_need(s["win_spr"]),
+            slot_known=s["win_abs"] == abs_w,
+            in_rng=abs_w < s["next_slot"][..., None],
         )
-        need = self._commit_need(s["win_spr"])
-        slot_known = s["win_abs"] == abs_w
-        in_rng = abs_w < s["next_slot"][..., None]
-        fail = in_rng & ~((cnt >= need) & slot_known)
-        fail_abs = jnp.min(jnp.where(fail, abs_w, _INF), axis=2)
-        cap = self._commit_cap(s, c, peer_f)
-        q_f = jnp.minimum(jnp.minimum(fail_abs, s["next_slot"]), cap)
-        s["commit_bar"] = jnp.where(
-            c.active_leader,
-            jnp.clip(q_f, s["commit_bar"], s["next_slot"]),
-            s["commit_bar"],
-        )
-        self._exec_gate(s, c)
+        return jnp.minimum(fail_abs, s["next_slot"])
 
     # ------------------------------------------- per-slot gossip cover tally
     def _advance_full_bar(self, s, cover):
         W = self.W
         _, abs_w = range_cover(s["full_bar"], s["full_bar"] + W, W)
-        cnt = (cover[..., :, None] > abs_w[..., None, :]).sum(
-            axis=2, dtype=jnp.int32
+        fail_abs = quorum_lib.coverage_frontier(
+            cover, abs_w,
+            need=self._recover_need(s["win_spr"]),
+            slot_known=s["win_abs"] == abs_w,
+            in_rng=abs_w < s["commit_bar"][..., None],
         )
-        need = self._recover_need(s["win_spr"])
-        slot_known = s["win_abs"] == abs_w
-        in_rng = abs_w < s["commit_bar"][..., None]
-        fail = in_rng & ~((cnt >= need) & slot_known)
-        fail_abs = jnp.min(jnp.where(fail, abs_w, _INF), axis=2)
         s["full_bar"] = jnp.clip(
             jnp.minimum(fail_abs, s["commit_bar"]),
             s["full_bar"],

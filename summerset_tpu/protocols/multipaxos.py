@@ -55,6 +55,7 @@ from typing import Any, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core import quorum as quorum_lib
 from ..core.protocol import ProtocolKernel, StepEffects
 from ..ops import prng
 from ..utils.bitmap import popcount
@@ -68,7 +69,6 @@ from .common import (
     client_intake,
     dst_onehot,
     initial_ballot,
-    kth_largest,
     make_greater_ballot,
     not_self,
     range_cover,
@@ -119,11 +119,27 @@ class ReplicaConfigMultiPaxos:
     # plane is active (core/engine.py); host deployments over real TCP
     # must budget it against tick_interval x observed one-way latency.
     lease_margin: int = 3
+    # quorum-tally transport (core/quorum.py): "pairwise" keeps the
+    # accept-reply lanes as R² [G, R, R] delay-line traffic (the
+    # digest-compatible default); "collective" shrinks them to
+    # per-source [G, R] broadcast records — the NetPaxos-style in-mesh
+    # tally — with byte-identical state/effects/telemetry (the flags
+    # pair-field keeps per-link masking, so the collective reads the
+    # same D-tick-delayed votes the pairwise path would deliver)
+    tally: str = "pairwise"
 
 
 @register_protocol("MultiPaxos")
 class MultiPaxosKernel(ProtocolKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_bal", "bw_val"})
+
+    # quorum-tally lanes (core/quorum.py): the accept-reply record a
+    # follower sends its leader is destination-independent (vote
+    # ballot, run start, durable frontier, nack rewind hint) — under
+    # ``tally="collective"`` these shrink from [G, R, R] pair lanes to
+    # per-source [G, R] broadcast records while the flags pair-field
+    # keeps per-link visibility (ACCEPT_REPLY / AR_NACK bits)
+    TALLY_LANES: Tuple[str, ...] = ("ar_bal", "ar_from", "ar_f", "ar_hint")
 
     # voluntary leader demotion (gray-failure mitigation): a [G, R] bool
     # mask from the host — rows the health plane indicted abandon their
@@ -178,6 +194,14 @@ class MultiPaxosKernel(ProtocolKernel):
     ):
         super().__init__(num_groups, population, window)
         self.config = config or ReplicaConfigMultiPaxos()
+        quorum_lib.check_tally(getattr(self.config, "tally", "pairwise"))
+        if self.collective_tally:
+            # collective tally records are per-source lanes: delivered
+            # by the broadcast path (all-gather over a sharded replica
+            # axis), never transposed
+            self.broadcast_lanes = (
+                frozenset(type(self).broadcast_lanes) | self.tally_lanes
+            )
         if self.config.max_proposals_per_tick > window // 2:
             raise ValueError("max_proposals_per_tick must be <= window/2")
         if getattr(self.config, "leader_leases", False) and (
@@ -284,11 +308,17 @@ class MultiPaxosKernel(ProtocolKernel):
         G, R, W = self.G, self.R, self.W
         i32 = jnp.int32
         pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        # tally lanes: per-source [G, R] records in collective mode
+        # (core/quorum.py), classic [G, R, R] pair lanes otherwise
+        tlane = (
+            (lambda: jnp.zeros((G, R), i32))
+            if self.collective_tally else pair
+        )
         out = {
             "flags": jnp.zeros((G, R, R), jnp.uint32),
             "acc_bal": pair(), "acc_lo": pair(), "acc_hi": pair(),
-            "ar_bal": pair(), "ar_from": pair(), "ar_f": pair(),
-            "ar_hint": pair(),
+            "ar_bal": tlane(), "ar_from": tlane(), "ar_f": tlane(),
+            "ar_hint": tlane(),
             "hb_bal": pair(), "hb_cbar": pair(), "hb_ebar": pair(),
             "hbr_ebar": pair(),
             "prp_bal": pair(), "prp_trigger": pair(),
@@ -320,6 +350,7 @@ class MultiPaxosKernel(ProtocolKernel):
         ("election", "_election"),
         ("try_step_up", "_try_step_up"),
         ("leader_propose", "_leader_propose"),
+        (quorum_lib.PHASE_TALLY, "_phase_quorum_tally"),
         ("advance_bars", "_advance_bars"),
         ("build_outbox", "_phase_build_outbox"),
         ("telemetry", "_phase_telemetry"),
@@ -499,29 +530,34 @@ class MultiPaxosKernel(ProtocolKernel):
     # ========== 4. ACCEPT_REPLY ingest (leader match bookkeeping)
     def _ingest_accept_reply(self, s, c):
         cfg = self.config
-        inbox = c.inbox
+        # receiver-oriented tally views: pairwise lanes as delivered, or
+        # collective [G, R_src] records broadcast over the dst axis —
+        # value-identical wherever the flags bit is set (core/quorum.py)
+        ar = quorum_lib.pair_views(
+            c.inbox, self.TALLY_LANES, self.collective_tally
+        )
         ar_valid = (c.flags & ACCEPT_REPLY) != 0
         i_am_leader = (s["bal_prepared"] == s["bal_max"]) & (
             s["bal_prepared"] > 0
         )
         ar_mine = (
             ar_valid
-            & (inbox["ar_bal"] == s["bal_max"][..., None])
+            & (ar["ar_bal"] == s["bal_max"][..., None])
             & i_am_leader[..., None]
         )
-        prog = ar_mine & (inbox["ar_f"] > s["match_f"])
+        prog = ar_mine & (ar["ar_f"] > s["match_f"])
         c.ar_prog = prog
         s["match_f"] = jnp.where(
-            ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"]
+            ar_mine, jnp.maximum(s["match_f"], ar["ar_f"]), s["match_f"]
         )
         s["match_from"] = jnp.where(
-            ar_mine, inbox["ar_from"], s["match_from"]
+            ar_mine, ar["ar_from"], s["match_from"]
         )
-        s["match_bal"] = jnp.where(ar_mine, inbox["ar_bal"], s["match_bal"])
+        s["match_bal"] = jnp.where(ar_mine, ar["ar_bal"], s["match_bal"])
         ar_nacked = ar_mine & ((c.flags & AR_NACK) != 0)
         s["next_idx"] = jnp.where(
             ar_nacked,
-            jnp.minimum(s["next_idx"], inbox["ar_hint"]),
+            jnp.minimum(s["next_idx"], ar["ar_hint"]),
             s["next_idx"],
         )
         s["retry_cnt"] = jnp.where(
@@ -826,16 +862,30 @@ class MultiPaxosKernel(ProtocolKernel):
         eye = jnp.eye(self.R, dtype=jnp.bool_)[None]
         return jnp.where(eye, s["dur_bar"][..., None], peer_f)
 
-    # ========== 10. durability + leader commit tally + exec
-    def _advance_bars(self, s, c):
+    # ========== 10. quorum tally: durability + the frontier reduction
+    def _phase_quorum_tally(self, s, c):
+        """The tally phase (core/quorum.py): advance the durable-ack
+        frontier, assemble the per-peer ballot-matched frontiers, and
+        reduce them to every group's accept-quorum frontier in one
+        segmented replica-axis reduction.  Scoped as ``quorum_tally``
+        so graftprof attributes the tally cost in both transport modes
+        (the netmodel tags the ar_* lanes' delay-line work with the
+        same scope)."""
         s["dur_bar"] = advance_durability(
             s, self.config.dur_lag, frontier="vote_bar"
         )
-        peer_f = self._peer_frontiers(s)
-        q_f = jnp.minimum(
-            kth_largest(peer_f, self.commit_k),
-            self._commit_cap(s, c, peer_f),
-        )
+        c.peer_f = self._peer_frontiers(s)
+        c.q_tally = self._tally_frontier(s, c, c.peer_f)
+
+    def _tally_frontier(self, s, c, peer_f):
+        """Hook: segmented reduction over acked frontiers -> [G, R]
+        accept-quorum frontier (Crossword swaps in its per-slot
+        shard-coverage tally)."""
+        return quorum_lib.quorum_frontier(peer_f, self.commit_k)
+
+    # ========== 10b. commit/exec bar advance off the tallied frontier
+    def _advance_bars(self, s, c):
+        q_f = jnp.minimum(c.q_tally, self._commit_cap(s, c, c.peer_f))
         s["commit_bar"] = jnp.where(
             c.active_leader,
             jnp.clip(q_f, s["commit_bar"], s["next_slot"]),
@@ -932,7 +982,11 @@ class MultiPaxosKernel(ProtocolKernel):
         oflags = oflags | jnp.where(do_hbr, jnp.uint32(HB_REPLY), 0)
         out["hbr_ebar"] = jnp.where(do_hbr, s["exec_bar"][..., None], 0)
 
-        # ACCEPT_REPLY: follower acks its durable frontier to its leader
+        # ACCEPT_REPLY: follower acks its durable frontier to its leader.
+        # The flags bits are per-link in BOTH tally modes (delivery
+        # masking / visibility semantics never change); only the record
+        # lanes differ — pairwise R² fan-out vs one per-source [G, R]
+        # tally lane (core/quorum.py)
         is_follower = (
             (s["leader"] >= 0)
             & (s["leader"] != c.rid)
@@ -941,12 +995,22 @@ class MultiPaxosKernel(ProtocolKernel):
         )
         do_ar = is_follower[..., None] & dst_onehot(s["leader"], R) & ns_mask
         oflags = oflags | jnp.where(do_ar, jnp.uint32(ACCEPT_REPLY), 0)
-        out["ar_bal"] = jnp.where(do_ar, s["vote_bal"][..., None], 0)
-        out["ar_from"] = jnp.where(do_ar, s["vote_from"][..., None], 0)
-        out["ar_f"] = jnp.where(do_ar, s["dur_bar"][..., None], 0)
         do_nack = do_ar & c.nack[..., None]
         oflags = oflags | jnp.where(do_nack, jnp.uint32(AR_NACK), 0)
-        out["ar_hint"] = jnp.where(do_nack, c.nack_hint[..., None], 0)
+        if self.collective_tally:
+            out["ar_bal"] = quorum_lib.source_lane(is_follower, s["vote_bal"])
+            out["ar_from"] = quorum_lib.source_lane(
+                is_follower, s["vote_from"]
+            )
+            out["ar_f"] = quorum_lib.source_lane(is_follower, s["dur_bar"])
+            out["ar_hint"] = quorum_lib.source_lane(
+                is_follower & c.nack, c.nack_hint
+            )
+        else:
+            out["ar_bal"] = jnp.where(do_ar, s["vote_bal"][..., None], 0)
+            out["ar_from"] = jnp.where(do_ar, s["vote_from"][..., None], 0)
+            out["ar_f"] = jnp.where(do_ar, s["dur_bar"][..., None], 0)
+            out["ar_hint"] = jnp.where(do_nack, c.nack_hint[..., None], 0)
 
         # PREPARE: candidates campaign every tick (loss-tolerant)
         do_prp = c.candidate[..., None] & ns_mask

@@ -49,6 +49,7 @@ from typing import Any, Tuple
 
 import jax.numpy as jnp
 
+from ..core import quorum as quorum_lib
 from ..core.protocol import ProtocolKernel, StepEffects
 from ..ops import prng
 from ..utils.bitmap import popcount
@@ -93,6 +94,12 @@ class ReplicaConfigRaft:
     dur_lag: int = 0                    # WAL ack lag (0 = instant durability)
     exec_follows_commit: bool = True    # device-only mode: exec == commit
     init_leader: int = 0                # warm-start leader id; -1 = cold elect
+    # quorum-tally transport (core/quorum.py): "collective" carries the
+    # AppendEntries-reply match-index records as per-source [G, R]
+    # broadcast lanes instead of R² pair lanes — byte-identical state
+    # (per-link flags keep the visibility semantics), one all-gather
+    # instead of an all-to-all on a replica-sharded mesh
+    tally: str = "pairwise"
 
 
 def _gather_slot(win_abs, win_field, slot):
@@ -113,6 +120,12 @@ def _gather_slot(win_abs, win_field, slot):
 @register_protocol("Raft")
 class RaftKernel(ProtocolKernel):
     broadcast_lanes = frozenset({"bw_abs", "bw_term", "bw_val"})
+
+    # quorum-tally lanes (core/quorum.py): the AE-reply record (term,
+    # certified durable match frontier, nack hint, exec bar) is
+    # destination-independent — Raft's match-index advance tallies the
+    # same per-source lane at every receiver under tally="collective"
+    TALLY_LANES: Tuple[str, ...] = ("ar_term", "ar_f", "ar_hint", "ar_ebar")
 
     # voluntary leader demotion (gray-failure mitigation): same contract
     # as the MultiPaxos family — a [G, R] bool mask from the host; the
@@ -162,6 +175,11 @@ class RaftKernel(ProtocolKernel):
     ):
         super().__init__(num_groups, population, window)
         self.config = config or ReplicaConfigRaft()
+        quorum_lib.check_tally(getattr(self.config, "tally", "pairwise"))
+        if self.collective_tally:
+            self.broadcast_lanes = (
+                frozenset(type(self).broadcast_lanes) | self.tally_lanes
+            )
         if self.config.max_proposals_per_tick > window // 2:
             raise ValueError("max_proposals_per_tick must be <= window/2")
         self._chunk = min(self.config.chunk_size, window)
@@ -228,12 +246,17 @@ class RaftKernel(ProtocolKernel):
         G, R, W = self.G, self.R, self.W
         i32 = jnp.int32
         pair = lambda: jnp.zeros((G, R, R), i32)  # noqa: E731
+        # tally lanes: per-source [G, R] records in collective mode
+        tlane = (
+            (lambda: jnp.zeros((G, R), i32))
+            if self.collective_tally else pair
+        )
         out = {
             "flags": jnp.zeros((G, R, R), jnp.uint32),
             "ae_term": pair(), "ae_lo": pair(), "ae_hi": pair(),
             "ae_prev": pair(), "ae_cbar": pair(),
-            "ar_term": pair(), "ar_f": pair(), "ar_hint": pair(),
-            "ar_ebar": pair(),
+            "ar_term": tlane(), "ar_f": tlane(), "ar_hint": tlane(),
+            "ar_ebar": tlane(),
             "rv_term": pair(), "rv_lidx": pair(), "rv_lterm": pair(),
             "vr_term": pair(),
             "snp_term": pair(), "snp_to": pair(), "snp_lterm": pair(),
@@ -259,6 +282,7 @@ class RaftKernel(ProtocolKernel):
         ("election", "_election"),
         ("try_win", "_try_win"),
         ("leader_append", "_leader_append"),
+        (quorum_lib.PHASE_TALLY, "_phase_quorum_tally"),
         ("advance_bars", "_advance_bars"),
         ("telemetry", "_phase_telemetry"),
         ("build_outbox", "_phase_build_outbox"),
@@ -470,20 +494,26 @@ class RaftKernel(ProtocolKernel):
     def _ingest_ae_reply(self, s, c):
         cfg = self.config
         inbox = c.inbox
+        # receiver-oriented tally views (core/quorum.py): pairwise lanes
+        # as delivered, or collective [G, R_src] records broadcast over
+        # the dst axis — value-identical wherever the flags bit is set
+        ar = quorum_lib.pair_views(
+            c.inbox, self.TALLY_LANES, self.collective_tally
+        )
         ar_valid = (c.flags & AE_REPLY) != 0
         ar_mine = (
             ar_valid
-            & (inbox["ar_term"] == s["term"][..., None])
+            & (ar["ar_term"] == s["term"][..., None])
             & s["is_leader"][..., None]
         )
-        prog = ar_mine & (inbox["ar_f"] > s["match_f"])
+        prog = ar_mine & (ar["ar_f"] > s["match_f"])
         s["match_f"] = jnp.where(
-            ar_mine, jnp.maximum(s["match_f"], inbox["ar_f"]), s["match_f"]
+            ar_mine, jnp.maximum(s["match_f"], ar["ar_f"]), s["match_f"]
         )
         ar_nacked = ar_mine & ((c.flags & AR_NACK) != 0)
         s["next_idx"] = jnp.where(
             ar_nacked,
-            jnp.minimum(s["next_idx"], inbox["ar_hint"]),
+            jnp.minimum(s["next_idx"], ar["ar_hint"]),
             s["next_idx"],
         )
         s["retry_cnt"] = jnp.where(
@@ -491,7 +521,7 @@ class RaftKernel(ProtocolKernel):
         )
         s["peer_exec"] = jnp.where(
             ar_valid,
-            jnp.maximum(s["peer_exec"], inbox["ar_ebar"]),
+            jnp.maximum(s["peer_exec"], ar["ar_ebar"]),
             s["peer_exec"],
         )
         c.ar_valid, c.ar_mine = ar_valid, ar_mine
@@ -499,7 +529,7 @@ class RaftKernel(ProtocolKernel):
         # higher terms piggybacked on replies force step-down
         reply_tmax = jnp.maximum(
             jnp.max(jnp.where(c.vr_valid, inbox["vr_term"], 0), axis=2),
-            jnp.max(jnp.where(ar_valid, inbox["ar_term"], 0), axis=2),
+            jnp.max(jnp.where(ar_valid, ar["ar_term"], 0), axis=2),
         )
         stepdown = reply_tmax > s["term"]
         s["term"] = jnp.where(stepdown, reply_tmax, s["term"])
@@ -626,17 +656,25 @@ class RaftKernel(ProtocolKernel):
             s, c.inputs, self.config.exec_follows_commit
         )
 
-    # ========== 8. durability + leader commit tally + exec
-    def _advance_bars(self, s, c):
+    # ========== 8. quorum tally: durability + match-index reduction
+    def _phase_quorum_tally(self, s, c):
+        """The tally phase (core/quorum.py): Raft's match-index advance
+        as one segmented replica-axis reduction over durably-acked
+        match frontiers — scoped ``quorum_tally`` so graftprof
+        attributes the tally cost in both transport modes."""
         R = self.R
         s["dur_bar"] = advance_durability(
             s, self.config.dur_lag, frontier="log_end"
         )
         eye = jnp.eye(R, dtype=jnp.bool_)[None]
         c.eye = eye
-        peer_f = jnp.where(eye, s["dur_bar"][..., None], s["match_f"])
-        q_f = self._commit_frontier(s, c, peer_f)
+        c.peer_f = jnp.where(eye, s["dur_bar"][..., None], s["match_f"])
+        c.q_tally = self._commit_frontier(s, c, c.peer_f)
+
+    # ========== 8b. commit/exec bar advance off the tallied frontier
+    def _advance_bars(self, s, c):
         # commit-only-current-term: at least one own-term entry replicated
+        q_f = c.q_tally
         can_commit = s["is_leader"] & (q_f > s["own_from"])
         s["commit_bar"] = jnp.where(
             can_commit,
@@ -722,22 +760,36 @@ class RaftKernel(ProtocolKernel):
         out["ae_cbar"] = jnp.where(do_ae, s["commit_bar"][..., None], 0)
         s["next_idx"] = jnp.where(do_ae, snd_hi, s["next_idx"])
 
-        # AE_REPLY: follower acks its durable certified frontier
+        # AE_REPLY: follower acks its durable certified frontier.  Flags
+        # bits stay per-link in both tally modes; collective mode sends
+        # ONE per-source record instead of the R² fan-out
         is_follower = (
             (s["leader"] >= 0) & (s["leader"] != c.rid) & ~s["is_leader"]
         )
         do_ar = is_follower[..., None] & dst_onehot(s["leader"], R) & ns_mask
         oflags = oflags | jnp.where(do_ar, jnp.uint32(AE_REPLY), 0)
-        out["ar_term"] = jnp.where(do_ar, s["term"][..., None], 0)
-        out["ar_f"] = jnp.where(
-            do_ar,
-            jnp.minimum(s["match_bar"], s["dur_bar"])[..., None],
-            0,
-        )
-        out["ar_ebar"] = jnp.where(do_ar, s["exec_bar"][..., None], 0)
         do_nack = do_ar & c.nack[..., None]
         oflags = oflags | jnp.where(do_nack, jnp.uint32(AR_NACK), 0)
-        out["ar_hint"] = jnp.where(do_nack, c.nack_hint[..., None], 0)
+        if self.collective_tally:
+            out["ar_term"] = quorum_lib.source_lane(is_follower, s["term"])
+            out["ar_f"] = quorum_lib.source_lane(
+                is_follower, jnp.minimum(s["match_bar"], s["dur_bar"])
+            )
+            out["ar_ebar"] = quorum_lib.source_lane(
+                is_follower, s["exec_bar"]
+            )
+            out["ar_hint"] = quorum_lib.source_lane(
+                is_follower & c.nack, c.nack_hint
+            )
+        else:
+            out["ar_term"] = jnp.where(do_ar, s["term"][..., None], 0)
+            out["ar_f"] = jnp.where(
+                do_ar,
+                jnp.minimum(s["match_bar"], s["dur_bar"])[..., None],
+                0,
+            )
+            out["ar_ebar"] = jnp.where(do_ar, s["exec_bar"][..., None], 0)
+            out["ar_hint"] = jnp.where(do_nack, c.nack_hint[..., None], 0)
 
         # REQVOTE: candidates campaign every tick (loss-tolerant)
         do_rv = c.candidate[..., None] & ns_mask

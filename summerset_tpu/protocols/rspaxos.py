@@ -45,6 +45,7 @@ import dataclasses
 
 import jax.numpy as jnp
 
+from ..core import quorum as quorum_lib
 from ..utils.bitmap import popcount
 from . import register_protocol
 from .common import (
@@ -73,6 +74,16 @@ class ReplicaConfigRSPaxos(ReplicaConfigMultiPaxos):
 
 @register_protocol("RSPaxos")
 class RSPaxosKernel(MultiPaxosKernel):
+    # the reconstruct-request record (wanted range + requester ballot)
+    # is destination-independent like the accept-reply record: under
+    # tally="collective" the gossip plane's rq_* lanes ride per-source
+    # [G, R] broadcast lanes too (RECON_REQ flags stay per-link);
+    # rr_hi stays pairwise — a reply's cover range genuinely depends on
+    # the requester it answers
+    TALLY_LANES = MultiPaxosKernel.TALLY_LANES + (
+        "rq_bal", "rq_lo", "rq_hi",
+    )
+
     def __init__(
         self,
         num_groups: int,
@@ -120,10 +131,11 @@ class RSPaxosKernel(MultiPaxosKernel):
     def _extra_outbox(self, out):
         G, R = self.G, self.R
         i32 = jnp.int32
+        rq_shape = (G, R) if self.collective_tally else (G, R, R)
         out.update(
-            rq_bal=jnp.zeros((G, R, R), i32),
-            rq_lo=jnp.zeros((G, R, R), i32),
-            rq_hi=jnp.zeros((G, R, R), i32),
+            rq_bal=jnp.zeros(rq_shape, i32),
+            rq_lo=jnp.zeros(rq_shape, i32),
+            rq_hi=jnp.zeros(rq_shape, i32),
             rr_hi=jnp.zeros((G, R, R), i32),
         )
 
@@ -312,28 +324,38 @@ class RSPaxosKernel(MultiPaxosKernel):
         s["recon_cnt"] = jnp.where(fire, cfg.recon_interval, s["recon_cnt"])
         do_rq = fire[..., None] & ns_mask
         oflags = oflags | jnp.where(do_rq, jnp.uint32(RECON_REQ), 0)
-        out["rq_bal"] = jnp.where(do_rq, s["bal_max"][..., None], 0)
-        out["rq_lo"] = jnp.where(do_rq, s["full_bar"][..., None], 0)
-        out["rq_hi"] = jnp.where(do_rq, goal[..., None], 0)
+        if self.collective_tally:
+            # per-source tally records (core/quorum.py); RECON_REQ flags
+            # above stay per-link
+            out["rq_bal"] = quorum_lib.source_lane(fire, s["bal_max"])
+            out["rq_lo"] = quorum_lib.source_lane(fire, s["full_bar"])
+            out["rq_hi"] = quorum_lib.source_lane(fire, goal)
+        else:
+            out["rq_bal"] = jnp.where(do_rq, s["bal_max"][..., None], 0)
+            out["rq_lo"] = jnp.where(do_rq, s["full_bar"][..., None], 0)
+            out["rq_hi"] = jnp.where(do_rq, goal[..., None], 0)
 
         # serve RECON_REQ: my current run covers [rq_lo, min(rq_hi,
         # vote_bar)) iff it reaches back to rq_lo and is at a ballot >= the
         # requester's bal_max (such votes are the committed values below the
         # requester's commit bar)
+        rq = quorum_lib.pair_views(
+            inbox, ("rq_bal", "rq_lo", "rq_hi"), self.collective_tally
+        )
         rq_valid = (c.flags & RECON_REQ) != 0
         can_serve = (
             rq_valid
-            & (s["vote_bal"][..., None] >= inbox["rq_bal"])
-            & (s["vote_from"][..., None] <= inbox["rq_lo"])
+            & (s["vote_bal"][..., None] >= rq["rq_bal"])
+            & (s["vote_from"][..., None] <= rq["rq_lo"])
         )
         cover_hi = jnp.where(
             can_serve,
-            jnp.minimum(inbox["rq_hi"], s["vote_bar"][..., None]),
+            jnp.minimum(rq["rq_hi"], s["vote_bar"][..., None]),
             0,
         )
         # the inbox is receiver-oriented [G, self, src], so replying to each
         # requester writes the same [G, self, dst=src] layout the outbox uses
-        do_rr = can_serve & (cover_hi > inbox["rq_lo"]) & ns_mask
+        do_rr = can_serve & (cover_hi > rq["rq_lo"]) & ns_mask
         oflags = oflags | jnp.where(do_rr, jnp.uint32(RECON_REPLY), 0)
         out["rr_hi"] = jnp.where(do_rr, cover_hi, 0)
         return oflags

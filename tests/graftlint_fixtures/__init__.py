@@ -15,8 +15,10 @@ from __future__ import annotations
 
 from types import SimpleNamespace
 
+import jax
 import jax.numpy as jnp
 
+from summerset_tpu.core import quorum as quorum_lib
 from summerset_tpu.core.protocol import ProtocolKernel, StepEffects
 
 
@@ -242,8 +244,88 @@ class AllowedForwarderKernel(BrokenForwarderKernel):
     )
 
 
+class GoodCollectiveKernel(GoodKernel):
+    """Control for the collective-tally rules: a per-source [G, R]
+    tally lane reduced with an explicit mesh collective (``lax.psum``
+    over the verifier-bound tally axis) INSIDE the quorum_tally phase
+    scope, with the lane flags-gated per source — clean under both C6
+    (collectives allowed in tally scope) and T1 (gate present)."""
+
+    name = "FixtureGoodCollective"
+    broadcast_lanes = frozenset({"tlane"})
+    TALLY_LANES = ("tlane",)
+
+    def zero_outbox(self):
+        out = super().zero_outbox()
+        out["tlane"] = jnp.zeros((self.G, self.R), jnp.int32)
+        return out
+
+    def _tally(self, s, inbox, gated: bool):
+        contrib = inbox["tlane"]
+        if gated:
+            # a source's record counts only where some link from it was
+            # alive this tick (flags zeroed per-link by the netmodel)
+            valid_src = jnp.any((inbox["flags"] & jnp.uint32(1)) != 0,
+                                axis=1)
+            contrib = jnp.where(valid_src, contrib, 0)
+        agg = jax.lax.psum(contrib, quorum_lib.TALLY_AXIS)
+        s["commit_bar"] = jnp.maximum(
+            s["commit_bar"], agg.sum(axis=1)[:, None]
+        )
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        with quorum_lib.tally_scope():
+            self._tally(s, inbox, gated=True)
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
+class UngatedCollectiveTallyKernel(GoodCollectiveKernel):
+    """T1: the collective tally consumes the raw [G, R] tally lane with
+    no flags-derived gate — dead-link garbage rides the psum into
+    commit_bar.  The dead-world class propagates THROUGH the segmented
+    reduction (psum of dead-zeros is zero, so no accidental clearing),
+    and the lane's sources survive to the state sink."""
+
+    name = "FixtureUngatedCollective"
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        with quorum_lib.tally_scope():
+            self._tally(s, inbox, gated=False)  # the violation
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
+class CollectiveOutsideScopeKernel(GoodCollectiveKernel):
+    """C6: the same (gated) collective tally OUTSIDE the quorum_tally
+    phase scope — cross-replica aggregation anywhere else in a step is
+    a sharding leak, sanctioned only inside the in-mesh tally plane."""
+
+    name = "FixtureCollectiveOutsideScope"
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        self._fold(s, inbox)
+        self._tally(s, inbox, gated=True)  # the violation: no scope
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
 FIXTURES = {
     "fixturegood": GoodKernel,
+    "fixturegoodcollective": GoodCollectiveKernel,
+    "fixtureungatedcollective": UngatedCollectiveTallyKernel,
+    "fixturecollectiveoutsidescope": CollectiveOutsideScopeKernel,
     "fixturebrokenforwarder": BrokenForwarderKernel,
     "fixtureallowedforwarder": AllowedForwarderKernel,
     "fixtureinvertedgate": InvertedGateKernel,

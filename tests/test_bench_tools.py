@@ -203,12 +203,16 @@ class TestBenchBackendFallback:
         assert gp["analytic"]["hlo_instructions"] > 0
         assert gp["analytic"]["hlo_ops_by_phase"]["ingest_accept"] > 0
 
-    def test_mesh_survives_fallback_and_stamps_donation(self):
+    @pytest.mark.parametrize("tally", ["pairwise", "collective"])
+    def test_mesh_survives_fallback_and_stamps_donation(self, tally):
         """`bench.py --mesh GxR` through the dead-backend fallback: the
         re-exec'd CPU child rebuilds the SAME mesh shape as a virtual
         CPU mesh (spec carried via BENCH_MESH), and the artifact stamps
         the mesh block with a fully-donated carry — a mesh capture that
-        lost donation would fail its own ok verdict."""
+        lost donation would fail its own ok verdict.  Parametrized over
+        both quorum-tally modes: the default pairwise fallback path
+        stays covered, and the collective mode must survive the re-exec
+        (env BENCH_TALLY) and stamp next to the mesh block."""
         import json
         import os
         import subprocess
@@ -222,10 +226,12 @@ class TestBenchBackendFallback:
         env["BENCH_TICKS"] = "32"
         env["BENCH_RUNS"] = "1"
         env["BENCH_PROPS"] = "8"
+        args = [sys.executable, os.path.join(repo, "bench.py"),
+                "--mesh", "2x1"]
+        if tally != "pairwise":
+            args += ["--tally", tally]
         proc = subprocess.run(
-            [sys.executable, os.path.join(repo, "bench.py"),
-             "--mesh", "2x1"],
-            env=env, capture_output=True, text=True, timeout=300,
+            args, env=env, capture_output=True, text=True, timeout=300,
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
         doc = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -238,3 +244,4 @@ class TestBenchBackendFallback:
         don = mesh["donation"]
         assert don["aliased_buffers"] == don["carry_leaves"] > 0
         assert "mesh 2x1" in doc["metric"]
+        assert doc["tally"] == tally

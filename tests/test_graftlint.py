@@ -66,6 +66,17 @@ def test_good_fixture_is_clean():
         ("fixtureundeclaredbroadcast", verify_kernel, ["43ec345af97e"]),
         ("fixturebogusdurable", verify_kernel, ["0438a08b7ffd"]),
         ("fixtureundeclaredinput", verify_kernel, ["fb44c6558984"]),
+        # the ungated collective tally: the [G, R] tally lane rides the
+        # psum into state/effects with no flags gate — four sinks, and
+        # the dead-world class propagating THROUGH the segmented
+        # reduction is what keeps the taint alive to all of them
+        ("fixtureungatedcollective", verify_kernel_taint,
+         ["26d8ef536b84", "327be3169de1", "a72c76cdfd2d",
+          "cbf23d22f878"]),
+        # a collective outside the quorum_tally scope: C6's one
+        # sanctioned cross-replica aggregation point is the tally plane
+        ("fixturecollectiveoutsidescope", verify_kernel,
+         ["8079fc1552c4"]),
     ],
 )
 def test_broken_fixture_fingerprint(name, passfn, expected):
@@ -81,9 +92,21 @@ def test_broken_fixtures_fail_only_their_rule():
     assert verify_kernel(make_fixture, "fixtureinvertedgate").ok
     assert verify_kernel(make_fixture, "fixtureunflaggedeffects").ok
     assert verify_kernel(make_fixture, "fixturebrokenforwarder").ok
+    assert verify_kernel(make_fixture, "fixtureungatedcollective").ok
+    assert verify_kernel_taint(
+        make_fixture, "fixturecollectiveoutsidescope"
+    ).ok
     assert verify_kernel_taint(make_fixture, "fixturefloatstate").ok
     assert verify_kernel_taint(make_fixture, "fixturebogusdurable").ok
     assert verify_kernel_taint(make_fixture, "fixtureundeclaredinput").ok
+
+
+def test_collective_in_tally_scope_is_clean():
+    """The control: a flags-gated psum INSIDE the quorum_tally phase
+    scope passes both passes — collectives are allowed-in-tally-scope,
+    not forbidden outright."""
+    assert verify_kernel(make_fixture, "fixturegoodcollective").ok
+    assert verify_kernel_taint(make_fixture, "fixturegoodcollective").ok
 
 
 def test_allowed_forwarder_suppresses_outbox_sink():

@@ -41,6 +41,24 @@ def test_exhaustive_depth6(protocol):
     assert r.max_committed_slots > 0
 
 
+def test_exhaustive_collective_tally_quick():
+    """The collective quorum-tally transport (core/quorum.py) under
+    exhaustion, quick tier: MultiPaxos depth 3 and Crossword depth 2
+    with ``tally="collective"`` — the per-source [G, R] tally lanes
+    must uphold agreement + decision durability under every fault
+    schedule exactly like the pairwise lanes (the committed
+    MODELCHECK.json carries the depth-5 rows)."""
+    r = explore("multipaxos", depth=3, tally="collective")
+    assert not r.violations, r.violations
+    assert r.tally == "collective"
+    assert r.max_committed_slots > 0
+    r = explore("crossword", depth=2, tally="collective",
+                config_overrides={"fault_tolerance": 0,
+                                  "assignment_adaptive": False})
+    assert not r.violations, r.violations
+    assert r.max_committed_slots > 0
+
+
 def test_exhaustive_crossword_depth2():
     """Crossword under exhaustion, quick tier: the coded kernel with
     diagonal shard slicing (spr pinned — assignment_adaptive off — so

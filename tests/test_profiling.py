@@ -407,6 +407,153 @@ class TestMeshSweepGate:
         assert len(errors) == 1 and "fewer devices" in errors[0]
 
 
+# -------------------------------------------------- tally-sweep gate ----
+def _tally_doc(points):
+    return {"tally_sweep": {
+        "shape": {"G": 8, "R": 4, "W": 8, "ticks": 4},
+        "points": points,
+        "skipped": [],
+    }}
+
+
+def _tally_point(proto="multipaxos", mesh="1x1", tally="pairwise",
+                 slots=100, ok=True):
+    gs, rs = (int(x) for x in mesh.split("x"))
+    coll = tally == "collective"
+    lane_shape = [1, 8, 4] if coll else [1, 8, 4, 4]
+    return {
+        "protocol": proto, "tally": tally, "mesh": mesh,
+        "group_shards": gs, "replica_shards": rs, "devices": gs * rs,
+        "groups_per_device": 8 // gs,
+        "analytic": {
+            "flops": 50.0 if coll else 100.0,
+            "bytes_accessed": 500.0 if coll else 1000.0,
+            "hlo_instructions": 40 if coll else 50,
+            "tally_phase_ops": 10 if coll else 30,
+        },
+        "hlo_ops_by_phase": {"quorum_tally": 10 if coll else 30},
+        "memory": {"argument_bytes": 64},
+        "tally_lane_shapes": {"ar_f": lane_shape},
+        "committed_slots": slots, "ok": ok,
+    }
+
+
+class TestTallySweepGate:
+    def _run(self, doc, cur_points=None, monkeypatch=None):
+        import perf_gate
+
+        if cur_points is not None:
+            monkeypatch.setattr(
+                perf_gate.profiling, "tally_sweep",
+                lambda *a, **k: {"points": cur_points, "skipped": []},
+            )
+        errors = []
+        perf_gate.check_tally_sweep(doc, errors)
+        return errors
+
+    def _pair(self):
+        return [_tally_point(tally="pairwise"),
+                _tally_point(tally="collective")]
+
+    def test_match_passes(self, monkeypatch):
+        pts = self._pair()
+        errors = self._run(
+            _tally_doc(pts), json.loads(json.dumps(pts)), monkeypatch
+        )
+        assert errors == []
+
+    def test_missing_sweep_fails(self):
+        errors = []
+        import perf_gate
+
+        perf_gate.check_tally_sweep({}, errors)
+        assert len(errors) == 1 and "ungated" in errors[0]
+
+    def test_missing_mode_fails(self):
+        errors = self._run(_tally_doc([_tally_point()]))
+        assert any("missing a tally mode" in e for e in errors)
+
+    def test_unreduced_collective_fails(self):
+        pts = self._pair()
+        # the collective cell stops paying for itself on every metric
+        pts[1]["analytic"] = dict(pts[0]["analytic"])
+        errors = self._run(_tally_doc(pts))
+        assert sum("not strictly below" in e for e in errors) == 3
+
+    def test_progress_divergence_fails(self):
+        pts = self._pair()
+        pts[1]["committed_slots"] = 99
+        errors = self._run(_tally_doc(pts))
+        assert any("semantically identical" in e for e in errors)
+
+    def test_pairwise_shaped_collective_lane_fails(self):
+        pts = self._pair()
+        pts[1]["tally_lane_shapes"]["ar_f"] = [1, 8, 4, 4]
+        errors = self._run(_tally_doc(pts))
+        assert any("still pairwise-shaped" in e for e in errors)
+
+    def test_dead_committed_point_fails(self):
+        pts = self._pair()
+        pts[1]["committed_slots"] = 0
+        pts[1]["ok"] = False
+        errors = self._run(_tally_doc(pts))
+        assert any("no progress" in e for e in errors)
+
+    def test_rederive_drift_fails(self, monkeypatch):
+        pts = self._pair()
+        cur = json.loads(json.dumps(pts))
+        cur[1]["analytic"]["tally_phase_ops"] = 11
+        errors = self._run(_tally_doc(pts), cur, monkeypatch)
+        assert any("drift in 'analytic'" in e for e in errors)
+
+
+def test_tally_cell_live_small():
+    """One real collective tally_cell vs its pairwise twin on the
+    virtual CPU mesh: strictly fewer tally-phase ops and flops, the
+    same committed slots, [D, G, R] lanes."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual CPU mesh")
+    pw = profiling.tally_cell("multipaxos", "pairwise", "2x2",
+                              G=8, R=4, W=8, ticks=8)
+    co = profiling.tally_cell("multipaxos", "collective", "2x2",
+                              G=8, R=4, W=8, ticks=8)
+    assert co["analytic"]["tally_phase_ops"] < \
+        pw["analytic"]["tally_phase_ops"]
+    assert co["analytic"]["flops"] < pw["analytic"]["flops"]
+    assert co["committed_slots"] == pw["committed_slots"] > 0
+    assert all(len(s) == 3 for s in co["tally_lane_shapes"].values())
+    assert all(len(s) == 4 for s in pw["tally_lane_shapes"].values())
+
+
+def test_committed_tally_sweep_shape():
+    """The committed PROFILE.json carries the quorum-tally before/after
+    for MultiPaxos AND Crossword, with every collective cell strictly
+    below its pairwise twin (the acceptance criterion, audited off the
+    committed artifact)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PROFILE.json",
+    )
+    with open(path) as f:
+        doc = json.load(f)
+    ts = doc["tally_sweep"]
+    protos = {p["protocol"] for p in ts["points"]}
+    assert protos >= {"multipaxos", "crossword"}
+    by_key = {}
+    for p in ts["points"]:
+        assert p["ok"] and p["committed_slots"] > 0
+        by_key.setdefault((p["protocol"], p["mesh"]), {})[p["tally"]] = p
+    assert any(m != "1x1" for _, m in by_key), "no multi-device point"
+    for key, modes in by_key.items():
+        pw, co = modes["pairwise"], modes["collective"]
+        assert co["analytic"]["tally_phase_ops"] < \
+            pw["analytic"]["tally_phase_ops"], key
+        assert co["analytic"]["flops"] < pw["analytic"]["flops"], key
+        assert co["committed_slots"] == pw["committed_slots"], key
+
+
 def test_mesh_cell_live_small():
     """One real mesh_cell on the virtual CPU mesh: donated carry,
     deterministic analytic block, consensus progress."""

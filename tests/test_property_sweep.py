@@ -64,6 +64,14 @@ CONFIGS = {
     "quorumleases": {},
     "bodega": {},
     "epaxos": {"num_key_buckets": EPAXOS_K},
+    # the collective quorum-tally transport (core/quorum.py) under the
+    # same randomized drops/partitions/jitter: per-source [G, R] tally
+    # lanes must uphold the exact safety envelope the pairwise lanes do
+    # (the equivalence gate proves byte-identity; these rows prove the
+    # invariants independently, on the kernels the tally plane targets)
+    "multipaxos_coll": {"tally": "collective"},
+    "raft_coll": {"tally": "collective"},
+    "crossword_coll": {"fault_tolerance": 0, "tally": "collective"},
 }
 
 

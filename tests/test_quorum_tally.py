@@ -1,0 +1,272 @@
+"""Collective quorum-tally plane: the pairwise-vs-collective
+equivalence gate + lane-geometry proofs (tier-1).
+
+The in-mesh tally (core/quorum.py) replaces the R² pairwise accept-reply
+lanes with per-source [G, R] broadcast records while the flags
+pair-field keeps per-link masking — so the two transports must be
+indistinguishable at the state level under EVERYTHING the netmodel can
+do: jittered multi-tick delays, iid drops, pause masks, and a mid-window
+durable device reset, on the unsharded engine AND on 1x1/4x1/2x2 CPU
+meshes with the scan carry donated (2x2 splits the REPLICA axis, so the
+collective lanes' delivery is a genuine cross-device gather).
+
+Three gates:
+
+1. **Window-digest equivalence** — pairwise (unsharded) vs collective
+   (unsharded, 1x1, 4x1, 2x2): byte-identical sha256 over every state
+   leaf (including telemetry lanes) + the collected per-tick effects,
+   per window, for MultiPaxos AND Crossword (whose shard-coverage
+   quorums are the largest win and whose recon rq_* lanes ride the
+   collective path too).
+2. **Per-tick equivalence** — Raft and RSPaxos compared leaf-for-leaf
+   (fast single-tick compile).
+3. **Lane geometry** — the R² ``ar_*`` pair lanes are ABSENT from the
+   collective delay line: the same names ride as [D, G, R] per-source
+   buffers; pairwise keeps [D, G, R, R].  Tally lanes stay out of the
+   packed transport stacks (they are the attributed quorum_tally
+   surface), and the packing plan still packs the bw_* window lanes.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from summerset_tpu.core import Engine, NetConfig
+from summerset_tpu.core import quorum as quorum_lib
+from summerset_tpu.core import sharding as shardlib
+from summerset_tpu.protocols import make_protocol
+
+G, R, W, P = 32, 4, 16, 4
+TICKS = 8       # per window
+WINDOWS = 3
+
+NET = NetConfig(delay_ticks=1, jitter_ticks=1, drop_rate=0.05,
+                max_delay_ticks=3)
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual CPU devices (conftest grants 8)")
+
+
+def _kernel(name, tally):
+    base = make_protocol(name, G, R, 64)
+    cfg = dataclasses.replace(
+        base.config, max_proposals_per_tick=P, tally=tally
+    )
+    if hasattr(cfg, "fault_tolerance"):
+        cfg = dataclasses.replace(cfg, fault_tolerance=0)
+    return make_protocol(name, G, R, W, cfg)
+
+
+def _window_seq(w):
+    """Stacked per-tick inputs: proposals every tick, a paused replica
+    mid-window, and a durable device reset in window 1."""
+    t = jnp.arange(TICKS, dtype=jnp.int32)
+    alive = np.ones((TICKS, G, R), bool)
+    alive[3, :, 1] = False
+    reset = np.zeros((TICKS, G, R), bool)
+    if w == 1:
+        reset[5, :, 1] = True
+    return {
+        "n_proposals": jnp.full((TICKS, G), P, jnp.int32),
+        "value_base": jnp.broadcast_to(
+            ((w * TICKS + t) * P)[:, None], (TICKS, G)
+        ),
+        "alive": jnp.asarray(alive),
+        "reset": jnp.asarray(reset),
+    }
+
+
+def _window_digests(eng):
+    """Per-window sha256 over EVERY state leaf (telemetry included) +
+    the collected per-tick effects."""
+    state, ns = eng.init()
+    out = []
+    for w in range(WINDOWS):
+        state, ns, fx = eng.run_ticks(state, ns, _window_seq(w),
+                                      collect=True)
+        h = hashlib.sha256()
+        for k in sorted(state):
+            h.update(np.asarray(state[k]).tobytes())
+        h.update(np.asarray(fx.commit_bar).tobytes())
+        h.update(np.asarray(fx.exec_bar).tobytes())
+        for k in sorted(fx.extra):
+            h.update(np.asarray(fx.extra[k]).tobytes())
+        out.append(h.hexdigest())
+    return out, state
+
+
+# ------------------------------------------ window-digest equivalence --
+class TestCollectiveEquivalence:
+    """Pairwise vs collective tally: byte-identical state / effects /
+    telemetry digests over a multi-window donated mesh run."""
+
+    @pytest.fixture(scope="class", params=["multipaxos", "crossword"])
+    def proto(self, request):
+        return request.param
+
+    @pytest.fixture(scope="class")
+    def baseline(self, proto):
+        digs, state = _window_digests(
+            Engine(_kernel(proto, "pairwise"), netcfg=NET, seed=7)
+        )
+        assert int(np.asarray(state["commit_bar"]).max()) > 0, (
+            "nothing committed during the equivalence run"
+        )
+        return digs
+
+    def test_collective_unsharded_byte_identical(self, proto, baseline):
+        got, _ = _window_digests(
+            Engine(_kernel(proto, "collective"), netcfg=NET, seed=7)
+        )
+        assert got == baseline, (
+            f"{proto}: collective tally diverges from pairwise "
+            f"({got} vs {baseline})"
+        )
+
+    @pytest.mark.parametrize("spec", ["1x1", "4x1", "2x2"])
+    def test_collective_sharded_byte_identical(self, proto, baseline,
+                                               spec):
+        gs, rs = shardlib.parse_mesh(spec)
+        _need_devices(gs * rs)
+        eng = Engine(
+            _kernel(proto, "collective"), netcfg=NET, seed=7,
+            mesh=shardlib.mesh_for(gs, rs),
+        )
+        assert eng.donate, "sharded engines donate the scan carry"
+        got, _ = _window_digests(eng)
+        assert got == baseline, (
+            f"{proto} @ {spec}: collective tally diverges from the "
+            f"unsharded pairwise run ({got} vs {baseline})"
+        )
+
+
+# ------------------------------------------------ per-tick equivalence --
+@pytest.mark.parametrize(
+    "proto", ["raft", "rspaxos", "quorumleases", "craft"]
+)
+def test_per_tick_state_equivalence(proto):
+    """The rest of the variant family leaf-for-leaf after a faulted
+    multi-window run: Raft's match-index advance, RSPaxos' recon
+    plane, the QuorumLeases lease plane (whose grant bookkeeping reads
+    ``ar_mine``), and CRaft's per-slot-threshold commit walk."""
+    outs = []
+    for tally in ("pairwise", "collective"):
+        eng = Engine(_kernel(proto, tally), netcfg=NET, seed=11)
+        state, ns = eng.init()
+        for w in range(2):
+            state, ns, _ = eng.run_ticks(state, ns, _window_seq(w))
+        outs.append({k: np.asarray(v) for k, v in state.items()})
+    pair, coll = outs
+    assert sorted(pair) == sorted(coll)
+    for k in pair:
+        np.testing.assert_array_equal(
+            pair[k], coll[k],
+            err_msg=f"{proto}: state[{k!r}] diverges collective vs "
+                    "pairwise",
+        )
+    assert int(pair["commit_bar"].max()) > 0
+
+
+# ----------------------------------------------------- lane geometry --
+def test_pairwise_lanes_absent_from_collective_delay_line():
+    """The acceptance-criterion shape proof: in collective mode the
+    ar_* (and rspaxos-family rq_*) lanes ride the delay line as
+    [D, G, R] per-source buffers — the R² pair-shaped enqueue is gone —
+    while pairwise keeps [D, G, R, R]."""
+    D = NET.max_delay_ticks
+    for proto in ("multipaxos", "crossword"):
+        for tally, tail in (("pairwise", (G, R, R)),
+                            ("collective", (G, R))):
+            k = _kernel(proto, tally)
+            eng = Engine(k, netcfg=NET, seed=7)
+            _, ns = eng.init()
+            for lane in k.TALLY_LANES:
+                assert ns["bufs"][lane].shape == (D,) + tail, (
+                    f"{proto}[{tally}] lane {lane}: "
+                    f"{ns['bufs'][lane].shape}"
+                )
+
+
+def test_collective_tally_lanes_are_broadcast_lanes():
+    """Collective tally lanes join broadcast_lanes (delivered as-is —
+    the all-gather path on a sharded mesh); pairwise mode leaves the
+    declared broadcast set untouched."""
+    kp = _kernel("multipaxos", "pairwise")
+    kc = _kernel("multipaxos", "collective")
+    assert kc.tally_lanes <= kc.broadcast_lanes
+    assert not (kp.tally_lanes & kp.broadcast_lanes)
+    assert kp.tally_lanes == kc.tally_lanes
+
+
+def test_tally_lanes_stay_out_of_packed_stacks():
+    """pack_lanes (the D==1 stacked transport) must keep the tally
+    lanes loose in BOTH modes — they are the scoped quorum_tally
+    attribution surface — while still packing the bw_* window lanes."""
+    for tally in ("pairwise", "collective"):
+        k = _kernel("multipaxos", tally)
+        eng = Engine(k, netcfg=NetConfig(pack_lanes=True), seed=3)
+        _, ns = eng.init()
+        net = eng.net
+        assert not (set(net._pack_pair) & set(k.TALLY_LANES))
+        assert not (set(net._pack_bcast) & set(k.TALLY_LANES))
+        assert set(net._pack_bcast) == {"bw_abs", "bw_bal", "bw_val"}, (
+            f"[{tally}] window lanes fell out of the packed stack: "
+            f"{net._pack_bcast}"
+        )
+        # loose tally lanes really ride the packed netstate
+        for lane in k.TALLY_LANES:
+            assert lane in ns["bufs"]
+
+
+def test_pack_lanes_defaults_on_for_depth_one():
+    """Satellite: the measured pack_lanes default — ON for the uniform
+    1-tick delay line (PERF.md round 11 A/B), OFF (and refused only
+    when EXPLICIT) for deeper jittered lines."""
+    assert NetConfig().lanes_packed is True
+    assert NetConfig(max_delay_ticks=3, delay_ticks=1,
+                     jitter_ticks=1).lanes_packed is False
+    with pytest.raises(ValueError, match="pack_lanes"):
+        NetConfig(pack_lanes=True, delay_ticks=2, max_delay_ticks=2)
+    # the None sentinel survives in the field, so deriving a jittered
+    # variant from a default config re-resolves instead of raising
+    jittered = dataclasses.replace(NetConfig(), jitter_ticks=2)
+    assert jittered.lanes_packed is False
+
+
+def test_tally_mode_validated():
+    with pytest.raises(ValueError, match="tally"):
+        _kernel("multipaxos", "telepathy")
+    assert quorum_lib.check_tally("pairwise") == "pairwise"
+
+
+# ------------------------------------------------- segmented reductions --
+def test_quorum_frontier_matches_kth_largest():
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.integers(0, 100, size=(4, 3, 5)), jnp.int32)
+    for k in (1, 3, 5):
+        got = np.asarray(quorum_lib.quorum_frontier(v, k))
+        want = np.sort(np.asarray(v), axis=-1)[..., 5 - k]
+        np.testing.assert_array_equal(got, want)
+
+
+def test_coverage_frontier_counts_per_slot():
+    """cover=[1 peer past slot 0, ...]: need=1 passes slot 0, need=2
+    fails it; out-of-range slots never fail."""
+    cover = jnp.asarray([[[1, 0, 0]]], jnp.int32)        # [1, 1, 3]
+    abs_w = jnp.asarray([[[0, 1]]], jnp.int32)           # [1, 1, 2]
+    known = jnp.ones((1, 1, 2), bool)
+    in_rng = jnp.asarray([[[True, False]]])
+    one = np.asarray(quorum_lib.coverage_frontier(
+        cover, abs_w, jnp.full((1, 1, 2), 1, jnp.int32), known, in_rng
+    ))[0, 0]
+    two = np.asarray(quorum_lib.coverage_frontier(
+        cover, abs_w, jnp.full((1, 1, 2), 2, jnp.int32), known, in_rng
+    ))[0, 0]
+    assert one == 1 << 30      # need met everywhere in range
+    assert two == 0            # slot 0 fails at need=2
