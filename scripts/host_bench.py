@@ -1,31 +1,76 @@
-"""Measured host-path throughput: a real manager + N replica event loops
-over localhost TCP sockets, G consensus groups served end-to-end, driven
-by open-loop ClientBench clients (VERDICT r3 #5: publish a real-socket
-ops/sec number; parity: summerset_client/src/clients/bench.rs:44-130).
+"""Measured host-path serving throughput: a real manager + N replica
+event loops over localhost TCP — now with an optional compartmentalized
+serving plane (``--proxies N``: stateless ingress proxies + learner read
+tiers, ``summerset_tpu/host/ingress.py``) and a selector-multiplexed
+client fleet that sustains >= 10k concurrent closed-loop clients on one
+box (``summerset_tpu/client/muxfleet.py``; thread-per-client topped out
+two orders of magnitude earlier).
 
-Writes HOSTBENCH.json at the repo root:
-  {"protocol", "groups", "clients", "tput", "lat_p50_ms", "lat_p99_ms"}
+The client fleet runs in SUBPROCESS workers (``--fleet-procs``) so the
+serving process's GIL never pays for client-side pickling — the
+committed artifact's device-tick accounting would otherwise measure the
+bench, not the serving plane.
 
-Usage: python scripts/host_bench.py [--protocol MultiPaxos] [--groups 16]
-       [--clients 4] [--secs 10] [--tick 0.002]
+Writes HOSTBENCH.json at the repo root with an ``ok`` self-verdict
+(dead backend / empty fleet / collapsed tick rate fails the artifact
+loudly — the BENCH_r05 lesson), the proxy count, the per-tier shed
+scrape (shard ``api_shed`` vs proxy ``proxy_shed``), and the device
+tick-rate ratio against a client-free baseline window.
+
+Usage:
+    python scripts/host_bench.py [--protocol MultiPaxos] [--groups 16]
+        [--clients 4] [--secs 10] [--proxies 2] [--clients 10000]
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import tempfile
-import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+
+def fleet_worker(spec_json: str) -> None:
+    """Subprocess mode: run one multiplexed fleet slice and print its
+    JSON summary.  Deliberately imports NO jax/cluster machinery — the
+    worker is a pure socket client."""
+    spec = json.loads(spec_json)
+    from summerset_tpu.client.muxfleet import run_fleet
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    plan = None
+    if spec.get("workload") and spec["workload"] != "uniform":
+        # plan_clients is the FLEET-WIDE clamp the parent stamped the
+        # digest with — a per-worker share here would generate (and
+        # run) a different plan than the artifact attests
+        plan = WorkloadPlan.generate(
+            spec["workload_seed"], spec["workload"],
+            clients=spec["plan_clients"],
+            num_keys=spec["num_keys"],
+        )
+    out = run_fleet(
+        [tuple(a) for a in spec["addrs"]],
+        spec["clients"], spec["secs"],
+        put_ratio=spec["put_ratio"], value_size=spec["value_size"],
+        num_keys=spec["num_keys"], seed=spec["seed"],
+        op_timeout=spec["op_timeout"], id_base=spec["id_base"],
+        plan=plan, think=spec.get("think", 0.0),
+    )
+    print("FLEET_RESULT " + json.dumps(out), flush=True)
+
+
+if "--fleet-worker" in sys.argv:
+    fleet_worker(sys.argv[sys.argv.index("--fleet-worker") + 1])
+    sys.exit(0)
+
 # --platform must be consumed BEFORE importing jax: the platform pin only
 # works pre-backend-init.  "cpu" (default) is hermetic for CI boxes;
 # "preset" leaves the environment's platform alone — on a TPU host that
-# is the one-command TPU-in-the-loop serving bench (the kernel ticks on
-# the chip while the client/WAL/apply planes run host-side).
+# is the one-command TPU-in-the-loop serving bench.
 _plat = "cpu"
 for _i, _a in enumerate(sys.argv[1:], 1):
     if _a == "--platform" and _i + 1 < len(sys.argv):
@@ -33,7 +78,7 @@ for _i, _a in enumerate(sys.argv[1:], 1):
     elif _a.startswith("--platform="):
         _plat = _a.split("=", 1)[1]
 
-import jax
+import jax  # noqa: E402
 
 if _plat != "preset":
     jax.config.update("jax_platforms", _plat)
@@ -42,6 +87,155 @@ if _plat != "preset":
         set_cpu_devices(8)
 
 sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_line(path: str, needle: str, timeout: float) -> bool:
+    """Positional readiness tail (quiet variant of local_cluster.py's
+    wait_for_line, which echoes the child log to stderr — too noisy
+    for a bench that launches nine processes)."""
+    deadline = time.monotonic() + timeout
+    pos = 0
+    buf = ""
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                f.seek(pos)
+                buf += f.read()
+                pos = f.tell()
+        except OSError:
+            pass
+        if needle in buf:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+class ProcCluster:
+    """A REAL multi-process cluster for the bench: one manager + N
+    server replica processes through the cli entries (the
+    local_cluster.py shape), each with its own GIL and XLA thread pool.
+    The in-process tests/test_cluster harness shares one interpreter
+    across replicas — fine for correctness, but its cross-replica GIL
+    contention leaks into the device-scan stopwatch this artifact
+    gates, so the bench measures the deployment shape instead."""
+
+    def __init__(self, protocol: str, n: int, tmpdir: str,
+                 tick: float, groups: int, window: int = 64,
+                 platform: str = "cpu"):
+        from test_cluster import free_ports  # shared bench/test helper
+        from local_cluster import make_cluster_env  # env lessons live there
+
+        ports = free_ports(2 + 2 * n)
+        self.srv_port, self.cli_port = ports[0], ports[1]
+        self.api_ports = ports[2:2 + n]
+        self.p2p_ports = ports[2 + n:]
+        self.manager_addr = ("127.0.0.1", self.cli_port)
+        self.tmpdir = tmpdir
+        self.procs = []
+        # make_cluster_env owns the sitecustomize PYTHONPATH filter (a
+        # TPU-tunnel startup hook hangs every child when the tunnel is
+        # down) and the cpu default; --platform preset/tpu must reach
+        # the replica processes too — the scan times this artifact
+        # gates are THEIRS, not the parent's
+        env = make_cluster_env()
+        if platform == "preset":
+            if "JAX_PLATFORMS" in os.environ:
+                env["JAX_PLATFORMS"] = os.environ["JAX_PLATFORMS"]
+            else:
+                env.pop("JAX_PLATFORMS", None)
+            env["PYTHONPATH"] = os.environ.get(
+                "PYTHONPATH", env.get("PYTHONPATH", "")
+            ) or env.get("PYTHONPATH", "")
+        elif platform != "cpu":
+            env["JAX_PLATFORMS"] = platform
+        man_log = os.path.join(tmpdir, "manager.log")
+        self.procs.append(subprocess.Popen(
+            [sys.executable, "-m", "summerset_tpu.cli.manager",
+             "-p", protocol, "--srv-port", str(self.srv_port),
+             "--cli-port", str(self.cli_port), "-n", str(n)],
+            stdout=open(man_log, "w"), stderr=subprocess.STDOUT,
+            env=env, cwd=REPO,
+        ))
+        if not _wait_line(man_log, "manager up", 30):
+            raise RuntimeError("manager never came up")
+        logs = []
+        for r in range(n):
+            log = os.path.join(tmpdir, f"server{r}.log")
+            logs.append(log)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "summerset_tpu.cli.server",
+                 "-p", protocol, "-a", str(self.api_ports[r]),
+                 "-i", str(self.p2p_ports[r]),
+                 "-m", f"127.0.0.1:{self.srv_port}",
+                 "-g", str(groups), "--window", str(window),
+                 "--tick-interval", str(tick),
+                 "--backer-dir", tmpdir],
+                stdout=open(log, "w"), stderr=subprocess.STDOUT,
+                env=env, cwd=REPO,
+            ))
+        for log in logs:
+            if not _wait_line(log, "accepting clients", 180):
+                self.stop()
+                raise RuntimeError(f"server never ready ({log})")
+
+    def stop(self) -> None:
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+
+def _pin_worker(fleet_cores) -> None:
+    """(child preexec) Deprioritize + pin a fleet worker to the carved
+    client cores so it can never contend with the serving pool."""
+    try:
+        os.nice(10)
+        if fleet_cores and hasattr(os, "sched_setaffinity"):
+            os.sched_setaffinity(0, fleet_cores)
+    except OSError:
+        pass
+
+
+def scrape_tick_marks(manager_addr) -> dict:
+    """Per-replica (tick counter, step-stage histogram count/sum_us)
+    marks.  Two marks bracket a window: the tick delta over wall time is
+    the LOOP rate (informational — on this in-process CPU harness the
+    loop also carries the host apply/WAL stages), while the step-stage
+    delta gives the DEVICE tick cost: mean device-scan duration per
+    tick, the thing that must stay flat under 10k clients (serving load
+    belongs to the host stages and the proxy tier, never to the scan)."""
+    from summerset_tpu.client.endpoint import scrape_metrics
+
+    snap = scrape_metrics(manager_addr, timeout=15.0)
+    out = {}
+    for sid, s in (snap or {}).items():
+        h = (s.get("host", {}).get("histograms", {})
+              .get("loop_stage_us{stage=step}") or {})
+        out[sid] = (s["tick"], h.get("count", 0), h.get("sum", 0))
+    return out
+
+
+def window_stats(a: dict, b: dict, dt: float):
+    """(mean loop ticks/s, mean device step us/tick) across replicas
+    between two scrape marks."""
+    rates, steps = [], []
+    for sid in a:
+        if sid not in b:
+            continue
+        rates.append((b[sid][0] - a[sid][0]) / max(dt, 1e-9))
+        dn = b[sid][1] - a[sid][1]
+        ds = b[sid][2] - a[sid][2]
+        if dn > 0:
+            steps.append(ds / dn)
+    rate = sum(rates) / len(rates) if rates else 0.0
+    step = sum(steps) / len(steps) if steps else 0.0
+    return rate, step
 
 
 def main() -> None:
@@ -57,96 +251,290 @@ def main() -> None:
                          "backend (run on a TPU host for the "
                          "TPU-in-the-loop serving measurement)")
     ap.add_argument("--num-keys", type=int, default=64)
-    ap.add_argument("--value-size", default="64")
+    ap.add_argument("--value-size", type=int, default=64)
     ap.add_argument("--put-ratio", type=float, default=0.5)
     ap.add_argument("--workload", default="uniform",
-                    help="workload class (host/workload.py "
-                         "WORKLOAD_CLASSES); uniform = the legacy "
-                         "bench mix, so default trajectories stay "
-                         "comparable")
+                    help="workload class (host/workload.py); uniform = "
+                         "the legacy bench mix so default trajectories "
+                         "stay comparable")
     ap.add_argument("--workload-seed", type=int, default=1)
+    ap.add_argument("--proxies", type=int, default=0,
+                    help="ingress proxies in front of the shards "
+                         "(0 = fused single-process serving, the "
+                         "default and the committed-trajectory mode)")
+    ap.add_argument("--fleet-procs", type=int, default=0,
+                    help="subprocess fleet workers (0 = auto: 1 for "
+                         "small fleets, 4 from 1000 clients up)")
+    ap.add_argument("--op-timeout", type=float, default=5.0)
+    ap.add_argument("--think", type=float, default=0.0,
+                    help="per-client think time between ops (jittered; "
+                         "10k clients at think=30 offer ~330 ops/s — "
+                         "the connection-scaling run controls offered "
+                         "rate instead of saturating)")
+    ap.add_argument("--tick-budget", type=float, default=0.9,
+                    help="min loaded/baseline device tick-rate ratio "
+                         "for the ok verdict when proxies are up")
     ap.add_argument("--out", default=os.path.join(REPO, "HOSTBENCH.json"))
     args = ap.parse_args()
 
-    from test_cluster import Cluster  # reuses the in-process harness
-    from summerset_tpu.client.bench import ClientBench
-    from summerset_tpu.client.endpoint import (
-        GenericEndpoint, scrape_metrics,
-    )
+    from summerset_tpu.client.endpoint import scrape_metrics
     from summerset_tpu.host.workload import WorkloadPlan
 
-    plan = None
+    # CPU isolation for the co-located bench (deployment runs proxies +
+    # clients on separate hosts): carve the box so the fleet/proxy
+    # processes cannot contend with the serving process's XLA thread
+    # pool — the device-scan flatness this artifact gates would
+    # otherwise measure core theft by the bench's own client tier.
+    # Must happen BEFORE the first jax backend touch (pool sizing).
+    fleet_cores = None
+    try:
+        all_cores = sorted(os.sched_getaffinity(0))
+        if args.proxies > 0 and len(all_cores) >= 8:
+            split = max(4, len(all_cores) // 4)
+            fleet_cores = set(all_cores[-split:])
+            os.sched_setaffinity(0, set(all_cores[:-split]))
+            print(f"cpu carve: serving {len(all_cores) - split} cores, "
+                  f"fleet+proxies {split}", flush=True)
+    except (AttributeError, OSError):
+        pass
+
+    plan_clients = max(4, min(64, args.clients))
+    plan_digest = None
     if args.workload != "uniform":
-        plan = WorkloadPlan.generate(
-            args.workload_seed, args.workload, clients=args.clients,
-            num_keys=args.num_keys,
-        )
+        plan_digest = WorkloadPlan.generate(
+            args.workload_seed, args.workload,
+            clients=plan_clients, num_keys=args.num_keys,
+        ).digest()
 
     tmp = tempfile.mkdtemp(prefix="host_bench_")
     t0 = time.time()
-    cluster = Cluster(
+    cluster = ProcCluster(
         args.protocol, args.replicas, tmp,
-        tick=args.tick, num_groups=args.groups,
+        tick=args.tick, groups=args.groups, platform=_plat,
     )
     print(f"cluster up in {time.time() - t0:.1f}s "
-          f"({args.replicas} replicas x {args.groups} groups)", flush=True)
+          f"({args.replicas} replica processes x {args.groups} groups)",
+          flush=True)
 
-    results = [None] * args.clients
+    plane = None
+    if args.proxies > 0:
+        from summerset_tpu.host.ingress import ServingPlane
 
-    def one_client(i: int) -> None:
-        ep = GenericEndpoint(cluster.manager_addr)
-        ep.connect()
-        bench = ClientBench(
-            ep,
-            secs=args.secs,
-            put_ratio=args.put_ratio,
-            value_size=args.value_size,
-            num_keys=args.num_keys,
-            interval=1e9,  # suppress per-interval prints
-            seed=i,
-            opgen=plan.opstream(i) if plan is not None else None,
+        # process mode: the proxies are REAL separate processes (the
+        # deployment shape) — the serving process's GIL never pays for
+        # the 10k-socket client plane
+        plane = ServingPlane(
+            cluster.manager_addr, proxies=args.proxies,
+            mode="process", cpus=fleet_cores,
+        ).start()
+        print(f"serving plane up: {args.proxies} proxy processes @ "
+              f"{plane.addrs}", flush=True)
+        targets = plane.addrs
+    else:
+        targets = [("127.0.0.1", p) for p in cluster.api_ports]
+
+    # warm the jit path first — an un-warmed baseline measures XLA
+    # compile time, not the serving tick
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import GenericEndpoint
+
+    wep = GenericEndpoint(cluster.manager_addr)
+    wep.connect()
+    DriverClosedLoop(wep, timeout=30.0).checked_put("warm", "1")
+    wep.leave()
+
+    # client-free baseline window: same scrape, same window shape as
+    # the loaded measurement below
+    m0 = scrape_tick_marks(cluster.manager_addr)
+    t_b0 = time.monotonic()
+    time.sleep(4.0)
+    m1 = scrape_tick_marks(cluster.manager_addr)
+    base_rate, base_step = window_stats(
+        m0, m1, time.monotonic() - t_b0
+    )
+    print(f"client-free: loop {base_rate:.1f} ticks/s, device scan "
+          f"{base_step:.0f} us/tick", flush=True)
+
+    procs = args.fleet_procs or (4 if args.clients >= 1000 else 1)
+    procs = max(1, min(procs, args.clients))
+    share = [args.clients // procs] * procs
+    for i in range(args.clients % procs):
+        share[i] += 1
+    workers = []
+    for w, n in enumerate(share):
+        spec = {
+            "addrs": [list(a) for a in targets],
+            "clients": n,
+            "secs": args.secs,
+            "put_ratio": args.put_ratio,
+            "value_size": args.value_size,
+            "num_keys": args.num_keys,
+            "seed": args.workload_seed * 131 + w,
+            "op_timeout": args.op_timeout,
+            "id_base": 10_000_000 + w * 1_000_000,
+            "plan_clients": plan_clients,
+            "think": args.think,
+            "workload": args.workload,
+            "workload_seed": args.workload_seed,
+        }
+        workers.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--fleet-worker", json.dumps(spec)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd=REPO,
+            # the client fleet must never steal CPU from the device
+            # scan it is measuring — same-box co-location is a bench
+            # convenience, not the deployment shape
+            preexec_fn=(lambda fc=fleet_cores: _pin_worker(fc)),
+        ))
+    # loaded tick window: marks snapped AFTER the connect storm settles
+    # (a one-time fleet ramp is not the steady serving state the 10%
+    # budget is about), closed before the fleet drains
+    settle = min(3.0, args.secs / 3)
+    time.sleep(settle)
+    marks_a = scrape_tick_marks(cluster.manager_addr)
+    t_load0 = time.monotonic()
+    time.sleep(max(0.5, args.secs - settle - 1.0))
+    marks_b = scrape_tick_marks(cluster.manager_addr)
+    t_load = time.monotonic() - t_load0
+    results = []
+    for p in workers:
+        out, _ = p.communicate(timeout=args.secs + 120)
+        for line in (out or "").splitlines():
+            if line.startswith("FLEET_RESULT "):
+                results.append(json.loads(line[len("FLEET_RESULT "):]))
+    loaded_rate, loaded_step = window_stats(marks_a, marks_b, t_load)
+    # interleaved post-baseline (the PERF round-8 A/B discipline): a
+    # single pre-baseline is exposed to slow system drift (freq
+    # scaling, cache state) over the minutes between windows; the
+    # client-free reference is the FASTER of the windows bracketing the
+    # loaded one, so drift shows up as noise, not as a phantom slowdown
+    time.sleep(1.0)
+    m2 = scrape_tick_marks(cluster.manager_addr)
+    t_p0 = time.monotonic()
+    time.sleep(4.0)
+    m3 = scrape_tick_marks(cluster.manager_addr)
+    _post_rate, post_step = window_stats(
+        m2, m3, time.monotonic() - t_p0
+    )
+    if post_step > 0:
+        # the SLOWER client-free window is the drift-honest reference:
+        # if the whole box slowed between windows, the post-baseline
+        # slowed with it and the ratio isolates the client effect; if
+        # clients alone slowed the scan, the post-baseline recovers and
+        # the ratio still catches it
+        base_step = max(base_step, post_step)
+    # the gated ratio: DEVICE scan throughput (1 / mean step-stage
+    # duration) under full client load vs client-free.  The serving
+    # plane's claim is that client fan-in rides the host tiers (proxy
+    # processes + the host intake/log/apply stages), never the device
+    # scan itself — the loop wall rate is stamped alongside for
+    # transparency but on this in-process CPU harness it also carries
+    # the host apply/WAL stages, which grow with throughput by design.
+    tick_ratio = (
+        base_step / loaded_step
+        if loaded_step > 0 and base_step > 0 else 0.0
+    )
+
+    tput = sum(r["tput"] for r in results)
+    acked = sum(r["acked"] for r in results)
+    connected = sum(r["connected_peak"] for r in results)
+    # per-worker minima sum to a lower bound of SIMULTANEOUS
+    # concurrency over the whole post-ramp window — peaks taken at
+    # different instants would overstate it
+    connected_min = sum(r.get("connected_min", 0) for r in results)
+    p50 = max((r["lat_p50_ms"] for r in results), default=0.0)
+    p99 = max((r["lat_p99_ms"] for r in results), default=0.0)
+
+    # per-tier shed attribution: shard api_shed off the post-run scrape,
+    # proxy proxy_shed off the in-process plane handles
+    server_metrics = scrape_metrics(cluster.manager_addr)
+    shard_shed = {
+        sid: snap.get("host", {}).get("counters", {}).get("api_shed", 0)
+        for sid, snap in (server_metrics or {}).items()
+    }
+    proxy_scrape = plane.scrape() if plane is not None else {}
+    proxy_shed = (
+        plane.shed_counts() if plane is not None else {}
+    )
+
+    failures = []
+    if _plat != "preset" and jax.devices()[0].platform != _plat:
+        failures.append("backend mismatch")
+    if not results or acked <= 0 or tput <= 0:
+        failures.append("no acked ops (dead serving path)")
+    if connected_min < 0.95 * args.clients:
+        failures.append(
+            f"fleet under target: only {connected_min}/{args.clients} "
+            "simultaneously established through the window"
         )
-        results[i] = bench.run()
-        ep.leave()
+    if args.proxies > 0 and tick_ratio < args.tick_budget:
+        failures.append(
+            f"device scan slowed under clients: "
+            f"{tick_ratio:.2f}x baseline throughput "
+            f"< {args.tick_budget}"
+        )
 
-    threads = [
-        threading.Thread(target=one_client, args=(i,), daemon=True)
-        for i in range(args.clients)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=args.secs + 60)
-
-    done = [r for r in results if r]
-    tput = sum(r["tput"] for r in done)
-    p50 = max(r["lat_p50_ms"] for r in done) if done else 0.0
-    p99 = max(r["lat_p99_ms"] for r in done) if done else 0.0
     out = {
         "protocol": args.protocol,
         "groups": args.groups,
         "replicas": args.replicas,
-        "clients": len(done),
+        "clients": args.clients,
+        "clients_concurrent_peak": connected,
+        "clients_concurrent_min": connected_min,
+        "fleet": "mux",             # selector-multiplexed closed loop
+        "fleet_procs": procs,
+        "proxies": args.proxies,
         "secs": args.secs,
+        "think_s": args.think,
         "platform": jax.devices()[0].platform,
-        # workload stamp: which traffic class produced this number
         "workload": args.workload,
         "workload_seed": args.workload_seed,
-        "workload_digest": plan.digest() if plan is not None else None,
+        "workload_digest": plan_digest,
         "tput": round(tput, 2),
         "lat_p50_ms": round(p50, 3),
         "lat_p99_ms": round(p99, 3),
-        # server-side breakdown: the metrics_dump scrape (device metric
-        # lanes + host histograms incl. fsync/request latency/loop
-        # stages + sampled ticks-to-commit) rides the committed artifact
-        # so the client percentiles above carry their own explanation
-        "server_metrics": scrape_metrics(cluster.manager_addr),
+        "issued": sum(r["issued"] for r in results),
+        "acked": acked,
+        "shed": sum(r["shed"] for r in results),
+        "timeouts": sum(r["timeouts"] for r in results),
+        # device-plane accounting: serving must ride on top of a live
+        # tick, not displace it — the compartmentalization claim is
+        # client fan-in WITHOUT device-plane cost
+        "tick_rate_baseline": round(base_rate, 2),
+        "tick_rate_loaded": round(loaded_rate, 2),
+        "device_step_us_baseline": round(base_step, 1),
+        "device_step_us_loaded": round(loaded_step, 1),
+        "tick_ratio": round(tick_ratio, 3),
+        "tick_budget": args.tick_budget,
+        # per-tier shed attribution (the compartmentalization receipt:
+        # with proxies up, overload lands on the proxy tier)
+        "api_shed": shard_shed,
+        "proxy_shed": proxy_shed,
+        "proxy_metrics": {
+            pid: {
+                "counters": snap["host"]["counters"],
+                "gauges": snap["host"]["gauges"],
+            }
+            for pid, snap in proxy_scrape.items()
+        },
+        "ok": not failures,
+        "failures": failures,
+        "server_metrics": server_metrics,
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({k: v for k, v in out.items()
-                      if k != "server_metrics"}), flush=True)
+    print(json.dumps({
+        k: v for k, v in out.items()
+        if k not in ("server_metrics", "proxy_metrics")
+    }), flush=True)
+    if plane is not None:
+        plane.stop()
     cluster.stop()
+    if failures:
+        print(f"HOSTBENCH NOT OK: {failures}", flush=True)
+        os._exit(1)
+    os._exit(0)
 
 
 if __name__ == "__main__":

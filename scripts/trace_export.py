@@ -54,7 +54,7 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 # plane -> tid (stable small ints; names attached via metadata events)
-PLANES = ("api", "device scan", "transport", "storage", "ctrl")
+PLANES = ("api", "device scan", "transport", "storage", "ctrl", "proxy")
 TID = {name: i for i, name in enumerate(PLANES)}
 
 _STAGE_ORDER = ("intake", "exchange", "step", "log", "apply")
@@ -322,8 +322,41 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
     paired_keys = {(p["src"], p["dst"], p["seq"]) for p in pairs}
     flow_done: set = set()  # dup faults re-receive a seq: one arrow only
 
+    # ---- proxy-hop pairing (serving-plane split, host/ingress.py):
+    # a proxy's typed proxy_fwd/proxy_rcv events join the shard's
+    # api_ingress/api_reply events where the shard-side client id IS the
+    # proxy's forward identity and req_id IS the proxy-minted rid — so
+    # the client→proxy→shard→reply chain renders as flow arrows with no
+    # wire change, exactly like the transport tx/rx pairing.
+    proxy_ids = {
+        int(d.get("me", -1)) for d in dumps.values()
+        if d.get("tier") == "proxy"
+    }
+    fwd_src: set = set()
+    fwd_dst: set = set()
+    rcv_src: set = set()
+    rcv_dst: set = set()
+    for s_, d_ in dumps.items():
+        me_ = int(d_.get("me", -1))
+        isp = d_.get("tier") == "proxy"
+        for ev in _events(d_):
+            k_ = ev.get("type")
+            if isp and k_ == "proxy_fwd":
+                fwd_src.add((ev.get("fwd_id", me_), ev.get("prid")))
+            elif isp and k_ == "proxy_rcv":
+                rcv_dst.add((me_, ev.get("prid")))
+            elif not isp and k_ == "api_ingress" \
+                    and ev.get("client") in proxy_ids:
+                fwd_dst.add((ev.get("client"), ev.get("req_id")))
+            elif not isp and k_ == "api_reply" \
+                    and ev.get("client") in proxy_ids:
+                rcv_src.add((ev.get("client"), ev.get("req_id")))
+    hop_fwd = fwd_src & fwd_dst
+    hop_rcv = rcv_src & rcv_dst
+
     for sid, dump in sorted(dumps.items(), key=lambda kv: str(kv[0])):
         me = int(dump.get("me", sid))
+        is_proxy = dump.get("tier") == "proxy"
         off = offsets.get(me, 0)
         fracs = (
             phase_fractions(phase_profile, dump.get("protocol", ""))
@@ -335,8 +368,10 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
 
         meta.append({
             "ph": "M", "name": "process_name", "pid": me, "tid": 0,
-            "args": {"name": f"replica {me}"
-                             f" ({dump.get('protocol', '?')})"},
+            "args": {"name": (
+                f"proxy {me}" if is_proxy
+                else f"replica {me} ({dump.get('protocol', '?')})"
+            )},
         })
         for plane, tid in TID.items():
             meta.append({
@@ -391,6 +426,71 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                         "args": {"client": ev["client"],
                                  "req_id": ev["req_id"]},
                     })
+                hkey = (ev["client"], ev["req_id"])
+                if not is_proxy and hkey in hop_fwd \
+                        and ("phop-f", hkey) not in flow_done:
+                    # proxy→shard hop lands here: finish the flow the
+                    # proxy's proxy_fwd event started
+                    flow_done.add(("phop-f", hkey))
+                    evs.append({
+                        "ph": "f", "bp": "e", "cat": "proxyhop",
+                        "id": f"phop-{hkey[0]}-{hkey[1]}",
+                        "name": "proxy_hop", "pid": me,
+                        "tid": TID["api"], "ts": t,
+                    })
+            elif k == "api_reply":
+                hkey = (ev.get("client"), ev.get("req_id"))
+                if not is_proxy and hkey in hop_rcv \
+                        and ("prep-s", hkey) not in flow_done:
+                    # shard→proxy reply hop starts here (the reply event
+                    # itself is consumed by the request-span pairing)
+                    flow_done.add(("prep-s", hkey))
+                    evs.append({
+                        "ph": "s", "cat": "proxyhop",
+                        "id": f"prep-{hkey[0]}-{hkey[1]}",
+                        "name": "proxy_reply", "pid": me,
+                        "tid": TID["api"], "ts": t,
+                    })
+            elif k == "proxy_fwd":
+                evs.append({
+                    "ph": "i", "s": "t", "name": "proxy_fwd",
+                    "pid": me, "tid": TID["proxy"], "ts": t,
+                    "args": {"sid": ev.get("sid"), "prid": ev.get("prid"),
+                             "n": ev.get("n")},
+                })
+                hkey = (ev.get("fwd_id", me), ev.get("prid"))
+                if hkey in hop_fwd and ("phop-s", hkey) not in flow_done:
+                    flow_done.add(("phop-s", hkey))
+                    evs.append({
+                        "ph": "s", "cat": "proxyhop",
+                        "id": f"phop-{hkey[0]}-{hkey[1]}",
+                        "name": "proxy_hop", "pid": me,
+                        "tid": TID["proxy"], "ts": t,
+                    })
+            elif k == "proxy_rcv":
+                evs.append({
+                    "ph": "i", "s": "t", "name": "proxy_rcv",
+                    "pid": me, "tid": TID["proxy"], "ts": t,
+                    "args": {"sid": ev.get("sid"), "prid": ev.get("prid"),
+                             "kind": ev.get("kind")},
+                })
+                hkey = (me, ev.get("prid"))
+                if hkey in hop_rcv and ("prep-f", hkey) not in flow_done:
+                    flow_done.add(("prep-f", hkey))
+                    evs.append({
+                        "ph": "f", "bp": "e", "cat": "proxyhop",
+                        "id": f"prep-{hkey[0]}-{hkey[1]}",
+                        "name": "proxy_reply", "pid": me,
+                        "tid": TID["proxy"], "ts": t,
+                    })
+            elif k == "read_serve":
+                evs.append({
+                    "ph": "i", "s": "t", "name": "read_serve",
+                    "pid": me, "tid": TID["api"], "ts": t,
+                    "args": {"client": ev.get("client"),
+                             "req_id": ev.get("req_id"),
+                             "seq": ev.get("seq")},
+                })
             elif k == "api_shed":
                 # ingress backpressure refused the request before it
                 # entered the queue: an instant on the api track (there

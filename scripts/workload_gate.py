@@ -33,11 +33,66 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from workload_soak import (  # noqa: E402  (scripts/ sibling import)
-    DEFAULT_BUDGET_TICKS, P99_BUDGET_S, RECOVER_FRAC, WL_MATRIX,
-    build_plans,
+    DEFAULT_BUDGET_TICKS, P99_BUDGET_S, PROXY_AB_MIN_RATIO, PROXY_CELL,
+    PROXY_COUNT, RECOVER_FRAC, WL_MATRIX, build_plans, build_proxy_plan,
 )
 
 DEFAULT_REPLICAS = 3
+
+
+def check_proxy_ab(row) -> list:
+    """Gate the fused-vs-proxy shed-point A/B row (serving-plane
+    split): same WorkloadPlan digest on both sides, shed point up by
+    >= PROXY_AB_MIN_RATIO, sheds attributed to the PROXY tier in the
+    proxy run, both runs linearizable and inside the fused budgets."""
+    from workload_soak import AB_SEED, DEFAULT_CLIENTS, DEFAULT_KEYS, \
+        DEFAULT_HORIZON
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    fails = []
+    tag = "proxy_ab"
+    if not row.get("ok"):
+        fails.append(f"{tag}: failed ({row.get('error')})")
+    wplan = WorkloadPlan.generate(
+        AB_SEED, "hot_burst", clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    if row.get("wl_digest") != wplan.digest():
+        fails.append(
+            f"{tag}: workload digest drift — committed "
+            f"{row.get('wl_digest')} vs regenerated {wplan.digest()}"
+        )
+    if row.get("proxies", 0) < 2:
+        fails.append(f"{tag}: needs >= 2 proxies "
+                     f"(ran {row.get('proxies')})")
+    ratio = row.get("shed_ratio") or 0.0
+    if ratio < PROXY_AB_MIN_RATIO:
+        fails.append(
+            f"{tag}: shed point improved only {ratio}x "
+            f"(need >= {PROXY_AB_MIN_RATIO})"
+        )
+    pshed = row.get("proxy_run_proxy_shed", 0)
+    sshed = row.get("proxy_run_shard_shed", 0)
+    if pshed <= 0 or pshed <= sshed:
+        fails.append(
+            f"{tag}: sheds not attributed to the proxy tier "
+            f"(proxy {pshed} vs shard {sshed})"
+        )
+    for mode in ("fused", "proxy"):
+        sub = row.get(mode) or {}
+        if not sub.get("linearizable"):
+            fails.append(f"{tag}: {mode} history not linearizable")
+        if (sub.get("p99_s") or 1e9) > P99_BUDGET_S:
+            fails.append(f"{tag}: {mode} accepted-op p99 "
+                         f"{sub.get('p99_s')}s over budget")
+        rec = sub.get("recover_tput")
+        st = sub.get("offered_steady")
+        if rec is None or st is None or rec < RECOVER_FRAC * st:
+            fails.append(
+                f"{tag}: {mode} post-burst throughput did not "
+                f"recover ({rec}/s tail vs {st}/s offered steady)"
+            )
+    return fails
 
 
 def main() -> int:
@@ -51,7 +106,15 @@ def main() -> int:
     failures = []
     want = {(p, c, s): fs for p, c, s, fs in WL_MATRIX}
     seen = set()
+    ab_rows = [r for r in rows if r.get("kind") == "proxy_ab"]
+    if not ab_rows:
+        failures.append("proxy_ab row missing (run "
+                        "scripts/workload_soak.py --proxy-ab)")
+    for ab in ab_rows:
+        failures.extend(check_proxy_ab(ab))
     for row in rows:
+        if row.get("kind") == "proxy_ab":
+            continue
         cell = (row.get("protocol"), row.get("wl_class"),
                 row.get("seed"))
         seen.add(cell)
@@ -80,6 +143,23 @@ def main() -> int:
                 f"{tag}: fault digest drift — committed "
                 f"{row.get('fault_digest')} vs regenerated {fdig}"
             )
+        if (cell[0], cell[1]) == PROXY_CELL:
+            # the proxied overload cell: proxies up + the canonical
+            # proxy_crash plan's digest must regenerate byte-identically
+            if row.get("proxies", 0) != PROXY_COUNT:
+                failures.append(
+                    f"{tag}: expected {PROXY_COUNT} proxies on the "
+                    f"proxied overload cell (ran {row.get('proxies')})"
+                )
+            pdig = build_proxy_plan(
+                cell[0], cell[1], cell[2], DEFAULT_REPLICAS
+            ).digest()
+            if row.get("proxy_fault_digest") != pdig:
+                failures.append(
+                    f"{tag}: proxy_crash digest drift — committed "
+                    f"{row.get('proxy_fault_digest')} vs regenerated "
+                    f"{pdig}"
+                )
         if cell[1] == "hot_burst":
             shed = row.get("shed", 0)
             # post-run scrape + the burst-peak pre-crash scrape: the

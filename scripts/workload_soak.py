@@ -93,6 +93,36 @@ FAULT_CLASSES = ("partition", "drop", "one_way")
 # crash stirs election noise into the window.
 API_MAX_BATCH = 2
 API_MAX_PENDING = 8
+
+# ---- compartmentalized serving plane (host/ingress.py) --------------
+# One overload cell runs behind ingress proxies WITH a mid-burst
+# proxy_crash (kill + restart; clients rediscover via the manager
+# re-announce), and the proxy_ab row measures the fused-vs-proxy shed
+# point on the same WorkloadPlan digest.  The proxy knobs are sized so
+# the tier's capacity gain over the fused shard is REAL but bounded —
+# bounded forward batches and upstream windows mean a sustained ramp
+# must eventually shed AT THE PROXY (front door), which is exactly the
+# attribution the A/B asserts: api_shed stays on the floor while
+# proxy_shed absorbs the overload.
+PROXY_CELL = ("MultiPaxos", "hot_burst")   # the proxied overload cell
+PROXY_COUNT = 2
+PROXY_CFG = {
+    "forward_batch": 8,     # cmds per forwarded batch (fan-in factor)
+    "upstream_window": 2,   # un-acked batches per shard
+    "max_pending": 16,      # proxy front-door queue bound
+    "backlog_limit": 8,     # internal forward backlog bound
+}
+# proxy_crash timing inside the proxied cell: derived from the wplan's
+# burst phase (deterministic per seed — the gate regenerates the digest
+# with the same formula), restart after ~1s of schedule time
+PROXY_CRASH_OFFSET = 6
+PROXY_CRASH_RESTART = 10
+# proxy_ab: the shed-point ramp sweeps offered rate from 1x to
+# RAMP_MAX_X the FUSED calibrated capacity across the burst window;
+# shed point := offered rate at the first client-observed shed
+AB_SEED = 1
+RAMP_MAX_X = 8.0
+PROXY_AB_MIN_RATIO = 1.5
 # shared with scripts/workload_gate.py (digest regeneration)
 DEFAULT_CLIENTS = 3
 DEFAULT_KEYS = 24
@@ -129,6 +159,26 @@ def build_plans(protocol: str, wl_class: str, seed: int,
             classes=FAULT_CLASSES,
         )
     return wplan, fplan
+
+
+def build_proxy_plan(protocol: str, wl_class: str, seed: int,
+                     replicas: int):
+    """The proxied overload cell's proxy_crash plan, derived
+    deterministically from the cell's own WorkloadPlan (crash lands
+    mid-burst) — regenerable by the gate without a cluster."""
+    from summerset_tpu.host.nemesis import FaultPlan
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    wplan = WorkloadPlan.generate(
+        seed, wl_class, clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    burst = wplan.phases[1]
+    return FaultPlan.proxy_crash(
+        seed, replicas, DEFAULT_HORIZON, proxies=PROXY_COUNT,
+        at=burst.tick + PROXY_CRASH_OFFSET,
+        restart_after=PROXY_CRASH_RESTART,
+    )
 
 
 def calibrate_capacity(manager_addr, clients: int, secs: float = 2.5,
@@ -256,6 +306,16 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
     if fplan is not None:
         print(fplan.timeline(), end="")
 
+    # the proxied overload cell: ingress proxies in front of the shards
+    # plus a mid-burst proxy kill/restart (clients rediscover through
+    # the manager re-announce) — the serving-plane split under the SAME
+    # schedule digests as the fused cells
+    proxied = (protocol, wl_class) == PROXY_CELL
+    pplan = (
+        build_proxy_plan(protocol, wl_class, seed, args.replicas)
+        if proxied else None
+    )
+
     tmp = tempfile.mkdtemp(
         prefix=f"wlsoak_{protocol.lower()}_{wl_class}_{seed}_"
     )
@@ -264,19 +324,32 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
         "fault_seed": fault_seed, "wl_digest": wplan.digest(),
         "fault_digest": fplan.digest() if fplan else None,
         "overload": overload, "ok": False,
+        "proxies": PROXY_COUNT if proxied else 0,
+        "proxy_fault_digest": pplan.digest() if pplan else None,
     }
     cluster = None
+    plane = None
     stop = threading.Event()
     ops: list = []
     stats: list = []
     threads: list = []
     runner = None
+    prunner = None
     nem_thread = None
     try:
         cluster = Cluster(
             protocol, args.replicas, tmp,
             config=protocol_config(protocol), tick=args.tick,
         )
+        if proxied:
+            from summerset_tpu.host.ingress import ServingPlane
+
+            plane = ServingPlane(
+                cluster.manager_addr, proxies=PROXY_COUNT,
+                proxy_config=dict(PROXY_CFG),
+            ).start()
+            print(f"serving plane up: {PROXY_COUNT} proxies "
+                  f"(crash plan {pplan.digest()})")
         # warm the jit path before the schedule clock starts
         wep = GenericEndpoint(cluster.manager_addr)
         wep.connect()
@@ -317,6 +390,23 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
                 target=runner.play, daemon=True
             )
             nem_thread.start()
+        if pplan is not None:
+            prunner = NemesisRunner(
+                cluster.manager_addr, pplan, tick_len=args.tick_len,
+            )
+
+            def _proxy_ctl(action: str, spec: dict) -> None:
+                for idx in spec.get("proxies", ()):
+                    i = int(idx) % PROXY_COUNT
+                    if action == "proxy_crash":
+                        plane.crash_proxy(i)
+                    else:
+                        plane.restart_proxy(i)
+
+            prunner.proxy_ctl = _proxy_ctl
+            pthread = threading.Thread(target=prunner.play, daemon=True)
+            pthread.start()
+            threads.append(pthread)
         crash_log: list = []
         if overload:
             # live leader crash mid-burst: the victim is whoever leads
@@ -347,6 +437,11 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
                                  .get("api_shed", 0)
                         for sid, snap in (pre or {}).items()
                     }
+                    if plane is not None:
+                        # likewise the proxy tier's burst-peak sheds —
+                        # the proxy_crash victim's counter dies with
+                        # its incarnation exactly like the leader's
+                        result["proxy_shed_pre"] = plane.shed_counts()
                     ep = GenericEndpoint(cluster.manager_addr)
                     info = ep.ctrl.request(CtrlRequest("query_info"))
                     victim = (
@@ -423,6 +518,14 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
             ctr = snap.get("host", {}).get("counters", {})
             api_shed[sid] = ctr.get("api_shed", 0)
         result["api_shed"] = api_shed
+        if plane is not None:
+            result["proxy_shed"] = plane.shed_counts()
+            result["proxy_metrics"] = {
+                pid: {
+                    "counters": snap["host"]["counters"],
+                }
+                for pid, snap in plane.scrape().items()
+            }
         result["server_metrics"] = {
             sid: {
                 "tick": snap["tick"],
@@ -498,9 +601,16 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
 
             # server-visible shedding: the post-run scrape PLUS the
             # burst-peak scrape taken just before the leader crash
-            # (the victim's counter does not survive its restart)
+            # (the victim's counter does not survive its restart).
+            # Proxied cells count the proxy tier's front-door sheds as
+            # server-side evidence too — that is where the overload is
+            # SUPPOSED to land once the tiers are split
             server_shed = sum(api_shed.values()) + sum(
                 (result.get("api_shed_pre") or {}).values()
+            ) + sum(
+                (result.get("proxy_shed") or {}).values()
+            ) + sum(
+                (result.get("proxy_shed_pre") or {}).values()
             )
             if shed == 0 or server_shed == 0:
                 result["error"] = (
@@ -547,6 +657,10 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
             result["flight"] = runner.flight_tails(last_n=256)
         if runner is not None:
             runner.close()
+        if prunner is not None:
+            prunner.close()
+        if plane is not None:
+            plane.stop()
         if cluster is not None:
             cluster.stop()
         if not result["ok"]:
@@ -562,13 +676,206 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_shed_ab(args) -> dict:
+    """Fused-vs-proxy shed-point A/B on the hot_burst overload row:
+    the SAME WorkloadPlan (same seed, same digest) runs twice — direct
+    against the shards, then through >= 2 ingress proxies — with the
+    burst phase's rate replaced by a linear offered-rate ramp from 1x to
+    ``RAMP_MAX_X`` the FUSED calibrated capacity.  The shed point is the
+    offered rate at the first client-observed shed; the proxy tier must
+    move it up by >= ``PROXY_AB_MIN_RATIO`` with the sheds landing at
+    the proxy front door (``proxy_shed``) instead of the shards
+    (``api_shed``), while accepted-op p99 and the post-burst recovery
+    tail stay inside the fused budgets.  Committed as the
+    ``kind == "proxy_ab"`` WORKLOADS.json row, gated by
+    scripts/workload_gate.py."""
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
+    from summerset_tpu.client.tester import start_workload_clients
+    from summerset_tpu.host.workload import WorkloadPlan
+    from summerset_tpu.utils.linearize import check_history
+
+    wplan = WorkloadPlan.generate(
+        AB_SEED, "hot_burst", clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    burst = wplan.phases[1]
+    row = {
+        "kind": "proxy_ab", "protocol": "MultiPaxos", "seed": AB_SEED,
+        "wl_digest": wplan.digest(), "ramp_max_x": RAMP_MAX_X,
+        "proxies": PROXY_COUNT, "proxy_cfg": dict(PROXY_CFG),
+        "ok": False,
+    }
+    cap_unit = None
+
+    def run_mode(mode: str) -> dict:
+        nonlocal cap_unit
+        sub = {"mode": mode}
+        tmp = tempfile.mkdtemp(prefix=f"wlab_{mode}_")
+        cluster = None
+        plane = None
+        stop = threading.Event()
+        ops: list = []
+        stats: list = []
+        threads: list = []
+        try:
+            cluster = Cluster(
+                "MultiPaxos", args.replicas, tmp,
+                config=protocol_config("MultiPaxos"), tick=args.tick,
+            )
+            if mode == "proxy":
+                from summerset_tpu.host.ingress import ServingPlane
+
+                plane = ServingPlane(
+                    cluster.manager_addr, proxies=PROXY_COUNT,
+                    proxy_config=dict(PROXY_CFG),
+                ).start()
+            wep = GenericEndpoint(cluster.manager_addr)
+            wep.connect()
+            DriverClosedLoop(wep, timeout=10.0).checked_put("warm", "1")
+            wep.leave()
+            if cap_unit is None:
+                # the FUSED run calibrates once; both runs share that
+                # offered-rate axis so "shed point" compares 1:1
+                cap_unit = calibrate_capacity(
+                    cluster.manager_addr, wplan.clients,
+                    timeout=args.op_timeout,
+                )
+                row["capacity_ops_s"] = round(cap_unit, 1)
+                time.sleep(min(2.0, API_MAX_PENDING / cap_unit + 0.3))
+            print(f"--- proxy_ab {mode}: ramp 1x..{RAMP_MAX_X}x of "
+                  f"{cap_unit:.1f} ops/s across the burst window")
+            t0 = time.monotonic()
+
+            def offered_at(tick: float) -> float:
+                if burst.tick <= tick < burst.tick + burst.ticks:
+                    frac = (tick - burst.tick) / burst.ticks
+                    return (1.0 + frac * (RAMP_MAX_X - 1.0)) * cap_unit
+                return wplan.rate_x_at(tick) * cap_unit
+
+            def rate_total_of() -> float:
+                return offered_at(
+                    (time.monotonic() - t0) / args.tick_len
+                )
+
+            threads = start_workload_clients(
+                cluster.manager_addr, wplan, rate_total_of, stop, ops,
+                stats, timeout=args.op_timeout,
+            )
+            horizon_s = wplan.horizon() * args.tick_len
+            time.sleep(max(0.0, t0 + horizon_s - time.monotonic()))
+            time.sleep(2.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+
+            sub["issued"] = sum(s["issued"] for s in stats)
+            sub["acked"] = sum(s["acked"] for s in stats)
+            sub["shed"] = sum(s["shed"] for s in stats)
+            shed_invs = [o.t_inv for o in ops if o.shed]
+            if shed_invs:
+                tick_at = (min(shed_invs) - t0) / args.tick_len
+                if tick_at >= burst.tick + burst.ticks:
+                    sp = RAMP_MAX_X * cap_unit  # survived the ramp
+                else:
+                    sp = offered_at(tick_at)
+                sub["first_shed_tick"] = round(tick_at, 1)
+            else:
+                sp = RAMP_MAX_X * cap_unit
+                sub["first_shed_tick"] = None
+            sub["shed_point_ops_s"] = round(sp, 1)
+
+            # budget checks shared with the overload cells
+            lat = [o.t_resp - o.t_inv
+                   for o in ops if o.acked and not o.shed]
+            sub["p99_s"] = round(p99(lat), 3)
+            win_rec = phase_window(wplan, 2, t0, args.tick_len)
+            r_lo = win_rec[0] + 0.6 * (win_rec[1] - win_rec[0])
+            rec_acc = accepted_in(ops, r_lo, win_rec[1])
+            rec_tput = len(rec_acc) / max(win_rec[1] - r_lo, 1e-9)
+            sub["recover_tput"] = round(rec_tput, 1)
+            sub["offered_steady"] = round(
+                wplan.phases[0].rate_x * cap_unit, 1
+            )
+
+            full = scrape_metrics(cluster.manager_addr)
+            sub["api_shed"] = {
+                sid: snap.get("host", {}).get("counters", {})
+                         .get("api_shed", 0)
+                for sid, snap in (full or {}).items()
+            }
+            if plane is not None:
+                sub["proxy_shed"] = plane.shed_counts()
+            ok, diag = check_history(ops)
+            sub["linearizable"] = bool(ok)
+            if not ok:
+                sub["error"] = diag
+            return sub
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            if plane is not None:
+                plane.stop()
+            if cluster is not None:
+                cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    row["fused"] = run_mode("fused")
+    row["proxy"] = run_mode("proxy")
+    sp_f = row["fused"]["shed_point_ops_s"]
+    sp_p = row["proxy"]["shed_point_ops_s"]
+    row["shed_point_fused"] = sp_f
+    row["shed_point_proxy"] = sp_p
+    row["shed_ratio"] = round(sp_p / sp_f, 2) if sp_f > 0 else 0.0
+    proxy_shed = sum((row["proxy"].get("proxy_shed") or {}).values())
+    shard_shed = sum((row["proxy"].get("api_shed") or {}).values())
+    row["proxy_run_proxy_shed"] = proxy_shed
+    row["proxy_run_shard_shed"] = shard_shed
+    errs = []
+    if not (row["fused"]["linearizable"]
+            and row["proxy"]["linearizable"]):
+        errs.append("history not linearizable")
+    if row["fused"]["shed"] <= 0:
+        errs.append("fused run never shed — ramp too low to measure")
+    if row["shed_ratio"] < PROXY_AB_MIN_RATIO:
+        errs.append(
+            f"shed point improved only {row['shed_ratio']}x "
+            f"(need >= {PROXY_AB_MIN_RATIO})"
+        )
+    if proxy_shed <= shard_shed or proxy_shed <= 0:
+        errs.append(
+            f"sheds not attributed to the proxy tier "
+            f"(proxy {proxy_shed} vs shard {shard_shed})"
+        )
+    for mode in ("fused", "proxy"):
+        if row[mode]["p99_s"] > args.p99_budget:
+            errs.append(f"{mode} accepted-op p99 over budget")
+        if row[mode]["recover_tput"] < (
+            args.recover_frac * row[mode]["offered_steady"]
+        ):
+            errs.append(f"{mode} post-burst throughput did not recover")
+    row["ok"] = not errs
+    if errs:
+        row["error"] = "; ".join(errs)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--protocol", default="MultiPaxos")
     ap.add_argument("--wl-class", default="hot_burst")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--matrix", action="store_true",
-                    help="run the full joint matrix (WL_MATRIX)")
+                    help="run the full joint matrix (WL_MATRIX) plus "
+                         "the fused-vs-proxy shed-point A/B row")
+    ap.add_argument("--proxy-ab", action="store_true",
+                    help="run ONLY the fused-vs-proxy shed-point A/B "
+                         "(appends/replaces the proxy_ab row)")
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--tick", type=float, default=0.005,
                     help="server tick interval (with api_max_batch="
@@ -585,7 +892,9 @@ def main():
                     default=os.path.join(REPO, "WORKLOADS.json"))
     args = ap.parse_args()
 
-    if args.matrix:
+    if args.proxy_ab:
+        runs = []
+    elif args.matrix:
         runs = list(WL_MATRIX)
     else:
         match = [
@@ -604,6 +913,24 @@ def main():
               f"(ops={r.get('num_ops')}, acked={r.get('acked')}, "
               f"shed={r.get('shed')}, p99={r.get('p99_s')}s)")
         results.append(r)
+    if args.matrix or args.proxy_ab:
+        ab = run_shed_ab(args)
+        status = "PASS" if ab["ok"] else f"FAIL ({ab.get('error')})"
+        print(f"=== proxy_ab: {status} (shed point "
+              f"{ab.get('shed_point_fused')} -> "
+              f"{ab.get('shed_point_proxy')} ops/s, "
+              f"{ab.get('shed_ratio')}x; proxy sheds "
+              f"{ab.get('proxy_run_proxy_shed')} vs shard "
+              f"{ab.get('proxy_run_shard_shed')})")
+        if args.proxy_ab and os.path.exists(args.out):
+            # surgical update: keep the committed matrix rows, swap the
+            # proxy_ab row
+            with open(args.out) as f:
+                results = [
+                    r for r in json.load(f)
+                    if r.get("kind") != "proxy_ab"
+                ]
+        results.append(ab)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {args.out}")

@@ -55,7 +55,11 @@ STORAGE_OWNER = "host/storage.py"
 # seeded-determinism scopes: module -> class names whose methods must be
 # wallclock-free and draw only from explicitly seeded RNGs (the nemesis
 # and workload schedule-generation surfaces; NemesisRunner's and the
-# open-loop drivers' wall pacing are exempt by not being listed)
+# open-loop drivers' wall pacing are exempt by not being listed).
+# host/ingress.py (the serving-plane proxy tier) is in the H101-H104
+# scan like every host/ module but declares NO seeded scope: the proxy
+# holds no schedule generators — its only time reads are wall pacing
+# (forward-cycle ticks, probe deadlines), which the contract exempts.
 SEEDED_SCOPES: Dict[str, Tuple[str, ...]] = {
     "host/nemesis.py": ("FaultPlan", "FaultEvent"),
     "host/workload.py": ("WorkloadPlan", "WorkloadPhase", "OpStream"),

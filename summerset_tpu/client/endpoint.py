@@ -144,15 +144,33 @@ class ClientApiStub:
 
 
 class GenericEndpoint:
-    """Manager-guided endpoint with redirect-aware server selection."""
+    """Manager-guided endpoint with redirect-aware server selection.
+
+    Proxy discovery (serving-plane split, ``host/ingress.py``): when the
+    manager's ``query_info`` lists registered ingress proxies, the
+    endpoint connects to a proxy instead of a replica — same wire, same
+    redirect/backoff machinery, the proxy tier just absorbs the leader
+    topology.  ``via_proxy="auto"`` (the default) uses proxies exactly
+    when some are registered AND the caller did not pin a ``server_id``
+    — so every existing direct-to-replica deployment, test, and soak is
+    byte-identical (no proxies registered -> nothing changes).
+    ``rotate``/``reconnect`` walk the proxy set in proxy mode (a crashed
+    proxy deregisters with its ctrl connection, so the refresh inside
+    ``rotate`` IS the rediscovery), and fall back to direct replica
+    connections if the whole proxy tier is gone.
+    """
 
     def __init__(self, manager_addr: Tuple[str, int],
-                 server_id: Optional[int] = None):
+                 server_id: Optional[int] = None,
+                 via_proxy="auto"):
         self.ctrl = ClientCtrlStub(manager_addr)
         self.id = self.ctrl.id
         self.prefer = server_id
+        self.via_proxy = via_proxy
         self.api: Optional[ClientApiStub] = None
         self.servers = {}
+        self.proxies = {}
+        self.proxy_mode = False
         self.current: Optional[int] = None
         # leader-redirect cache: the freshest leader hint this client has
         # observed (from redirect replies via ``note_leader`` or manager
@@ -167,16 +185,33 @@ class GenericEndpoint:
         if sid is not None and sid >= 0:
             self.leader_cache = sid
 
+    def _refresh_info(self, info) -> None:
+        if info.servers:
+            self.servers = info.servers
+        self.proxies = dict(getattr(info, "proxies", None) or {})
+        if info.leader is not None:
+            self.leader_cache = info.leader
+        self.proxy_mode = bool(self.proxies) and (
+            self.via_proxy is True
+            or (self.via_proxy == "auto" and self.prefer is None)
+        )
+
     def connect(self, timeout: Optional[float] = None) -> None:
         """``timeout`` bounds the server CONNECT only; the manager query
         keeps the ctrl stub's own budget (shrinking it risks stranding a
         stale reply in the ctrl stream — see ``rotate``)."""
         info = self.ctrl.request(CtrlRequest("query_info"))
+        self._refresh_info(info)
+        if self.proxy_mode:
+            # spread clients across the proxy tier by client id (stable
+            # per client, balanced across the fleet)
+            cands = sorted(self.proxies)
+            self._connect_to(
+                cands[self.id % len(cands)], timeout=timeout
+            )
+            return
         if not info.servers:
             raise SummersetError("no servers joined yet")
-        self.servers = info.servers
-        if info.leader is not None:
-            self.leader_cache = info.leader
         target = self.prefer
         if target is None or target not in info.servers:
             target = (
@@ -191,7 +226,10 @@ class GenericEndpoint:
         if self.api is not None:
             self.api.close()
             self.api = None
-        api_addr, _ = self.servers[sid]
+        if self.proxy_mode:
+            api_addr = self.proxies[sid]
+        else:
+            api_addr, _ = self.servers[sid]
         self.api = ClientApiStub(
             self.id, api_addr,
             connect_timeout=15.0 if timeout is None else timeout,
@@ -200,7 +238,8 @@ class GenericEndpoint:
 
     def reconnect(self, sid: Optional[int] = None,
                   timeout: Optional[float] = None) -> None:
-        if sid is not None and sid in self.servers:
+        pool = self.proxies if self.proxy_mode else self.servers
+        if sid is not None and sid in pool:
             self._connect_to(sid, timeout=timeout)
         else:
             # unknown/stale sid: fall back to a fresh manager-guided
@@ -245,30 +284,25 @@ class GenericEndpoint:
                 info = self.ctrl.request(
                     CtrlRequest("query_info"), timeout=5
                 )
-                if info.servers:
-                    self.servers = info.servers
+                self._refresh_info(info)
                 leader = info.leader
             except Exception:
                 pass
-        if not self.servers:
-            return
         if avoid is None:
             avoid = self.current
-        cands = sorted(self.servers)
-        order = []
-        for hint in (self.leader_cache, leader):
-            if (
-                hint is not None and hint in self.servers
-                and hint != avoid and hint not in order
-            ):
-                order.append(hint)
-        start = cands.index(avoid) if avoid in cands else -1
-        for off in range(1, len(cands) + 1):
-            cand = cands[(start + off) % len(cands)]
-            if cand != avoid and cand not in order:
-                order.append(cand)
-        if avoid in cands:
-            order.append(avoid)  # last resort: everything else unreachable
+        if self.proxy_mode:
+            # proxy tier: round-robin the registered proxies (the query
+            # above already dropped any crashed proxy — its ctrl
+            # connection death IS the deregistration); leader hints are
+            # server-space and do not apply here
+            order = self._walk_order(sorted(self.proxies), avoid, ())
+        else:
+            if not self.servers:
+                return
+            order = self._walk_order(
+                sorted(self.servers), avoid,
+                (self.leader_cache, leader),
+            )
         for cand in order:
             b = budget()
             if b is not None and b <= 0:
@@ -278,6 +312,27 @@ class GenericEndpoint:
                 return
             except OSError:
                 continue
+
+    @staticmethod
+    def _walk_order(cands, avoid, hints):
+        """The one failover walk both tiers share: usable ``hints``
+        first, then round-robin from ``avoid``, with ``avoid`` itself
+        as the last resort (everything else unreachable)."""
+        order = []
+        for hint in hints:
+            if (
+                hint is not None and hint in cands
+                and hint != avoid and hint not in order
+            ):
+                order.append(hint)
+        start = cands.index(avoid) if avoid in cands else -1
+        for off in range(1, len(cands) + 1):
+            cand = cands[(start + off) % len(cands)]
+            if cand != avoid and cand not in order:
+                order.append(cand)
+        if avoid in cands:
+            order.append(avoid)
+        return order
 
     def follow_redirect(self, hint: Optional[int],
                         deadline: Optional[float] = None) -> None:

@@ -40,6 +40,29 @@ logger = pf_logger("external")
 
 
 class ExternalApi:
+    """The reusable client-facing ingress tier.
+
+    Composability (the serving-plane split, ``host/ingress.py``): this
+    class is the front door of BOTH tiers — a fused/shard server runs it
+    with the default ``metric_ns="api"``, an ingress proxy embeds its own
+    instance under ``metric_ns="proxy"`` so the same counters surface as
+    the proxy-tier series (``proxy_shed`` / ``proxy_queue_depth`` / ...)
+    and per-tier shed attribution falls out of the namespace alone.
+
+    Queue accounting for the bounded ingress: one ``"req"``, ``"probe"``,
+    or ``"batch"`` request is ONE pending slot regardless of how many
+    commands a batch aggregates — that slot-per-batch rule is exactly the
+    fan-in amortization that lets a proxy tier raise the shard's shed
+    point (the shard drains ``max_batch_size`` *entries* per tick, each
+    carrying a whole proxy batch).  A shed refusal for a batch covers the
+    whole batch with one negative ack; ``conf``/``sub``/``leave`` bypass
+    the bound (rare control-plane ops must not starve under data
+    overload — a subscription is one request per learner lifetime).
+    """
+
+    #: request kinds subject to the bounded-queue shed rule
+    BOUNDED_KINDS = ("req", "batch", "probe")
+
     def __init__(
         self,
         api_addr: Tuple[str, int],
@@ -48,6 +71,7 @@ class ExternalApi:
         max_pending: int = 16384,
         registry=None,
         flight=None,
+        metric_ns: str = "api",
     ):
         self.api_addr = api_addr
         self.batch_interval = batch_interval
@@ -55,6 +79,16 @@ class ExternalApi:
         # ingress bound: data-plane requests beyond this queue depth are
         # shed with a retry-after hint instead of buffered unboundedly
         self.max_pending = max(1, int(max_pending))
+        # metric namespace: "api" on shard servers, "proxy" on the
+        # ingress-proxy tier — same seams, per-tier series
+        self.metric_ns = str(metric_ns)
+        ns = self.metric_ns
+        self._m_requests = f"{ns}_requests_total"
+        self._m_replies = f"{ns}_replies_total"
+        self._m_latency = f"{ns}_request_latency_us"
+        self._m_shed = f"{ns}_shed"
+        self._m_depth = f"{ns}_queue_depth"
+        self._m_evicted = f"{ns}_stamps_evicted"
         # EWMA of the replica's batch-take rate (reqs/s), written by
         # get_req_batch on the replica thread and read (one float load)
         # by servants computing retry-after hints
@@ -74,11 +108,11 @@ class ExternalApi:
         if registry is not None:
             # pre-register so the eviction blind spot is visible (and
             # zero) in every snapshot, not only after an overload
-            registry.counter_add("api_stamps_evicted", 0)
-            # likewise the backpressure lanes: a zero api_shed series
+            registry.counter_add(self._m_evicted, 0)
+            # likewise the backpressure lanes: a zero shed series
             # distinguishes "never overloaded" from "not measured"
-            registry.counter_add("api_shed", 0)
-            registry.gauge_set("api_queue_depth", 0)
+            registry.counter_add(self._m_shed, 0)
+            registry.gauge_set(self._m_depth, 0)
         self._arrivals: Dict[Tuple[int, int], float] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server = None
@@ -121,8 +155,14 @@ class ExternalApi:
                 )
             self._drain_t = now
             if self.registry is not None:
-                self.registry.gauge_set("api_queue_depth", depth)
+                self.registry.gauge_set(self._m_depth, depth)
         return batch
+
+    def has_client(self, client: int) -> bool:
+        """Is ``client``'s connection still owned by a servant?  (Dict
+        membership read, safe cross-thread: the server's commit-feed
+        flush uses it to GC subscribers whose learner connection died.)"""
+        return int(client) in self._writers
 
     def _retry_after_ms(self, depth: int) -> int:
         """Shed hint: estimated ms until the queue has drained ``depth``
@@ -178,9 +218,9 @@ class ExternalApi:
         if reg is not None:
             t0 = self._arrivals.pop((client, reply.req_id), None)
             if t0 is not None and reply.kind in ("reply", "conf"):
-                reg.observe_s("api_request_latency_us",
+                reg.observe_s(self._m_latency,
                               time.monotonic() - t0)
-            reg.counter_add("api_replies_total", kind=reply.kind)
+            reg.counter_add(self._m_replies, kind=reply.kind)
         if self.flight is not None:
             self.flight.record(
                 "api_reply", client=client, req_id=reply.req_id,
@@ -214,10 +254,12 @@ class ExternalApi:
                         writer, ApiReply(kind="leave", req_id=req.req_id)
                     )
                     break
-                if req.kind == "req":
-                    # bounded ingress (conf/leave bypass the bound —
+                if req.kind in self.BOUNDED_KINDS:
+                    # bounded ingress (conf/sub/leave bypass the bound —
                     # rare control ops must not starve under data
-                    # overload).  The check-then-append split below is
+                    # overload; a proxy batch is ONE slot, and its shed
+                    # refusal below covers the whole batch with one
+                    # negative ack).  The check-then-append split below is
                     # still race-free against other servants: they are
                     # coroutines on THIS loop and nothing between the
                     # check and the append awaits, while the replica
@@ -234,14 +276,14 @@ class ExternalApi:
                         hint = self._retry_after_ms(depth)
                         if self.registry is not None:
                             self.registry.counter_add(
-                                "api_requests_total"
+                                self._m_requests
                             )
-                            self.registry.counter_add("api_shed")
+                            self.registry.counter_add(self._m_shed)
                             # the shed IS this request's reply; keep
                             # the requests/replies counter pair
                             # reconcilable under sustained overload
                             self.registry.counter_add(
-                                "api_replies_total", kind="shed"
+                                self._m_replies, kind="shed"
                             )
                         if self.flight is not None:
                             self.flight.record(
@@ -260,18 +302,24 @@ class ExternalApi:
                         req_id=req.req_id, kind=req.kind,
                     )
                 if self.registry is not None:
-                    self.registry.counter_add("api_requests_total")
+                    self.registry.counter_add(self._m_requests)
+                if self.registry is not None and req.kind != "batch":
+                    # only kinds whose reply echoes this req_id are
+                    # stamped: a proxy batch is answered PER-PRID, so
+                    # its bid stamp would never be popped — thousands
+                    # of phantom entries would evict live stamps and
+                    # skew the latency histogram optimistic
                     arr = self._arrivals
                     arr[(int(client), req.req_id)] = time.monotonic()
                     if len(arr) > 8192:  # age out reply-less stamps
                         # the oldest stamps are exactly the slowest
                         # outstanding requests, so their loss skews
-                        # api_request_latency_us optimistic — count the
+                        # the latency histogram optimistic — count the
                         # evictions so the gap is diagnosable
                         for k in list(arr)[:4096]:
                             del arr[k]
                         self.registry.counter_add(
-                            "api_stamps_evicted", 4096
+                            self._m_evicted, 4096
                         )
                 with self._lock:
                     self._pending.append((int(client), req))

@@ -18,12 +18,36 @@ from .statemach import Command, CommandResult
 # --------------------------------------------------------------- data plane
 @dataclasses.dataclass(frozen=True)
 class ApiRequest:
-    """Client -> server (parity: ``ApiRequest::{Req, Conf, Leave}``)."""
+    """Client -> server (parity: ``ApiRequest::{Req, Conf, Leave}``).
+
+    The compartmentalized serving plane (``host/ingress.py``) adds three
+    tier-to-tier kinds that ride the same wire:
+
+    - ``"batch"`` — an ingress proxy's aggregated forward: ``batch`` is
+      a list of ``(proxy req id, Command)`` pairs that the shard unpacks
+      into individual ops.  A batch occupies ONE slot in the shard's
+      bounded ingress queue (the fan-in amortization that moves the shed
+      point off the shard and onto the proxy tier), and a shed refusal
+      covers the whole batch with one negative ack.
+    - ``"sub"``   — a learner/read-tier subscription: the replica
+      replies with a full KV snapshot + its commit-feed sequence number,
+      then streams ``"note"`` replies for every applied put.
+    - ``"probe"`` — a read-tier freshness probe for ``cmd``'s key: the
+      replica answers (on its own tick thread, exactly where the fused
+      lease-read decision is made) whether a lease-local read is
+      currently allowed for that key's group, plus its commit-feed seq —
+      the learner serves locally iff its learned seq covers the probe's.
+
+    ``"stats"`` is answered by INGRESS PROXIES only (per-tier metrics
+    scrape over the data plane; a fused server answers error).
+    """
 
     kind: str                      # "req" | "conf" | "leave"
+    #                              # | "batch" | "sub" | "probe" | "stats"
     req_id: int = 0
-    cmd: Optional[Command] = None  # kind == "req"
+    cmd: Optional[Command] = None  # kind == "req" | "probe"
     conf_delta: Optional[dict] = None  # kind == "conf" (protocol-specific)
+    batch: Optional[list] = None   # kind == "batch": [(prid, Command)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,7 +63,7 @@ class ApiReply:
     the same full queue)."""
 
     kind: str   # "reply" | "conf" | "redirect" | "error" | "shed"
-    #             | "leave"
+    #             | "leave" | "sub" | "note" | "probe" | "stats"
     req_id: int = 0
     result: Optional[CommandResult] = None
     redirect: Optional[int] = None  # hinted leader id
@@ -47,6 +71,12 @@ class ApiReply:
     rq_retry: bool = False          # read-query retry hint
     local: bool = False             # served as a leased local read
     retry_after_ms: int = 0         # shed: suggested client backoff
+    # commit-feed plane (read tier, host/ingress.py): "sub" carries the
+    # snapshot KV dict in `notes` with `seq` = the feed position it
+    # covers; "note" streams [(seq, key, value), ...] applied puts in
+    # apply order; "probe" answers success=lease_ok + the current seq
+    seq: int = 0
+    notes: Optional[Any] = None
 
 
 # -------------------------------------------------------------- p2p plane
@@ -98,7 +128,7 @@ class CtrlRequest:
 
     kind: str  # query_info | query_conf | reset_servers | pause_servers
     #            | resume_servers | take_snapshot | inject_faults
-    #            | metrics_dump | flight_dump | leave
+    #            | metrics_dump | flight_dump | proxy_join | leave
     servers: Optional[List[int]] = None  # None = all
     durable: bool = True                 # reset: keep durable files?
     payload: Optional[Dict[str, Any]] = None  # inject_faults: fault spec
@@ -129,3 +159,9 @@ class CtrlReply:
     # per-server reply payloads gathered by the fan-out (metrics_dump:
     # sid -> telemetry snapshot); None for ack-only orchestration kinds
     payloads: Optional[Dict[int, Any]] = None
+    # registered ingress proxies (host/ingress.py): pid -> api_addr,
+    # returned by query_info so clients discover the proxy tier through
+    # the same manager round they already make (a proxy deregisters when
+    # its ctrl connection drops, so rediscovery after a proxy crash is
+    # one fresh query_info away)
+    proxies: Optional[Dict[int, Any]] = None

@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..host.messages import CtrlRequest
+from ..utils.errors import SummersetError
 from ..utils.logging import pf_info, pf_logger, pf_warn
 
 logger = pf_logger("nemesis")
@@ -90,6 +91,15 @@ ALL_CLASSES = (
     "mem_pressure",  # bounded WAL write-back buffer (`arg` bytes): group
                    # commit degrades to constant forced fsyncs + reclaim
                    # stalls (memory pressure on the durability path)
+    "proxy_crash",  # serving-plane tier fault (host/ingress.py): kill an
+                   # ingress PROXY (targets = proxy indices, not replica
+                   # ids) and restart it after `duration` ticks — its
+                   # ctrl-connection drop deregisters it at the manager,
+                   # so clients must rediscover the tier via the
+                   # re-announce in their next query_info/rotate.  Played
+                   # through NemesisRunner.proxy_ctl (the soak wires it
+                   # to a live ServingPlane); plans without an attached
+                   # proxy tier record the action as an error, not fatal
 )
 
 # slow_peer host-lowering constants: the bandwidth cap is sized so a
@@ -111,6 +121,8 @@ HOST_ONLY = (
     # clock_skew) — disk latency, egress bandwidth, and allocator
     # pressure live in the host hubs
     "slow_disk", "slow_peer", "mem_pressure",
+    # the proxy tier is a host-process tier with no device analog at all
+    "proxy_crash",
 )
 # instantaneous events: no heal action at tick + duration
 INSTANT = ("crash", "wal_torn", "wal_fsync", "conf_change",
@@ -279,6 +291,35 @@ class FaultPlan:
         heal_tail = max(6, ticks // 8)
         dur = max(4, ticks - onset - heal_tail)
         ev = FaultEvent(onset, kind, (0,), dur, float(arg))
+        return FaultPlan(seed, population, ticks, (ev,))
+
+    @staticmethod
+    def proxy_crash(
+        seed: int,
+        population: int,
+        ticks: int,
+        proxies: int = 2,
+        at: Optional[int] = None,
+        restart_after: int = 10,
+    ) -> "FaultPlan":
+        """Canonical single-event proxy-tier crash plan: kill ingress
+        proxy ``seed % proxies`` at schedule tick ``at`` (or a seeded
+        point ~1/3 in) and restart it ``restart_after`` ticks later.
+        Targets are PROXY indices; the soak runner plays it against a
+        live :class:`~summerset_tpu.host.ingress.ServingPlane` via
+        ``NemesisRunner.proxy_ctl``.  Deterministic given its arguments,
+        so committed rows regenerate the digest without a cluster —
+        the same contract as :meth:`failslow`."""
+        import random
+
+        rng = random.Random((seed << 8) ^ 0x9C)
+        if at is None:
+            at = rng.randint(max(2, ticks // 3), max(3, ticks // 2))
+        pidx = seed % max(1, int(proxies))
+        ev = FaultEvent(
+            int(at), "proxy_crash", (pidx,),
+            max(1, int(restart_after)), 0.0,
+        )
         return FaultPlan(seed, population, ticks, (ev,))
 
     # ------------------------------------------------------- determinism
@@ -459,6 +500,15 @@ class FaultPlan:
                              {"per": {r: spec for r in ts}}))
                 acts.append((end, "net_clear", f"@{end:05d} slow_peer "
                              f"heal targets={ts}", {"servers": ts}))
+            elif ev.kind == "proxy_crash":
+                # targets are PROXY indices (the runner's proxy_ctl maps
+                # them onto the live ServingPlane); the heal action is
+                # the restart — a fresh incarnation on the same port
+                acts.append((ev.tick, "proxy_crash", ev.render(),
+                             {"proxies": ts}))
+                acts.append((end, "proxy_restart",
+                             f"@{end:05d} proxy restart targets={ts}",
+                             {"proxies": ts}))
             elif ev.kind == "wal_torn":
                 acts.append((ev.tick, "wal", ev.render(),
                              {"servers": ts, "spec": {"torn": 1}}))
@@ -494,6 +544,12 @@ class NemesisRunner:
         self.ep = GenericEndpoint(manager_addr)  # ctrl stub only
         self.executed: List[Tuple[int, str]] = []
         self._on_action = on_action
+        # serving-plane hook: the soak wires this to a live
+        # ServingPlane so proxy_crash/proxy_restart actions land on real
+        # proxy processes; plans scheduling proxy faults without a tier
+        # attached record the action error (not fatal) like any other
+        # impossible fault action
+        self.proxy_ctl: Optional[Callable[[str, dict], None]] = None
         # in-flight conf_change driver threads: conf entries ride the log
         # and may take many ticks to install under faults — the schedule
         # must keep playing WHILE they do (that concurrency is the point)
@@ -538,6 +594,12 @@ class NemesisRunner:
             self._inject(spec["servers"], {"skew": spec["factor"]})
         elif action == "conf_change":
             self._start_conf_change(list(spec["responders"]))
+        elif action in ("proxy_crash", "proxy_restart"):
+            if self.proxy_ctl is None:
+                raise SummersetError(
+                    "proxy fault scheduled but no serving plane attached"
+                )
+            self.proxy_ctl(action, spec)
         elif action == "take_snapshot":
             if spec.get("crash"):
                 # arm the crash point FIRST: the snapshot request then

@@ -372,6 +372,19 @@ class ServerReplica:
         self._snap_last = 0           # sum(applied) at last auto-snapshot
         self._pending_serve: Dict[Tuple[int, int], Any] = {}
         self._pending_kv_serve = False
+        # commit feed (serving-plane read tier, host/ingress.py): a
+        # learner subscribes with ApiRequest("sub") and receives every
+        # applied put as ordered (seq, key, value) notes; "probe"
+        # requests answer the lease-local-read verdict for a key's group
+        # plus the current feed seq ON THE REPLICA THREAD — the same
+        # place the fused local-read decision is made, so the learner's
+        # freshness rule (learned seq >= probe seq, notes and probe
+        # replies FIFO on one writer) inherits the identical lease
+        # safety argument.  Zero cost with no subscribers: the seq only
+        # advances (and notes only accumulate) while _subs is non-empty.
+        self._subs: Dict[int, bool] = {}
+        self._sub_seq = 0
+        self._sub_notes: List[Tuple[int, str, Any]] = []
         # client ConfChange plane (external.rs:106-121): one in flight
         self._conf_kind = (
             "ql" if "ql_out" in self.state
@@ -1045,6 +1058,67 @@ class ServerReplica:
             ))
         self._conf_queue.append((client, req))
 
+    # ------------------------------------------------------- commit feed
+    def _handle_subscribe(self, client: int, req: ApiRequest) -> None:
+        """Register a read-tier learner: the reply carries a consistent
+        KV snapshot plus the feed seq it covers; every put applied after
+        this point streams as a note (parity role: the learner tier of
+        compartmentalized SMR — commit notifications without ever
+        touching the proposer path)."""
+        self._subs[int(client)] = True
+        self._reply(client, ApiReply(
+            "sub", req_id=req.req_id, success=True, seq=self._sub_seq,
+            notes=self.statemach.snapshot_items(),
+        ))
+
+    def _handle_probe(self, client: int, req: ApiRequest) -> None:
+        """Answer a read-tier freshness probe: may a lease-local read of
+        this key be served RIGHT NOW, and what feed seq covers it?  Runs
+        on the replica thread between last tick's applies (all flushed as
+        notes) and this tick's — so a learner whose stream has reached
+        ``seq`` holds every write this replica had applied when the
+        verdict was sampled, and the lease condition is read exactly
+        where the fused serving path reads it."""
+        ok = False
+        if req.cmd is not None and req.cmd.kind == "get":
+            g = self.group_of(req.cmd.key)
+            if self._is_leader[g]:
+                ok = self._leader_read_ok(g) and not self._tail_writes_key(
+                    g, req.cmd.key
+                )
+            else:
+                ok = self._can_local_read(g)
+        self._reply(client, ApiReply(
+            "probe", req_id=req.req_id, success=bool(ok),
+            seq=self._sub_seq,
+        ))
+
+    def _note_put(self, key: str, value: Any) -> None:
+        """Append one applied put to the commit feed (no-op without
+        subscribers — the fused path pays one dict-truthiness check)."""
+        if self._subs:
+            self._sub_seq += 1
+            self._sub_notes.append((self._sub_seq, key, value))
+
+    def _flush_notes(self) -> None:
+        """Ship buffered notes to every live subscriber, once per tick,
+        strictly AFTER the group-commit fsync (notes reflect applied
+        state; like client replies they must never precede the
+        durability point covering it).  Dead learners (connection gone)
+        are GC'd here instead of accumulating notes forever."""
+        if not (self._subs and self._sub_notes):
+            return
+        notes = self._sub_notes
+        self._sub_notes = []
+        for c in [c for c in self._subs
+                  if not self.external.has_client(c)]:
+            del self._subs[c]
+        last = notes[-1][0]
+        for c in self._subs:
+            self._reply(c, ApiReply(
+                "note", req_id=0, seq=last, notes=notes,
+            ))
+
     def _intake(self) -> Tuple[np.ndarray, np.ndarray, Dict]:
         """Drain the client plane: route requests to groups, serve leased
         local reads, redirect what we don't lead, answer every request
@@ -1062,6 +1136,24 @@ class ServerReplica:
         for client, req in batch:
             if req.kind == "conf":
                 self._handle_conf_req(client, req)
+            elif req.kind == "batch":
+                # ingress-proxy forward: unpack into individual ops —
+                # each (prid, Command) behaves exactly like a direct
+                # client "req" from here on (replies route back to the
+                # proxy per prid); the batch already paid its ONE
+                # bounded-queue slot at the api plane
+                for prid, cmd in (req.batch or ()):
+                    if cmd is None:
+                        continue
+                    by_group.setdefault(
+                        self.group_of(cmd.key), []
+                    ).append((client, ApiRequest(
+                        "req", req_id=int(prid), cmd=cmd,
+                    )))
+            elif req.kind == "sub":
+                self._handle_subscribe(client, req)
+            elif req.kind == "probe":
+                self._handle_probe(client, req)
             elif req.kind != "req" or req.cmd is None:
                 self._reply(client, ApiReply(
                     "error", req_id=req.req_id, success=False,
@@ -1847,6 +1939,12 @@ class ServerReplica:
             k: v for k, v in kv.items() if self.group_of(k) in ok_groups
         }
         self.statemach._kv.update(upd)
+        # install-snapshot jumps bypass the per-slot apply loop, so the
+        # commit feed must carry the transferred values too — a learner
+        # of a jumped replica would otherwise hold keys the replica
+        # itself serves newer values of
+        for k, v in upd.items():
+            self._note_put(k, v)
         # the transferred values' write slots must ride along, or a
         # jumped replica would report stale/absent wslots for NEWER
         # values and lose the near-quorum-read max-by-wslot comparison
@@ -1889,6 +1987,8 @@ class ServerReplica:
                 mine = (g, vid) in self.origin
                 for client, req in batch:
                     res = apply_command(self.statemach._kv, req.cmd)
+                    if req.cmd.kind == "put":
+                        self._note_put(req.cmd.key, req.cmd.value)
                     if mine:
                         self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,
@@ -2009,6 +2109,7 @@ class ServerReplica:
                     res = apply_command(self.statemach._kv, req.cmd)
                     if req.cmd.kind == "put":
                         self._wslot[req.cmd.key] = slot
+                        self._note_put(req.cmd.key, req.cmd.value)
                     if mine:
                         self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,
@@ -2043,6 +2144,7 @@ class ServerReplica:
         for client, reply in self._reply_queue:
             self._reply(client, reply)
         self._reply_queue.clear()
+        self._flush_notes()
         if self._trace_replied:
             now = time.monotonic()
             for g, vid in self._trace_replied:

@@ -319,3 +319,28 @@ DECLARED = (
     "pp_bytes",
     "pp_items",
 )
+
+# canonical metric names every INGRESS PROXY (host/ingress.py) must
+# expose — the proxy-tier twin of DECLARED, pre-registered at proxy
+# construction so "never routed / never shed / never served a learner
+# read" all read as zero series, not missing ones.  The proxy's embedded
+# ExternalApi contributes the proxy_requests/replies/shed/queue_depth
+# family through its metric namespace; the routing/dedupe/read-tier
+# counters are the proxy's own.  Per-tier queue-depth attribution:
+# ``api_queue_depth`` is the shard tier's gauge, ``proxy_queue_depth``
+# the proxy tier's — overload location is readable straight off which
+# tier's ``*_shed`` series moves.
+PROXY_DECLARED = (
+    "proxy_requests_total",
+    "proxy_replies_total",
+    "proxy_request_latency_us",
+    "proxy_stamps_evicted",
+    "proxy_shed",            # front-door sheds AT the proxy tier
+    "proxy_queue_depth",
+    "proxy_routed",          # commands forwarded to owner shards
+    "proxy_dedupe_hits",     # (client, req_id) duplicates absorbed
+    "proxy_upstream_shed",   # shard-tier sheds relayed through
+    "proxy_backlog",         # internal forward backlog depth gauge
+    "read_tier_served",      # gets served from the learner's state
+    "read_tier_backlog",     # in-flight freshness probes gauge
+)

@@ -67,6 +67,16 @@ EVENT_TYPES = (
     "wal_fsync",     # group-commit durability point (dur_us, batch)
     "commit",        # slot passed the commit bar (g, vid, slot, tick)
     "apply",         # slot applied to the KV (g, vid, slot, tick)
+    "proxy_fwd",     # ingress proxy forwarded an op to an owner shard
+                     # (sid, prid, client, req_id, fwd_id) — pairs with
+                     # the shard's api_ingress where client == fwd_id
+                     # and req_id == prid, giving trace_export the
+                     # client→proxy→shard flow arrow with no wire change
+    "proxy_rcv",     # upstream reply returned to the proxy (sid, prid,
+                     # kind) — the shard→proxy half of the hop chain
+    "read_serve",    # read tier served a get from learner state
+                     # (client, req_id, seq) — the probe-gated
+                     # lease-local read that never touched the proposer
     "fault_ctl",     # nemesis fault_ctl received (planes touched)
     "demote",        # health plane indicted THIS replica's leadership and
                      # the server voluntarily stepped down (signals, the

@@ -58,6 +58,13 @@ class ClusterManager:
         self.servers: Dict[int, _ServerConn] = {}
         self.leader: Optional[int] = None
         self.conf: Optional[dict] = None
+        # registered ingress proxies (host/ingress.py): ctrl-conn cid ->
+        # api_addr.  A proxy registers with CtrlRequest("proxy_join")
+        # over its ctrl connection and is DE-registered the moment that
+        # connection drops — so a crashed proxy disappears from the very
+        # next query_info, and client rediscovery is one manager round
+        # (the re-announce the proxy_crash nemesis class relies on)
+        self.proxies: Dict[int, Tuple[str, int]] = {}
         self._next_sid = 0
         self._next_cid = 1000
         self._conf_seq = 0  # total order over relayed ConfChanges
@@ -220,12 +227,16 @@ class ClusterManager:
                 if req.kind == "leave":
                     await safetcp.send_msg(writer, CtrlReply("leave"))
                     break
-                reply = await self._handle_request(req)
+                reply = await self._handle_request(req, cid=cid)
                 await safetcp.send_msg(writer, reply)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             writer.close()
+            # a proxy lives exactly as long as its ctrl connection: the
+            # pop here IS the deregistration clients rediscover through
+            if self.proxies.pop(cid, None) is not None:
+                pf_warn(logger, f"proxy {cid} deregistered")
 
     def _targets(self, req: CtrlRequest):
         ids = req.servers
@@ -370,7 +381,8 @@ class ClusterManager:
                 done.append(sid)
         return CtrlReply("reset_state", done=done)
 
-    async def _handle_request(self, req: CtrlRequest) -> CtrlReply:
+    async def _handle_request(self, req: CtrlRequest,
+                              cid: Optional[int] = None) -> CtrlReply:
         if req.kind == "query_info":
             return CtrlReply(
                 "info",
@@ -381,7 +393,18 @@ class ClusterManager:
                     if s.joined
                 },
                 leader=self.leader,
+                proxies=dict(self.proxies),
             )
+        if req.kind == "proxy_join":
+            # ingress-proxy registration (host/ingress.py): the proxy's
+            # identity is its ctrl-connection cid, so no id plane is
+            # added — registration and liveness share one socket
+            addr = tuple((req.payload or {}).get("api_addr") or ())
+            if cid is None or len(addr) != 2:
+                return CtrlReply("proxy_join", done=[])
+            self.proxies[cid] = (str(addr[0]), int(addr[1]))
+            pf_info(logger, f"proxy {cid} joined @ {addr}")
+            return CtrlReply("proxy_join", done=[cid])
         if req.kind == "query_conf":
             return CtrlReply("conf", conf=self.conf, leader=self.leader)
         if req.kind == "pause_servers":

@@ -1,0 +1,512 @@
+"""Compartmentalized serving plane (host/ingress.py): routing-table
+units, learner read-tier logic, proxy-hop trace export, and live
+cluster-behind-proxies integration — accept/dedupe/batch/route through
+real sockets, proxy crash + rediscovery, and the commit-feed
+subscribe/note/probe seam the read tier rides."""
+
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts",
+))
+
+import trace_export  # noqa: E402
+
+from summerset_tpu.host.ingress import (  # noqa: E402
+    LEARNER_ID_OFFSET, LearnerReadTier, RoutingTable, ServingPlane,
+)
+from summerset_tpu.host.messages import ApiReply, ApiRequest  # noqa: E402
+from summerset_tpu.host.statemach import Command  # noqa: E402
+from summerset_tpu.host.telemetry import (  # noqa: E402
+    MetricsRegistry, PROXY_DECLARED,
+)
+from summerset_tpu.host.tracing import FlightRecorder  # noqa: E402
+from summerset_tpu.utils import safetcp  # noqa: E402
+
+
+# ---------------------------------------------------------------- units --
+class TestRoutingTable:
+    def test_default_full_range_to_leader(self):
+        rt = RoutingTable()
+        rt.update({0: ("h", 1), 1: ("h", 2), 2: ("h", 3)}, leader=1)
+        assert rt.owner_for("") == 1
+        assert rt.owner_for("zzz") == 1
+        assert rt.write_target() == 1
+
+    def test_no_leader_falls_back_to_lowest_sid(self):
+        rt = RoutingTable()
+        rt.update({2: ("h", 3), 0: ("h", 1)}, leader=None)
+        assert rt.owner_for("k") == 0
+
+    def test_note_leader_rebuilds_but_keeps_overrides(self):
+        rt = RoutingTable()
+        rt.update({0: ("h", 1), 1: ("h", 2)}, leader=0)
+        rt.set_owner("a", "m", 1)
+        assert rt.owner_for("b") == 1 and rt.owner_for("x") == 0
+        rt.note_leader(1)
+        assert rt.owner_for("x") == 1
+        assert rt.owner_for("b") == 1  # override survives
+        assert rt.version >= 3
+
+    def test_negative_hint_ignored(self):
+        rt = RoutingTable()
+        rt.update({0: ("h", 1)}, leader=0)
+        v = rt.version
+        rt.note_leader(-1)
+        assert rt.leader == 0 and rt.version == v
+
+    def test_reader_prefers_non_leader_responder(self):
+        rt = RoutingTable()
+        rt.update({0: ("h", 1), 1: ("h", 2), 2: ("h", 3)},
+                  leader=0, responders=[0, 2])
+        assert rt.reader_sid() == 2  # responder, not the leader
+        rt.update({0: ("h", 1), 1: ("h", 2), 2: ("h", 3)},
+                  leader=0, responders=[])
+        assert rt.reader_sid() in (1, 2)  # any non-leader
+        rt.update({0: ("h", 1)}, leader=0, responders=[])
+        assert rt.reader_sid() is None  # never the proposer
+
+    def test_declared_proxy_series_unique(self):
+        assert len(PROXY_DECLARED) == len(set(PROXY_DECLARED))
+
+
+class _FakeProxy:
+    """Duck-typed IngressProxy core for LearnerReadTier unit tests."""
+
+    def __init__(self):
+        import collections
+
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._requeue = collections.deque()
+        self._pends = {}
+        self.cid = 1234
+        self.metrics = MetricsRegistry()
+        self.flight = FlightRecorder(enabled=True, me=1234)
+        self.routing = RoutingTable()  # empty: learner thread idles
+        self.replies = []
+
+    def _pop_pend(self, prid):
+        return self._pends.pop(prid, None)
+
+    def _drop_pend(self, prid):
+        self._pop_pend(prid)
+
+    def _reply_client(self, pend, reply, cache=True):
+        self.replies.append((pend["client"], reply))
+
+
+class TestLearnerUnit:
+    def _mk(self):
+        p = _FakeProxy()
+        lt = LearnerReadTier(p)
+        return p, lt
+
+    def test_not_ready_refuses_probe(self):
+        p, lt = self._mk()
+        assert not lt.try_probe(1, Command("get", "k"))
+        p._stop.set()
+
+    def test_probe_reply_serves_from_learned_state(self):
+        p, lt = self._mk()
+        lt.kv = {"k": "v7"}
+        lt.seq = 5
+        p._pends[9] = {"client": 3, "req_id": 40,
+                       "cmd": Command("get", "k")}
+        with p._lock:
+            lt._probes[9] = time.monotonic() + 2
+        lt._on_probe_reply(ApiReply("probe", req_id=9, success=True,
+                                    seq=5))
+        assert p.replies and p.replies[0][0] == 3
+        rep = p.replies[0][1]
+        assert rep.kind == "reply" and rep.result.value == "v7"
+        assert rep.local
+        assert p.metrics.counter_value("read_tier_served") == 1
+        p._stop.set()
+
+    def test_refused_probe_falls_back_and_backs_off(self):
+        p, lt = self._mk()
+        lt.kv = {}
+        lt.seq = 5
+        lt.ready = True
+        lt._sock = object()  # never used: refusal path only
+        p._pends[9] = {"client": 3, "req_id": 40,
+                       "cmd": Command("get", "k")}
+        with p._lock:
+            lt._probes[9] = time.monotonic() + 2
+        lt._on_probe_reply(ApiReply("probe", req_id=9, success=False,
+                                    seq=5))
+        assert list(p._requeue) == [9]          # owner path takes over
+        assert not p.replies
+        # refusal backoff: the next probe is suppressed entirely
+        assert not lt.try_probe(10, Command("get", "k"))
+        p._stop.set()
+
+    def test_stale_seq_falls_back(self):
+        p, lt = self._mk()
+        lt.seq = 3                              # learned stream behind
+        p._pends[9] = {"client": 3, "req_id": 40,
+                       "cmd": Command("get", "k")}
+        with p._lock:
+            lt._probes[9] = time.monotonic() + 2
+        lt._on_probe_reply(ApiReply("probe", req_id=9, success=True,
+                                    seq=8))
+        assert list(p._requeue) == [9]
+        p._stop.set()
+
+    def test_expired_probe_drops_pend(self):
+        p, lt = self._mk()
+        p._pends[9] = {"client": 3, "req_id": 40,
+                       "cmd": Command("get", "k")}
+        with p._lock:
+            lt._probes[9] = time.monotonic() - 1
+        lt.expire_probes(time.monotonic())
+        assert 9 not in p._pends and not lt._probes
+        p._stop.set()
+
+
+# -------------------------------------------------- proxy-hop export --
+def _proxy_hop_dumps():
+    """Synthetic proxy + shard flight dumps forming one forwarded op:
+    client -> proxy (api_ingress) -> shard (proxy_fwd/api_ingress) ->
+    reply (api_reply/proxy_rcv) -> client (api_reply)."""
+    t = [1000 * i for i in range(1, 9)]
+    proxy = {
+        "v": 1, "me": 1001, "tier": "proxy", "count": 4, "dropped": 0,
+        "t_start_us": 0, "t_dump_us": 99999,
+        "events": [
+            {"n": 0, "t_us": t[0], "type": "api_ingress",
+             "client": 2000, "req_id": 7, "kind": "req"},
+            {"n": 1, "t_us": t[1], "type": "proxy_fwd", "sid": 0,
+             "prid": 55, "n": 1, "fwd_id": 1001},
+            {"n": 2, "t_us": t[5], "type": "proxy_rcv", "sid": 0,
+             "prid": 56, "kind": "reply"},
+            {"n": 3, "t_us": t[6], "type": "api_reply",
+             "client": 2000, "req_id": 7, "kind": "reply"},
+            {"n": 4, "t_us": t[6] + 10, "type": "read_serve",
+             "client": 2001, "req_id": 9, "seq": 3},
+        ],
+    }
+    shard = {
+        "v": 1, "me": 0, "protocol": "MultiPaxos", "count": 3,
+        "dropped": 0, "t_start_us": 0, "t_dump_us": 99999,
+        "events": [
+            {"n": 0, "t_us": t[2], "type": "api_ingress",
+             "client": 1001, "req_id": 55, "kind": "batch"},
+            {"n": 1, "t_us": t[3], "type": "commit", "g": 0, "vid": 1,
+             "slot": 0, "tick": 3},
+            {"n": 2, "t_us": t[4], "type": "api_reply",
+             "client": 1001, "req_id": 56, "kind": "reply"},
+        ],
+    }
+    return {"p0": proxy, "0": shard}
+
+
+class TestProxyHopExport:
+    def test_flow_arrows_and_schema(self):
+        doc = trace_export.export_chrome(_proxy_hop_dumps(), align=False)
+        assert trace_export.validate_chrome(doc) == []
+        evs = doc["traceEvents"]
+        hops = [e for e in evs if e.get("cat") == "proxyhop"]
+        # one forward arrow (proxy_fwd -> shard api_ingress) and one
+        # reply arrow (shard api_reply -> proxy_rcv), each s+f
+        fwd = [e for e in hops if e["id"] == "phop-1001-55"]
+        rep = [e for e in hops if e["id"] == "prep-1001-56"]
+        assert sorted(e["ph"] for e in fwd) == ["f", "s"]
+        assert sorted(e["ph"] for e in rep) == ["f", "s"]
+        # arrows start at the proxy / shard respectively
+        assert {e["pid"] for e in fwd} == {1001, 0}
+        names = {e.get("name") for e in evs}
+        assert "read_serve" in names
+        # proxy process labeled as a proxy, not a replica
+        procs = [e for e in evs
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert any("proxy 1001" in e["args"]["name"] for e in procs)
+
+    def test_no_arrows_without_proxy_dumps(self):
+        dumps = _proxy_hop_dumps()
+        del dumps["p0"]
+        doc = trace_export.export_chrome(dumps, align=False)
+        assert trace_export.validate_chrome(doc) == []
+        assert not [
+            e for e in doc["traceEvents"]
+            if e.get("cat") == "proxyhop"
+        ]
+
+
+# ------------------------------------------------------------ muxfleet --
+class TestMuxFleet:
+    """The selector-multiplexed closed-loop fleet against a bare
+    ExternalApi echo tier: framing, closed-loop pacing, shed parking,
+    concurrency accounting — no consensus cluster needed."""
+
+    @pytest.fixture()
+    def echo_api(self):
+        from summerset_tpu.host.external import ExternalApi
+
+        import socket as socket_mod
+
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        api = ExternalApi(("127.0.0.1", port), max_pending=64)
+        stop = threading.Event()
+
+        def pump():
+            from summerset_tpu.host.statemach import CommandResult
+
+            while not stop.is_set():
+                for client, req in api.get_req_batch(timeout=0.05):
+                    if req.kind in ("req",):
+                        api.send_reply(ApiReply(
+                            "reply", req_id=req.req_id,
+                            result=CommandResult("get", value="x"),
+                        ), client)
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        yield ("127.0.0.1", port)
+        stop.set()
+        api.stop()
+
+    def test_closed_loop_fleet(self, echo_api):
+        from summerset_tpu.client.muxfleet import run_fleet
+
+        out = run_fleet([echo_api], clients=50, secs=1.5, seed=3)
+        assert out["connected_peak"] == 50
+        assert out["acked"] > 50          # multiple rounds per client
+        assert out["issued"] >= out["acked"]
+        assert out["timeouts"] == 0
+        assert out["lat_p50_ms"] > 0
+
+    def test_think_time_paces_offered_rate(self, echo_api):
+        from summerset_tpu.client.muxfleet import run_fleet
+
+        out = run_fleet(
+            [echo_api], clients=40, secs=2.0, seed=3, think=1.0,
+        )
+        assert out["connected_peak"] == 40
+        # staggered first ops: ~secs/think * clients ops total, far
+        # below the unpaced hot loop
+        assert 0 < out["acked"] < 40 * 6
+
+
+# ----------------------------------------------------------- live tier --
+@pytest.fixture(scope="module")
+def proxied_cluster(tmp_path_factory):
+    """One MultiPaxos cluster with a 2-proxy serving plane in front."""
+    from test_cluster import Cluster
+
+    c = Cluster(
+        "MultiPaxos", 3, tmp_path_factory.mktemp("ingress_cluster"),
+    )
+    plane = ServingPlane(c.manager_addr, proxies=2).start()
+    yield c, plane
+    plane.stop()
+    c.stop()
+
+
+def _fresh_ep(cluster, **kw):
+    from summerset_tpu.client.endpoint import GenericEndpoint
+
+    ep = GenericEndpoint(cluster.manager_addr, **kw)
+    ep.connect()
+    return ep
+
+
+class TestLiveProxyServing:
+    def test_roundtrips_through_proxy(self, proxied_cluster):
+        from summerset_tpu.client.drivers import DriverClosedLoop
+
+        cluster, plane = proxied_cluster
+        ep = _fresh_ep(cluster)
+        assert ep.proxy_mode, "client must auto-discover the proxy tier"
+        drv = DriverClosedLoop(ep, timeout=10.0)
+        for i in range(8):
+            drv.checked_put(f"ik{i}", f"iv{i}")
+        for i in range(8):
+            drv.checked_get(f"ik{i}", expect=f"iv{i}")
+        routed = sum(
+            p.metrics.counter_value("proxy_routed")
+            for p in plane.proxies if p is not None
+        )
+        assert routed > 0
+        ep.leave()
+
+    def test_direct_server_pin_bypasses_proxies(self, proxied_cluster):
+        cluster, _plane = proxied_cluster
+        ep = _fresh_ep(cluster, server_id=0)
+        assert not ep.proxy_mode  # byte-compatible fused path
+        ep.leave()
+
+    def test_dedupe_replays_cached_reply(self, proxied_cluster):
+        cluster, plane = proxied_cluster
+        ep = _fresh_ep(cluster)
+        assert ep.proxy_mode
+        before = sum(
+            p.metrics.counter_value("proxy_dedupe_hits")
+            for p in plane.proxies if p is not None
+        )
+        ep.api.send_req(ApiRequest(
+            "req", req_id=1, cmd=Command("put", "ded", "v1"),
+        ))
+        rep1 = ep.recv_reply(timeout=10)
+        assert rep1.kind == "reply"
+        # client retransmit of the SAME (client, req_id): the proxy
+        # replays its cached reply without re-proposing
+        ep.api.send_req(ApiRequest(
+            "req", req_id=1, cmd=Command("put", "ded", "v1"),
+        ))
+        rep2 = ep.recv_reply(timeout=10)
+        assert rep2.kind == "reply" and rep2.req_id == 1
+        after = sum(
+            p.metrics.counter_value("proxy_dedupe_hits")
+            for p in plane.proxies if p is not None
+        )
+        assert after == before + 1
+        ep.leave()
+
+    def test_commit_feed_subscribe_note_probe(self, proxied_cluster):
+        """The read-tier seam raw: subscribe to a replica's commit
+        feed, watch an applied put stream as a note, and probe (refused
+        on MultiPaxos — no leases — but carrying the feed seq)."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+
+        cluster, _plane = proxied_cluster
+        # seed a write through the normal path
+        ep = _fresh_ep(cluster)
+        drv = DriverClosedLoop(ep, timeout=10.0)
+        drv.checked_put("feedk", "feedv0")
+
+        # raw learner connection straight to a follower replica
+        info = ep.ctrl.request(
+            __import__(
+                "summerset_tpu.host.messages", fromlist=["CtrlRequest"]
+            ).CtrlRequest("query_info")
+        )
+        leader = info.leader if info.leader is not None else 0
+        sid = next(s for s in sorted(info.servers) if s != leader)
+        addr = tuple(info.servers[sid][0])
+        sock = socket.create_connection(addr, timeout=5)
+        sock.settimeout(10)
+        safetcp.send_msg_sync(sock, 999_999 + LEARNER_ID_OFFSET)
+        safetcp.send_msg_sync(sock, ApiRequest("sub", req_id=3))
+        sub = safetcp.recv_msg_sync(sock)
+        assert sub.kind == "sub" and sub.success
+        seq0 = sub.seq
+        learned = dict(sub.notes or {})
+        # the ack rides the LEADER's apply; this follower may apply the
+        # put a tick later — in which case it arrives as a note > seq0
+        # (the exact snapshot-plus-stream contract the read tier uses)
+        if learned.get("feedk") != "feedv0":
+            deadline = time.monotonic() + 20
+            while learned.get("feedk") != "feedv0":
+                assert time.monotonic() < deadline, \
+                    "snapshot catch-up note never arrived"
+                rep = safetcp.recv_msg_sync(sock)
+                if rep.kind == "note":
+                    for _s, k, v in rep.notes:
+                        learned[k] = v
+
+        # a new applied put must stream as a note, after durability
+        drv.checked_put("feedk", "feedv1")
+        deadline = time.monotonic() + 20
+        seen = None
+        while time.monotonic() < deadline:
+            rep = safetcp.recv_msg_sync(sock)
+            if rep.kind == "note":
+                for s, k, v in rep.notes:
+                    if k == "feedk" and v == "feedv1":
+                        seen = (s, rep.seq)
+                if seen:
+                    break
+        assert seen is not None, "commit note never arrived"
+        assert seen[0] > seq0 and seen[1] >= seen[0]
+
+        # probes refuse without leases but answer with the current seq
+        safetcp.send_msg_sync(sock, ApiRequest(
+            "probe", req_id=4, cmd=Command("get", "feedk"),
+        ))
+        probe = None
+        while probe is None:
+            rep = safetcp.recv_msg_sync(sock)
+            if rep.kind == "probe":
+                probe = rep
+        assert not probe.success         # MultiPaxos: no lease plane
+        assert probe.seq >= seen[0]
+        sock.close()
+        ep.leave()
+
+    def test_proxy_crash_rediscovery_and_restart(self, proxied_cluster):
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.host.messages import CtrlRequest
+
+        cluster, plane = proxied_cluster
+        ep = _fresh_ep(cluster)
+        assert ep.proxy_mode
+        drv = DriverClosedLoop(ep, timeout=10.0)
+        drv.checked_put("ck", "cv")
+        victim = plane.ports.index(ep.api.sock.getpeername()[1])
+        plane.crash_proxy(victim)
+        # the dead proxy deregisters with its ctrl connection; the
+        # client's rotate/backoff machinery rides to the survivor
+        drv.checked_put("ck", "cv2")
+        drv.checked_get("ck", expect="cv2")
+        info = ep.ctrl.request(CtrlRequest("query_info"))
+        assert len(info.proxies or {}) == 1
+        plane.restart_proxy(victim)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            info = ep.ctrl.request(CtrlRequest("query_info"))
+            if len(info.proxies or {}) == 2:
+                break
+            time.sleep(0.2)
+        assert len(info.proxies or {}) == 2
+        ep.leave()
+
+
+@pytest.mark.slow
+class TestLiveReadTierQuorumLeases:
+    def test_lease_local_learner_reads(self, tmp_path):
+        """QuorumLeases: the learner read tier serves gets from its
+        learned state (probe-gated) and stays fresh across writes."""
+        from test_cluster import Cluster
+
+        from summerset_tpu.client.drivers import DriverClosedLoop
+
+        c = Cluster("QuorumLeases", 3, tmp_path)
+        plane = ServingPlane(c.manager_addr, proxies=1).start()
+        try:
+            ep = _fresh_ep(c)
+            drv = DriverClosedLoop(ep, timeout=10.0)
+            # grant read leases everywhere: lease-LOCAL reads need an
+            # installed responders conf (the learner's probes refuse,
+            # harmlessly, until the grant lands)
+            drv.conf_change({"responders": [0, 1, 2]})
+            time.sleep(2.0)  # learner subscribe + lease grants settle
+            for i in range(3):
+                drv.checked_put(f"qk{i}", f"qv{i}")
+            time.sleep(1.5)
+            for _ in range(3):
+                for i in range(3):
+                    drv.checked_get(f"qk{i}", expect=f"qv{i}")
+            served = plane.proxies[0].metrics.counter_value(
+                "read_tier_served"
+            )
+            assert served > 0, "no learner-local reads served"
+            # freshness: write-then-read interleave must never serve
+            # a stale learned value
+            for i in range(6):
+                drv.checked_put("qhot", f"qh{i}")
+                drv.checked_get("qhot", expect=f"qh{i}")
+            ep.leave()
+        finally:
+            plane.stop()
+            c.stop()

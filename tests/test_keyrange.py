@@ -1,0 +1,167 @@
+"""utils/keyrange coverage: KeyRangeMap sliver semantics, unbounded
+ends, bisect lookup edges, and RespondersConf — routing-critical now
+that the ingress-proxy tier (host/ingress.py) resolves every op's owner
+shard through a KeyRangeMap."""
+
+import pytest
+
+from summerset_tpu.utils.bitmap import Bitmap
+from summerset_tpu.utils.errors import SummersetError
+from summerset_tpu.utils.keyrange import KeyRangeMap, RespondersConf
+
+
+class TestKeyRangeMapLookup:
+    def test_empty_map_returns_none(self):
+        m = KeyRangeMap()
+        assert m.get("") is None
+        assert m.get("anything") is None
+        assert len(m) == 0
+
+    def test_key_below_first_start(self):
+        m = KeyRangeMap()
+        m.insert("m", "t", 1)
+        # bisect lands at -1 for keys sorting before every start
+        assert m.get("a") is None
+        assert m.get("lzzz") is None
+        assert m.get("m") == 1
+
+    def test_half_open_semantics(self):
+        m = KeyRangeMap()
+        m.insert("b", "d", 7)
+        assert m.get("b") == 7          # start inclusive
+        assert m.get("c") == 7
+        assert m.get("czzz") == 7
+        assert m.get("d") is None       # end exclusive
+        assert m.get("dzz") is None
+
+    def test_gap_between_ranges(self):
+        m = KeyRangeMap()
+        m.insert("a", "b", 1)
+        m.insert("x", "y", 2)
+        assert m.get("a") == 1
+        assert m.get("m") is None       # lands in the gap
+        assert m.get("x") == 2
+
+    def test_unbounded_end_range(self):
+        m = KeyRangeMap()
+        m.insert("k", None, 9)
+        assert m.get("k") == 9
+        assert m.get("zzzzzz") == 9     # None = +infinity
+        assert m.get("j") is None
+
+    def test_empty_string_start_covers_everything_below(self):
+        m = KeyRangeMap()
+        m.full_range(5)
+        assert m.get("") == 5
+        assert m.get("\x00") == 5
+        assert m.get("zzz") == 5
+        assert len(m) == 1
+
+
+class TestKeyRangeMapInsertOverlap:
+    def test_invalid_range_refused(self):
+        m = KeyRangeMap()
+        with pytest.raises(SummersetError):
+            m.insert("b", "b", 1)       # empty
+        with pytest.raises(SummersetError):
+            m.insert("c", "a", 1)       # inverted
+
+    def test_overwrite_middle_keeps_both_slivers(self):
+        m = KeyRangeMap()
+        m.insert("a", "z", 1)
+        m.insert("g", "k", 2)
+        assert m.get("a") == 1          # left sliver [a, g)
+        assert m.get("f") == 1
+        assert m.get("g") == 2          # new range [g, k)
+        assert m.get("jzz") == 2
+        assert m.get("k") == 1          # right sliver [k, z)
+        assert m.get("y") == 1
+        assert len(m) == 3
+
+    def test_overwrite_prefix_and_suffix(self):
+        m = KeyRangeMap()
+        m.insert("c", "m", 1)
+        m.insert("a", "e", 2)           # overlaps the left edge
+        assert m.get("b") == 2
+        assert m.get("d") == 2
+        assert m.get("e") == 1          # surviving sliver [e, m)
+        m.insert("j", "q", 3)           # overlaps the right edge
+        assert m.get("i") == 1
+        assert m.get("j") == 3
+        assert m.get("p") == 3
+        assert m.get("q") is None
+
+    def test_insert_swallowing_whole_range(self):
+        m = KeyRangeMap()
+        m.insert("d", "f", 1)
+        m.insert("a", "z", 2)
+        assert m.get("d") == 2
+        assert m.get("e") == 2
+        assert len(m) == 1
+
+    def test_overwrite_into_unbounded_range_keeps_tail(self):
+        m = KeyRangeMap()
+        m.insert("a", None, 1)
+        m.insert("g", "k", 2)
+        assert m.get("a") == 1
+        assert m.get("h") == 2
+        assert m.get("k") == 1          # right sliver [k, None)
+        assert m.get("zzzz") == 1
+
+    def test_unbounded_insert_truncates_everything_above(self):
+        m = KeyRangeMap()
+        m.insert("a", "e", 1)
+        m.insert("p", "t", 2)
+        m.insert("c", None, 3)
+        assert m.get("a") == 1          # left sliver survives
+        assert m.get("c") == 3
+        assert m.get("q") == 3          # old [p, t) swallowed
+        assert m.get("zz") == 3
+
+    def test_full_range_resets(self):
+        m = KeyRangeMap()
+        m.insert("a", "b", 1)
+        m.insert("c", "d", 2)
+        m.full_range(9)
+        assert len(m) == 1
+        assert m.get("a") == 9 and m.get("zz") == 9
+
+    def test_adjacent_ranges_no_overlap_kept_intact(self):
+        m = KeyRangeMap()
+        m.insert("a", "g", 1)
+        m.insert("g", "m", 2)           # exactly adjacent
+        assert m.get("fzz") == 1
+        assert m.get("g") == 2
+        assert len(m) == 2
+
+    def test_items_sorted_by_start(self):
+        m = KeyRangeMap()
+        m.insert("x", "y", 1)
+        m.insert("a", "b", 2)
+        m.insert("m", "n", 3)
+        assert [s for s, _e, _v in m.items()] == ["a", "m", "x"]
+
+
+class TestRespondersConf:
+    def test_leader_and_range_responders(self):
+        rc = RespondersConf(3)
+        rc.set_leader(1)
+        assert rc.is_leader(1) and not rc.is_leader(0)
+        bm = Bitmap.from_ids(3, [0, 2])
+        rc.set_responders(("a", "m"), bm)
+        assert rc.is_responder_by_key("b", 0)
+        assert not rc.is_responder_by_key("b", 1)
+        assert not rc.is_responder_by_key("z", 0)  # outside the range
+
+    def test_full_range_responders(self):
+        rc = RespondersConf(3)
+        rc.set_responders(None, Bitmap.from_ids(3, [2]), leader=0)
+        assert rc.is_responder_by_key("anything", 2)
+        assert rc.leader == 0
+
+    def test_invalid_leader_and_size_mismatch(self):
+        rc = RespondersConf(3)
+        with pytest.raises(SummersetError):
+            rc.set_leader(3)
+        with pytest.raises(SummersetError):
+            rc.set_responders(None, Bitmap.from_ids(4, [0]))
