@@ -35,6 +35,12 @@ set_cpu_devices(8)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
 
+def _wire_codec_on() -> bool:
+    from summerset_tpu.utils import wirecodec
+
+    return wirecodec.default_on()
+
+
 def run_point(cluster, clients, secs, freq, put_ratio, value_size,
               num_keys, plan=None):
     from summerset_tpu.client.bench import ClientBench
@@ -179,6 +185,9 @@ def main():
         # quorum-tally transport stamp (core/quorum.py), next to the
         # mesh block like bench.py
         "tally": args.tally,
+        # wire-plane stamp (utils/wirecodec.py): which frame format the
+        # cluster's hot planes served this curve with
+        "wire_codec": _wire_codec_on(),
         # serving-mesh stamp: which device mesh each replica's [G, R]
         # state was sharded over (None = the single-device legacy path);
         # the canonical block shared with bench.py and PROFILE.json

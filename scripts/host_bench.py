@@ -238,6 +238,152 @@ def window_stats(a: dict, b: dict, dt: float):
     return rate, step
 
 
+#: wire A/B throughput tolerance: the 10k-client shape is think-time
+#: limited (offered rate ~constant), so codec-on tput should match
+#: codec-off to box noise; the gate allows 3% jitter and the committed
+#: run is expected to hold plain >=
+WIRE_AB_TPUT_FRAC = 0.97
+
+
+def _wire_metrics(art: dict) -> dict:
+    """Distill one bench artifact's wire-plane numbers: peer-frame
+    bytes per device tick (transport egress over ticks served) and the
+    mean p2p serialize/deserialize cost per frame, straight off the
+    committed histograms."""
+    tot_bytes = tot_ticks = 0
+    sums = {"enc": [0, 0], "dec": [0, 0]}
+    for _sid, s in (art.get("server_metrics") or {}).items():
+        host = s.get("host", {})
+        for k, v in host.get("counters", {}).items():
+            if k.startswith("transport_bytes_sent"):
+                tot_bytes += v
+        tot_ticks += s.get("tick", 0)
+        for k, h in host.get("histograms", {}).items():
+            if "plane=p2p" not in k:
+                continue
+            if k.startswith("wire_encode_us"):
+                sums["enc"][0] += h["sum"]
+                sums["enc"][1] += h["count"]
+            elif k.startswith("wire_decode_us"):
+                sums["dec"][0] += h["sum"]
+                sums["dec"][1] += h["count"]
+    return {
+        "wire_codec": art.get("wire_codec"),
+        "ok": art.get("ok"),
+        "tput": art.get("tput"),
+        "lat_p50_ms": art.get("lat_p50_ms"),
+        "lat_p99_ms": art.get("lat_p99_ms"),
+        "acked": art.get("acked"),
+        "clients_concurrent_min": art.get("clients_concurrent_min"),
+        "peer_bytes_per_tick": round(tot_bytes / max(tot_ticks, 1), 1),
+        "encode_us_mean": round(
+            sums["enc"][0] / max(sums["enc"][1], 1), 2
+        ),
+        "decode_us_mean": round(
+            sums["dec"][0] / max(sums["dec"][1], 1), 2
+        ),
+        "frames_timed": sums["enc"][1],
+    }
+
+
+def check_wire_ab(block: dict) -> list:
+    """The codec A/B inequalities (shared with workload_gate.py):
+    peer-frame bytes/tick and p2p encode+decode us/op STRICTLY down
+    codec-on vs codec-off, steady tput held, both runs ok."""
+    on, off = block.get("on") or {}, block.get("off") or {}
+    fails = []
+    if on.get("wire_codec") is not True or off.get("wire_codec") \
+            is not False:
+        fails.append("wire_ab: runs not labeled codec on/off")
+    for side, sub in (("on", on), ("off", off)):
+        if not sub.get("ok"):
+            fails.append(f"wire_ab: codec-{side} bench not ok")
+    for key in ("peer_bytes_per_tick", "encode_us_mean",
+                "decode_us_mean"):
+        a, b = on.get(key), off.get(key)
+        if a is None or b is None or not a < b:
+            fails.append(
+                f"wire_ab: {key} not strictly down ({a} vs {b})"
+            )
+    t_on, t_off = on.get("tput") or 0.0, off.get("tput") or 0.0
+    if t_on < WIRE_AB_TPUT_FRAC * t_off:
+        fails.append(
+            f"wire_ab: codec-on tput {t_on} below codec-off {t_off}"
+        )
+    return fails
+
+
+def run_wire_ab(args) -> None:
+    """Parent mode: run the full bench twice as subprocesses — codec
+    off then on, flipped through SMR_WIRE_CODEC so the replica, proxy,
+    AND fleet processes all follow — and commit the gated A/B block.
+    The codec-on run's full artifact becomes the new HOSTBENCH body
+    (codec-on is the serving default), with ``wire_ab`` (and any
+    committed ``wire_bench`` block) carried alongside."""
+    child_argv = [
+        sys.executable, os.path.abspath(__file__),
+    ]
+    skip = 0
+    for a in sys.argv[1:]:
+        if skip:
+            skip -= 1
+            continue
+        if a == "--wire-ab":
+            continue
+        if a == "--out":
+            skip = 1
+            continue
+        if a.startswith("--out="):
+            continue
+        child_argv.append(a)
+    runs = {}
+    tmp = tempfile.mkdtemp(prefix="wire_ab_")
+    for mode in ("off", "on"):
+        out = os.path.join(tmp, f"hostbench_{mode}.json")
+        env = dict(os.environ)
+        env["SMR_WIRE_CODEC"] = "1" if mode == "on" else "0"
+        print(f"=== wire_ab: codec {mode} run ===", flush=True)
+        r = subprocess.run(
+            child_argv + ["--out", out], env=env, cwd=REPO,
+        )
+        if not os.path.exists(out):
+            print(f"wire_ab: codec-{mode} run produced no artifact "
+                  f"(rc={r.returncode})", flush=True)
+            sys.exit(1)
+        with open(out) as f:
+            runs[mode] = json.load(f)
+    block = {
+        "clients": runs["on"].get("clients"),
+        "proxies": runs["on"].get("proxies"),
+        "protocol": runs["on"].get("protocol"),
+        "groups": runs["on"].get("groups"),
+        "on": _wire_metrics(runs["on"]),
+        "off": _wire_metrics(runs["off"]),
+    }
+    fails = check_wire_ab(block)
+    block["ok"] = not fails
+    block["failures"] = fails
+    art = dict(runs["on"])
+    prev = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+        except Exception:
+            prev = {}
+    if "wire_bench" in prev:
+        art["wire_bench"] = prev["wire_bench"]
+    art["wire_ab"] = block
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print("wire_ab: " + json.dumps(
+        {k: v for k, v in block.items() if k != "failures"} | {
+            "failures": fails,
+        }
+    ), flush=True)
+    sys.exit(0 if block["ok"] else 1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--protocol", default="MultiPaxos")
@@ -274,8 +420,18 @@ def main() -> None:
     ap.add_argument("--tick-budget", type=float, default=0.9,
                     help="min loaded/baseline device tick-rate ratio "
                          "for the ok verdict when proxies are up")
+    ap.add_argument("--wire-ab", action="store_true",
+                    help="run the whole bench twice — wire codec off "
+                         "then on (SMR_WIRE_CODEC into every child "
+                         "tier) — and commit the gated A/B block "
+                         "(bytes/tick + serialize us/op strictly "
+                         "down, tput held)")
     ap.add_argument("--out", default=os.path.join(REPO, "HOSTBENCH.json"))
     args = ap.parse_args()
+
+    if args.wire_ab:
+        run_wire_ab(args)
+        return
 
     from summerset_tpu.client.endpoint import scrape_metrics
     from summerset_tpu.host.workload import WorkloadPlan
@@ -475,11 +631,14 @@ def main() -> None:
             f"< {args.tick_budget}"
         )
 
+    from summerset_tpu.utils import wirecodec
+
     out = {
         "protocol": args.protocol,
         "groups": args.groups,
         "replicas": args.replicas,
         "clients": args.clients,
+        "wire_codec": wirecodec.default_on(),
         "clients_concurrent_peak": connected,
         "clients_concurrent_min": connected_min,
         "fleet": "mux",             # selector-multiplexed closed loop
@@ -522,6 +681,17 @@ def main() -> None:
         "failures": failures,
         "server_metrics": server_metrics,
     }
+    # preserve sibling blocks other tools commit into this artifact
+    # (wire_bench microbench rows, the wire_ab parent's A/B block)
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+            for k in ("wire_bench", "wire_ab"):
+                if k in prev:
+                    out[k] = prev[k]
+        except Exception:
+            pass
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({

@@ -55,9 +55,47 @@ def main() -> int:
         rows = json.load(f)
 
     failslow_rows = [r for r in rows if r.get("failslow")]
-    rows = [r for r in rows if not r.get("failslow")]
+    wire_ab_rows = [r for r in rows if r.get("kind") == "wire_ab"]
+    rows = [
+        r for r in rows
+        if not r.get("failslow") and r.get("kind") != "wire_ab"
+    ]
 
     failures = []
+
+    # ---- wire-codec A/B row --------------------------------------------
+    # one soak cell run codec-on AND codec-off: the seeded repro
+    # contract must hold across wire formats — byte-identical FaultPlan
+    # digests (and identical to what the current generator produces),
+    # both runs linearizable with bounded recovery
+    if not wire_ab_rows:
+        failures.append("wire_ab row missing (run "
+                        "scripts/nemesis_soak.py --wire-ab)")
+    for row in wire_ab_rows:
+        tag = f"wire_ab {row.get('protocol')} seed={row.get('seed')}"
+        if not row.get("ok"):
+            failures.append(f"{tag}: failed ({row.get('error')})")
+        if not row.get("digests_identical"):
+            failures.append(f"{tag}: plan digests diverged across "
+                            "codec modes")
+        want = FaultPlan.generate(
+            row.get("seed"), DEFAULT_REPLICAS, DEFAULT_TICKS,
+            classes=SOAK_CLASSES,
+        ).digest()
+        if row.get("digest") != want:
+            failures.append(
+                f"{tag}: digest drift — committed {row.get('digest')} "
+                f"vs regenerated {want}"
+            )
+        for mode in ("codec_on", "codec_off"):
+            sub = row.get(mode) or {}
+            if not sub.get("ok"):
+                failures.append(
+                    f"{tag}: {mode} run failed ({sub.get('error')})"
+                )
+            if bool(sub.get("wire_codec")) != (mode == "codec_on"):
+                failures.append(f"{tag}: {mode} ran with wire_codec="
+                                f"{sub.get('wire_codec')}")
     by_seed = {
         s: FaultPlan.generate(
             s, DEFAULT_REPLICAS, DEFAULT_TICKS, classes=SOAK_CLASSES

@@ -146,9 +146,12 @@ def run_one(protocol: str, seed: int, args) -> dict:
     print(f"--- {protocol} seed={seed} digest={plan.digest()}")
     print(plan.timeline(), end="")
 
+    from summerset_tpu.utils import wirecodec
+
     tmp = tempfile.mkdtemp(prefix=f"nemsoak_{protocol.lower()}_{seed}_")
     result = {
         "protocol": protocol, "seed": seed, "digest": plan.digest(),
+        "wire_codec": wirecodec.default_on(),
         "ok": False,
     }
     cluster = None
@@ -541,10 +544,60 @@ def run_failslow_pairs(pairs, args) -> list:
     return rows
 
 
-def merge_rows(path: str, new_rows: list, replace_failslow: bool) -> list:
+def run_wire_ab(args) -> dict:
+    """The wire-codec A/B cell: ONE soak cell (protocol, seed) run
+    twice — codec-on and codec-off — flipped through the process-wide
+    wirecodec default so every in-process tier (replicas, clients,
+    runner stubs) follows.  The committed row asserts the repro
+    contract holds across wire formats: byte-identical FaultPlan
+    digests (the schedule is a pure function of the seed — the wire
+    format must not leak into it) and both runs linearizable with
+    bounded recovery."""
+    from summerset_tpu.utils import wirecodec
+
+    sub = {}
+    for mode in (True, False):
+        prev = wirecodec.set_default(mode)
+        try:
+            r = run_one(args.protocol, args.seed, args)
+        finally:
+            wirecodec.set_default(prev)
+        r["wire_codec"] = mode
+        tag = "codec_on" if mode else "codec_off"
+        status = "PASS" if r["ok"] else f"FAIL ({r.get('error')})"
+        print(f"=== wire_ab {args.protocol} seed={args.seed} "
+              f"{tag}: {status} (ops={r.get('num_ops')}, "
+              f"recovery={r.get('recovery_ticks')} ticks)")
+        sub[tag] = r
+    same = sub["codec_on"]["digest"] == sub["codec_off"]["digest"]
+    row = {
+        "kind": "wire_ab",
+        "protocol": args.protocol,
+        "seed": args.seed,
+        "digest": sub["codec_on"]["digest"],
+        "digests_identical": same,
+        "ok": bool(
+            same and sub["codec_on"]["ok"] and sub["codec_off"]["ok"]
+        ),
+        "codec_on": sub["codec_on"],
+        "codec_off": sub["codec_off"],
+    }
+    if not same:
+        row["error"] = "plan digests diverged across codec modes"
+    return row
+
+
+def _row_half(r: dict) -> str:
+    """Which independently-regenerated artifact half a row belongs to."""
+    if r.get("kind") == "wire_ab":
+        return "wire_ab"
+    return "failslow" if r.get("failslow") else "matrix"
+
+
+def merge_rows(path: str, new_rows: list, replace: str) -> list:
     """Merge into an existing artifact: ``--failslow*`` runs replace the
-    fail-slow rows and keep the committed 12-cell matrix; ``--matrix``
-    does the reverse — so the two halves regenerate independently."""
+    fail-slow rows, ``--matrix`` the 12-cell matrix, ``--wire-ab`` the
+    codec A/B row — each half regenerates independently."""
     old: list = []
     if os.path.exists(path):
         try:
@@ -552,10 +605,7 @@ def merge_rows(path: str, new_rows: list, replace_failslow: bool) -> list:
                 old = json.load(f)
         except Exception:
             old = []
-    kept = [
-        r for r in old
-        if bool(r.get("failslow")) != replace_failslow
-    ]
+    kept = [r for r in old if _row_half(r) != replace]
     return kept + new_rows
 
 
@@ -592,10 +642,19 @@ def main():
                          f"{FAILSLOW_CLASSES} x {FAILSLOW_PROTOCOLS}, "
                          "each as a mitigated/unmitigated twin pair; "
                          "rows merge into --out beside the fault matrix")
+    ap.add_argument("--wire-ab", action="store_true",
+                    help="run ONE (protocol, seed) soak cell twice — "
+                         "wire codec on and off — and commit the "
+                         "equivalence row (byte-identical plan digests, "
+                         "both runs linearizable) beside the matrix")
     ap.add_argument("--out", default=os.path.join(REPO, "NEMESIS.json"))
     args = ap.parse_args()
 
-    if args.failslow or args.failslow_matrix:
+    if args.wire_ab:
+        row = run_wire_ab(args)
+        results = [row]
+        merged = merge_rows(args.out, results, replace="wire_ab")
+    elif args.failslow or args.failslow_matrix:
         pairs = (
             [(p, c) for c in FAILSLOW_CLASSES for p in FAILSLOW_PROTOCOLS]
             if args.failslow_matrix
@@ -605,7 +664,7 @@ def main():
             if c not in FAILSLOW_CLASSES:
                 ap.error(f"unknown fail-slow class {c!r}")
         results = run_failslow_pairs(pairs, args)
-        merged = merge_rows(args.out, results, replace_failslow=True)
+        merged = merge_rows(args.out, results, replace="failslow")
     else:
         runs = (
             [(p, s)
@@ -621,7 +680,7 @@ def main():
                   f"(ops={r.get('num_ops')}, "
                   f"recovery={r.get('recovery_ticks')} ticks)")
             results.append(r)
-        merged = merge_rows(args.out, results, replace_failslow=False)
+        merged = merge_rows(args.out, results, replace="matrix")
     with open(args.out, "w") as f:
         json.dump(merged, f, indent=1)
     print(f"wrote {args.out}")

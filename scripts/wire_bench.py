@@ -1,0 +1,227 @@
+#!/usr/bin/env python3
+"""Wire-codec microbench: encode/decode throughput + bytes per frame,
+wirecodec vs pickle, over the repo's hot frame shapes.
+
+Measures, per shape (tick frames at several [G, R] geometries with and
+without payload piggybacks, hot api messages, proxy forward batches):
+
+- ``bytes``     — one encoded frame's body size, both formats;
+- ``enc_us`` / ``dec_us`` — best-of-rounds mean per-op wall time;
+- ``enc_mbps`` / ``dec_mbps`` — the same as body-throughput (each
+  format over ITS OWN body size — the codec moves fewer bytes AND
+  less time, so MB/s alone under-sells it).
+
+``--commit`` merges the result as the ``wire_bench`` block into
+HOSTBENCH.json (everything else in the artifact is preserved), with an
+``ok`` verdict asserting the codec's headline inequalities on the p2p
+shapes: bytes strictly down AND enc+dec time strictly down on every
+tick-frame shape.  ``scripts/workload_gate.py`` re-checks the committed
+block (the drift gate for this plane).
+
+Usage:
+    python scripts/wire_bench.py [--rounds 5] [--iters 2000] [--commit]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from summerset_tpu.host.messages import ApiReply, ApiRequest  # noqa: E402
+from summerset_tpu.host.statemach import Command, CommandResult  # noqa: E402
+from summerset_tpu.utils import wirecodec  # noqa: E402
+
+
+def tick_frame(g: int, r: int, pp_ops: int, seed: int = 7):
+    """A representative transport tick frame: the kernel outbox lane
+    dict (shapes/dtypes as MultiPaxos serves them) + the host payload
+    keys that ride alongside."""
+    rng = np.random.default_rng(seed)
+    msg = {}
+    for name in ("prep_bal", "prep_vbal", "acc_bal", "acc_val",
+                 "commit_bar", "hb_bal"):
+        msg[name] = rng.integers(0, 1 << 20, (g,)).astype(np.int32)
+    for name in ("ar_bal", "ar_f", "ar_hint"):
+        msg[name] = rng.integers(0, 1 << 20, (g, r)).astype(np.int32)
+    msg["flags"] = rng.integers(0, 1 << 30, (g, r)).astype(np.uint32)
+    pp = {}
+    for i in range(pp_ops):
+        pp[(i % g, 100 + i)] = [(5 + i, ApiRequest(
+            "req", req_id=i,
+            cmd=Command("put", f"key{i}", "v" * 64),
+        ))]
+    payload = {
+        "msg": msg,
+        "pp": pp,
+        "kv_need": False,
+        "need": [],
+        "ts": 123.456,
+        "hb": {"f": 12.5, "w": 3.25, "q": 0.5,
+               "o": {p: 1.5 for p in range(r) if p != 0}},
+    }
+    return (4242, payload)
+
+
+def shapes():
+    return {
+        # p2p plane (the gated rows): bench-fallback shape, the
+        # serving-default shape, and the pod-scale shape
+        "tick_g16_r3": ("p2p", tick_frame(16, 3, 2)),
+        "tick_g64_r3": ("p2p", tick_frame(64, 3, 4)),
+        "tick_g1024_r3": ("p2p", tick_frame(1024, 3, 4)),
+        "tick_g16_r3_idle": ("p2p", tick_frame(16, 3, 0)),
+        # api plane (reported): the steady-state client exchange
+        "api_put_req": ("api", ApiRequest(
+            "req", req_id=77, cmd=Command("put", "mykey123", "x" * 64),
+        )),
+        "api_get_req": ("api", ApiRequest(
+            "req", req_id=78, cmd=Command("get", "mykey123"),
+        )),
+        "api_put_reply": ("api", ApiReply(
+            "reply", req_id=77,
+            result=CommandResult("put", old_value="y" * 64),
+        )),
+        "api_shed": ("api", ApiReply(
+            "shed", req_id=3, success=False, retry_after_ms=120,
+        )),
+        # distinct per-op values, as real client fleets generate them —
+        # identical repeated strings would hand pickle a memoization
+        # advantage no live workload provides
+        "proxy_batch16": ("api", ApiRequest(
+            "batch", req_id=1, batch=[
+                (i, Command("put", f"key{i}", f"v{i:03d}" * 16))
+                for i in range(16)
+            ],
+        )),
+        "feed_note8": ("api", ApiReply(
+            "note", req_id=0, seq=42,
+            notes=[(40 + i, f"k{i}", f"n{i:03d}" * 16)
+                   for i in range(8)],
+        )),
+    }
+
+
+def bench_fn(fn, iters: int, rounds: int) -> float:
+    """Best-of-rounds mean microseconds per call."""
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e6
+
+
+def run(iters: int, rounds: int) -> dict:
+    enc = wirecodec.FrameEncoder()
+    out = {}
+    for name, (plane, obj) in shapes().items():
+        cbody = enc.encode_bytes(obj)
+        pbody = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        assert cbody[0] == wirecodec.MAGIC, f"{name} not codec-encoded"
+
+        def enc_codec():
+            enc.encode_frame_into(obj)
+            enc.release()
+
+        row = {
+            "plane": plane,
+            "codec_bytes": len(cbody),
+            "pickle_bytes": len(pbody),
+            "codec_enc_us": round(bench_fn(enc_codec, iters, rounds), 2),
+            "pickle_enc_us": round(bench_fn(
+                lambda: pickle.dumps(obj, pickle.HIGHEST_PROTOCOL),
+                iters, rounds,
+            ), 2),
+            "codec_dec_us": round(bench_fn(
+                lambda: wirecodec.decode_body(cbody), iters, rounds,
+            ), 2),
+            "pickle_dec_us": round(bench_fn(
+                lambda: pickle.loads(pbody), iters, rounds,
+            ), 2),
+        }
+        for fmt in ("codec", "pickle"):
+            nb = row[f"{fmt}_bytes"]
+            row[f"{fmt}_enc_mbps"] = round(
+                nb / row[f"{fmt}_enc_us"], 1
+            )
+            row[f"{fmt}_dec_mbps"] = round(
+                nb / row[f"{fmt}_dec_us"], 1
+            )
+        out[name] = row
+    return out
+
+
+def verdict(rows: dict) -> tuple:
+    """The committed inequalities: every shape's bytes strictly down;
+    on the p2p (tick frame) shapes, enc AND dec time strictly down."""
+    failures = []
+    for name, r in rows.items():
+        if r["codec_bytes"] >= r["pickle_bytes"]:
+            failures.append(
+                f"{name}: codec bytes {r['codec_bytes']} >= pickle "
+                f"{r['pickle_bytes']}"
+            )
+        if r["plane"] != "p2p":
+            continue
+        if r["codec_enc_us"] >= r["pickle_enc_us"]:
+            failures.append(
+                f"{name}: codec encode {r['codec_enc_us']}us >= pickle "
+                f"{r['pickle_enc_us']}us"
+            )
+        if r["codec_dec_us"] >= r["pickle_dec_us"]:
+            failures.append(
+                f"{name}: codec decode {r['codec_dec_us']}us >= pickle "
+                f"{r['pickle_dec_us']}us"
+            )
+    return (not failures), failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=2000)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--commit", action="store_true",
+                    help="merge the block into HOSTBENCH.json")
+    ap.add_argument("--out", default=os.path.join(REPO, "HOSTBENCH.json"))
+    args = ap.parse_args()
+
+    rows = run(args.iters, args.rounds)
+    ok, failures = verdict(rows)
+    block = {
+        "iters": args.iters,
+        "rounds": args.rounds,
+        "rows": rows,
+        "ok": ok,
+        "failures": failures,
+    }
+    for name, r in rows.items():
+        print(f"{name:18s} bytes {r['codec_bytes']:>7}/{r['pickle_bytes']:<7}"
+              f" enc {r['codec_enc_us']:>7.2f}/{r['pickle_enc_us']:<7.2f}us"
+              f" dec {r['codec_dec_us']:>7.2f}/{r['pickle_dec_us']:<7.2f}us"
+              f"  (codec/pickle)")
+    print(f"verdict: {'ok' if ok else failures}")
+
+    if args.commit:
+        art = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                art = json.load(f)
+        art["wire_bench"] = block
+        with open(args.out, "w") as f:
+            json.dump(art, f, indent=1)
+        print(f"wire_bench block committed into {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,9 +16,15 @@ Asserts, WITHOUT bringing up clusters (pure plan regeneration):
    was made: acked > 0, sheds < issued, and no value was ever both
    acked and shed);
 5. overload rows stayed within the committed latency/recovery budgets
-   (accepted-op p99 through the burst, post-burst throughput tail).
+   (accepted-op p99 through the burst, post-burst throughput tail);
+6. the wire-codec planes hold their inequalities in HOSTBENCH.json:
+   the ``wire_ab`` block (10k-client bench codec on/off: peer-frame
+   bytes/tick + p2p serialize us/op strictly down, tput held — see
+   ``host_bench.check_wire_ab``) and the ``wire_bench`` microbench
+   block (bytes down on every shape, time down on the tick shapes).
 
 Usage:  python scripts/workload_gate.py [--json WORKLOADS.json]
+                                        [--hostbench HOSTBENCH.json]
 """
 
 from __future__ import annotations
@@ -95,15 +101,58 @@ def check_proxy_ab(row) -> list:
     return fails
 
 
+def check_hostbench_wire(path: str) -> list:
+    """The committed wire-codec proof rows in HOSTBENCH.json: the
+    10k-client A/B block and the microbench block must both be present
+    and hold their inequalities (re-asserted on the committed numbers,
+    like every other drift gate here)."""
+    from host_bench import check_wire_ab
+
+    fails = []
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except OSError:
+        return [f"hostbench: {path} missing"]
+    ab = art.get("wire_ab")
+    if not ab:
+        fails.append("hostbench: wire_ab block missing (run "
+                     "scripts/host_bench.py --wire-ab)")
+    else:
+        fails.extend(check_wire_ab(ab))
+        if not ab.get("ok"):
+            fails.append("hostbench: wire_ab committed not ok")
+    wb = art.get("wire_bench")
+    if not wb:
+        fails.append("hostbench: wire_bench block missing (run "
+                     "scripts/wire_bench.py --commit)")
+    else:
+        from wire_bench import verdict as wb_verdict
+
+        rows = wb.get("rows") or {}
+        ok, wfails = wb_verdict(rows)
+        fails.extend(f"hostbench: {w}" for w in wfails)
+        if not rows:
+            fails.append("hostbench: wire_bench block has no rows")
+        elif not wb.get("ok"):
+            # a recorded-failing block must fail the gate even when the
+            # committed rows themselves re-verify (verdict drift)
+            fails.append("hostbench: wire_bench committed not ok")
+    return fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--json",
                     default=os.path.join(REPO, "WORKLOADS.json"))
+    ap.add_argument("--hostbench",
+                    default=os.path.join(REPO, "HOSTBENCH.json"))
     args = ap.parse_args()
     with open(args.json) as f:
         rows = json.load(f)
 
     failures = []
+    failures.extend(check_hostbench_wire(args.hostbench))
     want = {(p, c, s): fs for p, c, s, fs in WL_MATRIX}
     seen = set()
     ab_rows = [r for r in rows if r.get("kind") == "proxy_ab"]

@@ -95,7 +95,7 @@ WALLCLOCK_READS = (
 BLOCKING_NAMES = frozenset({
     "fsync", "fdatasync", "sleep", "accept", "connect", "recv",
     "recvfrom", "recv_into", "sendall", "send_msg_sync", "recv_msg_sync",
-    "recv_exact",
+    "recv_exact", "sendmsg", "sendmsg_all",
 })
 # blocking only without a timeout= kwarg (queue.get, thread.join)
 TIMEOUT_GATED_NAMES = frozenset({"get", "join"})

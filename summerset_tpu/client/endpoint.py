@@ -121,8 +121,15 @@ class ClientCtrlStub:
 
 
 class ClientApiStub:
+    """Data-plane stub.  ``codec=None`` follows the process-wide wire
+    codec default (utils/wirecodec.py): hot requests leave in the
+    compact binary form; the reply side dispatches per frame, so the
+    stub talks to codec-on and codec-off servers alike."""
+
     def __init__(self, client_id: int, api_addr: Tuple[str, int],
-                 connect_timeout: float = 15.0):
+                 connect_timeout: float = 15.0,
+                 codec: Optional[bool] = None):
+        self.codec = codec
         self.sock = socket.create_connection(
             tuple(api_addr), timeout=max(connect_timeout, 0.05)
         )
@@ -130,7 +137,7 @@ class ClientApiStub:
         safetcp.send_msg_sync(self.sock, client_id)
 
     def send_req(self, req: ApiRequest) -> None:
-        safetcp.send_msg_sync(self.sock, req)
+        safetcp.send_msg_sync(self.sock, req, codec=self.codec)
 
     def recv_reply(self, timeout: Optional[float] = None) -> ApiReply:
         self.sock.settimeout(timeout)
@@ -162,11 +169,14 @@ class GenericEndpoint:
 
     def __init__(self, manager_addr: Tuple[str, int],
                  server_id: Optional[int] = None,
-                 via_proxy="auto"):
+                 via_proxy="auto", wire_codec: Optional[bool] = None):
         self.ctrl = ClientCtrlStub(manager_addr)
         self.id = self.ctrl.id
         self.prefer = server_id
         self.via_proxy = via_proxy
+        # wire codec pin for the data-plane stub (None = process
+        # default); the ctrl stub stays pickle — ctrl kinds are cold
+        self.wire_codec = wire_codec
         self.api: Optional[ClientApiStub] = None
         self.servers = {}
         self.proxies = {}
@@ -233,6 +243,7 @@ class GenericEndpoint:
         self.api = ClientApiStub(
             self.id, api_addr,
             connect_timeout=15.0 if timeout is None else timeout,
+            codec=self.wire_codec,
         )
         self.current = sid
 

@@ -9,8 +9,10 @@ clients — the same event-loop shape the serving side's asyncio servants
 already use, so client count stops being a thread count.
 
 Each virtual client is a tiny nonblocking state machine speaking the
-standard safetcp frame format (8-byte BE length + pickled
-``ApiRequest``/``ApiReply``):
+standard safetcp frame format (8-byte BE length + body, where the body
+is the compact wirecodec form for hot ``ApiRequest``/``ApiReply``
+kinds and pickle otherwise — replies dispatch per frame on the body's
+tag byte, so the fleet follows whatever the serving tier emits):
 
     connect -> send id frame -> { send one op, await its reply } loop
 
@@ -34,7 +36,6 @@ never pays for client-side pickling).
 
 from __future__ import annotations
 
-import pickle
 import random
 import selectors
 import socket
@@ -45,6 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..host.messages import ApiReply, ApiRequest
 from ..host.statemach import Command
+from ..utils import safetcp, wirecodec
 
 _LEN = struct.Struct(">Q")
 
@@ -70,9 +72,9 @@ def raise_nofile(want: int) -> int:
         return 1 << 20  # unknown platform: assume plenty
 
 
-def _frame(obj: Any) -> bytes:
-    body = pickle.dumps(obj)
-    return _LEN.pack(len(body)) + body
+def _frame(obj: Any, codec: bool = False) -> bytes:
+    # the one codec-or-pickle framing decision lives in safetcp
+    return safetcp.encode_frame(obj, codec=codec)
 
 
 class _VClient:
@@ -122,7 +124,12 @@ class MuxWorker:
         op_timeout: float = 5.0,
         connect_timeout: float = 10.0,
         think: float = 0.0,
+        codec: Optional[bool] = None,
     ):
+        # wire codec for outgoing hot requests (None = process default)
+        self.codec = (
+            wirecodec.default_on() if codec is None else bool(codec)
+        )
         self.addrs = [tuple(a) for a in addrs]
         self.clients = clients
         self.secs = float(secs)
@@ -211,7 +218,9 @@ class MuxWorker:
     def _issue(self, c: _VClient, now: float) -> None:
         cmd = self._next_cmd(c)
         c.rid += 1
-        c.out += _frame(ApiRequest("req", req_id=c.rid, cmd=cmd))
+        c.out += _frame(
+            ApiRequest("req", req_id=c.rid, cmd=cmd), codec=self.codec
+        )
         c.issued += 1
         c.t_sent = now
         c.deadline = now + self.op_timeout
@@ -277,7 +286,7 @@ class MuxWorker:
             body = bytes(c.buf[8:8 + n])
             del c.buf[:8 + n]
             try:
-                rep = pickle.loads(body)
+                rep = wirecodec.decode_body(body)
             except Exception:
                 continue
             if isinstance(rep, ApiReply):
@@ -428,6 +437,7 @@ def run_fleet(
     id_base: int = FLEET_ID_BASE,
     plan=None,
     think: float = 0.0,
+    codec: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Run ``clients`` multiplexed closed-loop clients against ``addrs``
     for ``secs`` on THIS thread (callers wanting parallel pickling run
@@ -447,5 +457,6 @@ def run_fleet(
         addrs, vcs, secs,
         put_ratio=put_ratio, value_size=value_size,
         num_keys=num_keys, op_timeout=op_timeout, think=think,
+        codec=codec,
     )
     return worker.run()

@@ -32,7 +32,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..utils import safetcp
+from ..utils import safetcp, wirecodec
 from ..utils.logging import pf_debug, pf_info, pf_logger
 from .messages import ApiReply, ApiRequest
 
@@ -72,10 +72,19 @@ class ExternalApi:
         registry=None,
         flight=None,
         metric_ns: str = "api",
+        codec: Optional[bool] = None,
     ):
         self.api_addr = api_addr
         self.batch_interval = batch_interval
         self.max_batch_size = max_batch_size
+        # wire codec (utils/wirecodec.py): hot replies (reply/shed/note/
+        # probe) leave in the compact binary form; cold kinds and the
+        # whole ingress side dispatch per frame, so clients of either
+        # persuasion interoperate.  None = process default.
+        self.codec = (
+            wirecodec.default_on() if codec is None else bool(codec)
+        )
+        self._enc = wirecodec.FrameEncoder()  # event-loop-thread owned
         # ingress bound: data-plane requests beyond this queue depth are
         # shed with a retry-after hint instead of buffered unboundedly
         self.max_pending = max(1, int(max_pending))
@@ -213,6 +222,23 @@ class ExternalApi:
         self._thread.join(timeout=5)
 
     # -- event loop side -----------------------------------------------------
+    async def _wire_send(self, writer, reply: ApiReply) -> None:
+        """The one egress seam: codec-aware encode (hot kinds only)
+        through this instance's own encoder (every caller is a
+        coroutine on the one event loop — no lock needed, unlike the
+        shared module encoder), with the per-tier ``wire_encode_us``
+        stamp."""
+        t0 = time.monotonic()
+        buf = safetcp.encode_frame_bytes(reply, self._enc,
+                                         codec=self.codec)
+        if self.registry is not None:
+            self.registry.observe_s(
+                "wire_encode_us", time.monotonic() - t0,
+                plane=self.metric_ns,
+            )
+        writer.write(buf)
+        await writer.drain()
+
     async def _send(self, client: int, reply: ApiReply) -> None:
         reg = self.registry
         if reg is not None:
@@ -231,7 +257,7 @@ class ExternalApi:
             self._writers.pop(client, None)
             return
         try:
-            await safetcp.send_msg(w, reply)
+            await self._wire_send(w, reply)
         except (ConnectionError, asyncio.IncompleteReadError):
             self._writers.pop(client, None)
 
@@ -244,9 +270,14 @@ class ExternalApi:
             return
         self._writers[int(client)] = writer
         pf_debug(logger, f"accepted client {client}")
+        reg = self.registry
         try:
             while True:
-                req = await safetcp.recv_msg(reader)
+                req, t_dec = await safetcp.recv_msg_timed(reader)
+                if reg is not None:
+                    reg.observe_s(
+                        "wire_decode_us", t_dec, plane=self.metric_ns
+                    )
                 if not isinstance(req, ApiRequest):
                     continue
                 if req.kind == "leave":
@@ -291,7 +322,7 @@ class ExternalApi:
                                 req_id=req.req_id, retry_ms=hint,
                                 depth=depth,
                             )
-                        await safetcp.send_msg(writer, ApiReply(
+                        await self._wire_send(writer, ApiReply(
                             kind="shed", req_id=req.req_id,
                             success=False, retry_after_ms=hint,
                         ))

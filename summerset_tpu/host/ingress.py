@@ -67,7 +67,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..utils import safetcp
+from ..utils import safetcp, wirecodec
 from ..utils.errors import SummersetError
 from ..utils.keyrange import KeyRangeMap
 from ..utils.logging import pf_info, pf_logger, pf_warn
@@ -278,7 +278,8 @@ class LearnerReadTier:
             depth = len(self._probes)
         try:
             safetcp.send_msg_sync(
-                sock, ApiRequest("probe", req_id=prid, cmd=cmd)
+                sock, ApiRequest("probe", req_id=prid, cmd=cmd),
+                codec=self.proxy.codec,
             )
         except Exception:
             self.ready = False
@@ -474,11 +475,19 @@ class IngressProxy:
         retry_redirects: int = 3,
         pend_timeout: float = 15.0,
         flight_capacity: int = 4096,
+        codec: Optional[bool] = None,
     ):
         from ..client.endpoint import ClientCtrlStub
 
         self.manager_addr = tuple(manager_addr)
         self.api_addr = (str(api_addr[0]), int(api_addr[1]))
+        # wire codec for the tier's hot hops: client-facing replies (the
+        # embedded ExternalApi below) AND the upstream forward batches /
+        # read-tier probes.  None = process default; ingress of either
+        # format dispatches per frame (utils/wirecodec.py)
+        self.codec = (
+            wirecodec.default_on() if codec is None else bool(codec)
+        )
         self.forward_batch = max(1, int(forward_batch))
         self.upstream_window = max(1, int(upstream_window))
         self.backlog_limit = int(
@@ -539,7 +548,7 @@ class IngressProxy:
             self.api_addr, batch_interval=self.tick_interval,
             max_batch_size=max_batch, max_pending=max_pending,
             registry=self.metrics, flight=self.flight,
-            metric_ns="proxy",
+            metric_ns="proxy", codec=self.codec,
         )
 
         self.read_tier: Optional[LearnerReadTier] = (
@@ -758,7 +767,7 @@ class IngressProxy:
         try:
             safetcp.send_msg_sync(up.sock, ApiRequest(
                 "batch", req_id=bid, batch=entries,
-            ))
+            ), codec=self.codec)
         except Exception:
             self._kill_upstream(up)
             return False
@@ -1081,6 +1090,12 @@ class ServingPlane:
                 argv += [flag, str(self.cfg[k])]
         if not self.read_tier:
             argv.append("--no-read-tier")
+        env = None
+        if self.cfg.get("codec") is not None:
+            # wire-codec pin rides the env into the child (the same
+            # SMR_WIRE_CODEC default the A/B bench flips process-wide)
+            env = dict(os.environ)
+            env["SMR_WIRE_CODEC"] = "1" if self.cfg["codec"] else "0"
         cpus = self.cpus
 
         def _deprioritize() -> None:
@@ -1096,7 +1111,7 @@ class ServingPlane:
 
         return subprocess.Popen(
             argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            preexec_fn=_deprioritize,
+            preexec_fn=_deprioritize, env=env,
         )
 
     def _wait_registered(self, want: int, timeout: float = 20.0) -> None:

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 
 from ..core import telemetry as dev_telemetry
 from ..protocols import make_protocol
+from ..utils import wirecodec
 from ..utils.errors import SummersetError
 from ..utils.logging import pf_info, pf_logger, pf_warn
 from .codeword import assigned_sids
@@ -184,6 +185,16 @@ class ServerReplica:
         # over a (group, replica) mesh of this host's local devices
         # (core/sharding.py); "" = the single-device legacy compile
         self.device_mesh = str(cfg.pop("device_mesh", "") or "")
+        # wire-plane codec (utils/wirecodec.py): hot frames on the p2p
+        # tick mesh and the api reply path leave in the compact binary
+        # form instead of pickle.  None = process default (env
+        # SMR_WIRE_CODEC); decode always dispatches per frame, so mixed
+        # codec-on/off meshes interoperate (the A/B bench runs exactly
+        # that).  Threaded to TransportHub AND ExternalApi below.
+        _wc = cfg.pop("wire_codec", None)
+        self.wire_codec = (
+            wirecodec.default_on() if _wc is None else bool(_wc)
+        )
         self._bd_last_print = time.monotonic()
         self.near_quorum_reads = bool(cfg.pop("near_quorum_reads", False))
         # telemetry plane: one registry threaded through every hub seam
@@ -506,6 +517,7 @@ class ServerReplica:
             self.transport = TransportHub(
                 self.me, self.population, p2p_addr,
                 registry=self.metrics, flight=self.flight,
+                codec=self.wire_codec,
             )
             self.transport.health = self.health
             join = CtrlMsg("new_server_join", {
@@ -551,6 +563,7 @@ class ServerReplica:
                 api_addr, max_batch_size=self.api_max_batch,
                 max_pending=self.api_max_pending,
                 registry=self.metrics, flight=self.flight,
+                codec=self.wire_codec,
             )
         except BaseException:
             # failed bring-up must release every port/handle it grabbed:
@@ -2414,6 +2427,7 @@ class ServerReplica:
             "me": self.me,
             "protocol": self.protocol,
             "tick": self.tick,
+            "wire_codec": self.wire_codec,
             "applied": list(self.applied),
             "device": dev_telemetry.snapshot_row(
                 self.state[dev_telemetry.TELEM_KEY], self.me

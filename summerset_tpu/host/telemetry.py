@@ -302,6 +302,17 @@ DECLARED = (
     "transport_frames_recv",
     "transport_bytes_recv",
     "transport_connects",
+    # wire-plane codec (utils/wirecodec.py): per-frame serialize /
+    # deserialize cost histograms labeled by plane (p2p = tick mesh,
+    # api/proxy = client planes) — the A/B row's gated us/op source —
+    # plus the SAMPLED bytes-saved counter (every Nth codec frame is
+    # also pickled to measure the delta; pre-registered at zero so
+    # codec-off runs read as 0, not missing).  wire_codec_on is the
+    # mode gauge artifacts stamp.
+    "wire_encode_us",
+    "wire_decode_us",
+    "wire_bytes_saved",
+    "wire_codec_on",
     # gray-failure plane (host/health.py): per-peer frame-delivery
     # latency histograms (the slow_peer signal), the replica's own
     # health verdict gauge (1.0 healthy .. 0.0 indicted), and the

@@ -32,11 +32,14 @@ import struct
 import threading
 from typing import Any, Dict, Optional, Tuple
 
-from ..utils import safetcp
+from ..utils import safetcp, wirecodec
 from ..utils.errors import SummersetError
 from ..utils.logging import pf_info, pf_logger, pf_warn
 
 logger = pf_logger("transport")
+
+#: one in N codec frames is also pickled to sample wire_bytes_saved
+_SAVE_EVERY = 64
 
 
 _TCP_STATES = {
@@ -89,15 +92,31 @@ def hard_close(sock: socket.socket) -> None:
 
 class TransportHub:
     def __init__(self, me: int, population: int, p2p_addr: Tuple[str, int],
-                 registry=None, flight=None):
+                 registry=None, flight=None, codec: Optional[bool] = None):
         self.me = me
         self.population = population
         self.p2p_addr = p2p_addr
+        # wire codec (utils/wirecodec.py): when on, hot tick frames leave
+        # as compact binary segments through one vectored sendmsg — lane
+        # arrays ride zero-copy from the kernel outbox to the socket.
+        # None follows the process default (SMR_WIRE_CODEC); the decode
+        # side ALWAYS dispatches per frame, so a mixed mesh (codec peers
+        # talking to pickle peers) interoperates with no negotiation.
+        self.codec = wirecodec.default_on() if codec is None else bool(codec)
+        self._enc = wirecodec.FrameEncoder()
+        # sampled codec-savings accounting: every _SAVE_EVERY'th encoded
+        # frame is also pickled to measure the byte delta (pickling every
+        # frame would give back the codec's own win); pre-registered so
+        # "codec off / never sampled" reads as a zero series
+        self._save_probe = 0
         # telemetry seam (host/telemetry.MetricsRegistry): per-peer frame
         # and byte counters both directions, plus connect events — a
         # reconnect storm shows up as transport_connects outrunning the
         # population
         self.registry = registry
+        if registry is not None:
+            registry.counter_add("wire_bytes_saved", 0)
+            registry.gauge_set("wire_codec_on", 1 if self.codec else 0)
         # graftscope seam (host/tracing.FlightRecorder): frame_tx /
         # frame_rx events with (peer, seq) where seq is the SENDER's tick
         # number — it already rides the wire in every frame, so tx and rx
@@ -264,9 +283,22 @@ class TransportHub:
     def _messenger_recv(self, peer: int, sock: socket.socket) -> None:
         import time
 
+        rx = safetcp.FrameReceiver()
+        reg = self.registry
         try:
             while True:
-                (tick, payload), nbytes = safetcp.recv_msg_sync_len(sock)
+                body = rx.recv_raw(sock)
+                nbytes = len(body)
+                # decode timed on its own — the blocking recv above
+                # waits out the peer's whole tick interval and would
+                # swamp the histogram by ~1000x
+                t_dec = time.monotonic()
+                tick, payload = wirecodec.decode_body(body)
+                if reg is not None:
+                    reg.observe_s(
+                        "wire_decode_us", time.monotonic() - t_dec,
+                        plane="p2p",
+                    )
                 faults = self._faults
                 if faults is not None and faults.ingress_drop(peer):
                     # count AFTER the drop decision: a frame the fault
@@ -323,10 +355,18 @@ class TransportHub:
 
     # ------------------------------------------------------------ tick I/O
     def send_tick(self, tick: int, per_peer: Dict[int, Any]) -> None:
-        """Send this tick's outbox slice to each connected peer."""
+        """Send this tick's outbox slice to each connected peer.
+
+        Egress is vectored and coalesced per peer: the frame's length
+        prefix, codec chunks, and zero-copy lane-array views — times
+        the dup count, when the fault plane duplicates — leave in ONE
+        ``sendmsg`` syscall, with no join copy of the body (the old
+        path concatenated header + pickle body per peer per tick)."""
         import time
 
         faults = self._faults
+        enc = self._enc
+        reg = self.registry
         for peer, payload in per_peer.items():
             sock = self._conns.get(peer)
             if sock is None:
@@ -338,7 +378,27 @@ class TransportHub:
                     continue  # frame lost: kernels' loss machinery heals
                 if verdict == "dup":
                     copies = 2
-            buf = safetcp.encode_frame((tick, payload))
+            t_enc = time.monotonic()
+            segs, nbytes = safetcp.encode_frame_into(
+                (tick, payload), enc, codec=self.codec
+            )
+            if reg is not None:
+                reg.observe_s(
+                    "wire_encode_us", time.monotonic() - t_enc,
+                    plane="p2p",
+                )
+                if self.codec:
+                    self._save_probe += 1
+                    if self._save_probe >= _SAVE_EVERY:
+                        self._save_probe = 0
+                        base = len(safetcp.encode_frame(
+                            (tick, payload), codec=False
+                        ))
+                        reg.counter_add(
+                            "wire_bytes_saved", max(0, base - nbytes)
+                        )
+            if copies > 1:
+                segs = segs * copies
             if faults is not None:
                 # fail-slow slow_peer: the egress token bucket / CPU
                 # starve duty stalls the SENDER's tick loop — the host is
@@ -347,41 +407,42 @@ class TransportHub:
                 # AFTER the frame was stamped (payload "ts"), so peers'
                 # delivery-delay samples see the injected limp.
                 stall = faults.host_stall(
-                    copies * len(buf), time.monotonic()
+                    copies * nbytes, time.monotonic()
                 )
                 if stall > 0:
                     time.sleep(stall)
             try:
                 # graftlint: disable=H101 -- the per-peer write lock exists to serialize frame writers on one socket; it guards nothing else, so blocking inside it cannot deadlock other state
                 with self._wlocks[peer]:
-                    for _ in range(copies):
-                        sock.sendall(buf)
+                    safetcp.sendmsg_all(sock, segs, copies * nbytes)
                 # bytes_sent (debug_state + adaptive consumers) and the
                 # registry counter must account identically — update both
                 # here or neither
                 self.bytes_sent[peer] = (
-                    self.bytes_sent.get(peer, 0) + copies * len(buf)
+                    self.bytes_sent.get(peer, 0) + copies * nbytes
                 )
-                if self.registry is not None:
-                    self.registry.counter_add(
+                if reg is not None:
+                    reg.counter_add(
                         "transport_frames_sent", copies, peer=peer
                     )
-                    self.registry.counter_add(
-                        "transport_bytes_sent", copies * len(buf),
+                    reg.counter_add(
+                        "transport_bytes_sent", copies * nbytes,
                         peer=peer,
                     )
                 if self.flight is not None:
-                    # recorded after the sendall (outside the write
-                    # lock): an egress-dropped or failed frame was never
-                    # on the wire, so it must not mint a tx event
+                    # recorded after the send (outside the write lock):
+                    # an egress-dropped or failed frame was never on the
+                    # wire, so it must not mint a tx event
                     self.flight.record(
                         "frame_tx", peer=peer, seq=int(tick),
-                        nbytes=copies * len(buf),
+                        nbytes=copies * nbytes,
                     )
             except OSError:
                 if self._conns.get(peer) is sock:
                     self._conns.pop(peer, None)
                 hard_close(sock)
+            finally:
+                enc.release()
 
     def recv_tick(
         self, tick: int, deadline: float

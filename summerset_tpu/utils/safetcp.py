@@ -7,10 +7,26 @@ cancellation-safe partial-read buffers map to asyncio's ``readexactly``;
 its non-blocking would-block write contract maps to ``drain()``.
 
 Serialization: the reference uses bincode over serde structs.  Here messages
-are plain Python objects (dataclasses / tuples / dicts) encoded with pickle —
-acceptable for a trusted research cluster, and symmetric across all three
-planes (client/server data, server p2p, control).  The frame format (8-byte BE
-length + body) is preserved so wire-level tooling carries over.
+are plain Python objects (dataclasses / tuples / dicts); the frame format
+(8-byte BE length + body) is preserved so wire-level tooling carries over.
+The BODY has two formats, dispatched per frame on its first byte
+(``utils/wirecodec.py``): pickle (the universal fallback, and the only
+format for cold/ctrl kinds) and the compact wirecodec binary form for the
+hot data-plane kinds — transport tick frames, ``req``/``reply``/``shed``/
+``batch``/``note``/``probe`` api messages.  Because dispatch is
+per-frame, a codec-on sender and a codec-off sender interoperate on one
+mesh with no negotiation.
+
+Hot-path I/O: egress uses ``socket.sendmsg`` over the encoder's segment
+list (vectored writes — the length prefix, the small-field chunks, and
+zero-copy ndarray views leave in ONE syscall per frame, with no join
+copy); ingress reads the length prefix into a reusable buffer and the
+body into one exact-size buffer via ``recv_into`` (the old
+``buf += chunk`` accumulation re-copied the partial frame on every
+chunk — quadratic in frame size).  Body buffers are per-frame on
+purpose: the codec decodes ndarray lanes as zero-copy views INTO the
+received body, so recycling a body ring would corrupt frames already
+handed to the replica.
 """
 
 from __future__ import annotations
@@ -21,14 +37,18 @@ import pickle
 import random
 import struct
 import threading
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
+from . import wirecodec
 from .errors import SummersetError
 
 _LEN = struct.Struct(">Q")
 
 # Refuse absurd frames (reference caps values at 16MB; give headroom).
 MAX_FRAME = 64 * 1024 * 1024
+
+#: sendmsg scatter-gather cap (IOV_MAX is 1024 on Linux; stay under it)
+_IOV_MAX = 512
 
 
 class FrameFaults:
@@ -161,71 +181,189 @@ class FrameFaults:
         return self._rate(self._delay, peer)
 
 
-def encode_frame(obj: Any) -> bytes:
-    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+def encode_frame(obj: Any, codec: Optional[bool] = None) -> bytes:
+    """One joined frame (8-byte BE length + body).  ``codec=None``
+    follows the process default; the codec only ever engages for hot
+    objects (``wirecodec.is_hot``) — everything else stays pickle."""
+    if codec is None:
+        codec = wirecodec.default_on()
+    if codec and wirecodec.is_hot(obj):
+        body = wirecodec.encode_body(obj)
+    else:
+        body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     return _LEN.pack(len(body)) + body
 
 
-async def send_msg(writer: asyncio.StreamWriter, obj: Any) -> None:
-    writer.write(encode_frame(obj))
+def encode_frame_into(
+    obj: Any, enc: "wirecodec.FrameEncoder", codec: Optional[bool] = None
+) -> Tuple[List[Any], int]:
+    """Encode one frame as a segment list for vectored egress: the
+    8-byte length prefix, the encoder's small-field chunks, and
+    zero-copy ndarray views.  Returns ``(segments, total_bytes)``.  The
+    segments borrow ``enc``'s scratch and (for arrays) the frame's own
+    buffers: send them (:func:`sendmsg_all`), then ``enc.release()``."""
+    if codec is None:
+        codec = wirecodec.default_on()
+    if codec and wirecodec.is_hot(obj):
+        segs, blen = enc.encode_frame_into(obj)
+        return [_LEN.pack(blen)] + segs, 8 + blen
+    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return [_LEN.pack(len(body)), body], 8 + len(body)
+
+
+def sendmsg_all(sock, segs: List[Any], total: int) -> None:
+    """``sendall`` semantics over a segment list via ``socket.sendmsg``
+    (scatter-gather): the whole frame leaves in one syscall in the
+    common case, with no Python-side join copy; partial sends (signals,
+    tiny socket buffers) resume from the exact byte."""
+    sent = sock.sendmsg(segs[:_IOV_MAX])
+    if sent >= total and len(segs) <= _IOV_MAX:
+        return
+    # slow path: advance past what left, retry the remainder
+    idx = 0
+    remaining = total - sent
+    while sent > 0:
+        n = len(segs[idx])
+        if sent >= n:
+            sent -= n
+            idx += 1
+        else:
+            segs = list(segs)
+            segs[idx] = memoryview(segs[idx])[sent:]
+            sent = 0
+    segs = segs[idx:]
+    while remaining > 0:
+        sent = sock.sendmsg(segs[:_IOV_MAX])
+        remaining -= sent
+        if remaining <= 0:
+            return
+        idx = 0
+        while sent > 0:
+            n = len(segs[idx])
+            if sent >= n:
+                sent -= n
+                idx += 1
+            else:
+                segs[idx] = memoryview(segs[idx])[sent:]
+                sent = 0
+        segs = segs[idx:]
+
+
+def encode_frame_bytes(
+    obj: Any, enc: "wirecodec.FrameEncoder",
+    codec: Optional[bool] = None,
+) -> bytes:
+    """One joined frame through a CALLER-OWNED encoder (hot loops that
+    need bytes — asyncio writers — without the shared encoder's lock)."""
+    segs, _total = encode_frame_into(obj, enc, codec=codec)
+    try:
+        return b"".join(
+            s if type(s) is bytes else bytes(s) for s in segs
+        )
+    finally:
+        enc.release()
+
+
+async def send_msg(writer: asyncio.StreamWriter, obj: Any,
+                   codec: Optional[bool] = None) -> None:
+    writer.write(encode_frame(obj, codec=codec))
     await writer.drain()
 
 
 async def recv_msg(reader: asyncio.StreamReader) -> Any:
+    return (await recv_msg_timed(reader))[0]
+
+
+async def recv_msg_timed(reader: asyncio.StreamReader) -> Tuple[Any, float]:
+    """:func:`recv_msg` plus the decode-only wall seconds (the socket
+    wait excluded) — feeds the ``wire_decode_us`` histograms."""
+    import time
+
     hdr = await reader.readexactly(_LEN.size)
     (length,) = _LEN.unpack(hdr)
     if length > MAX_FRAME:
         raise SummersetError(f"frame length {length} exceeds cap {MAX_FRAME}")
     body = await reader.readexactly(length)
-    return pickle.loads(body)
+    t0 = time.monotonic()
+    obj = wirecodec.decode_body(body)
+    return obj, time.monotonic() - t0
 
 
-def send_msg_sync(sock, obj: Any) -> None:
-    """Blocking-socket variant (used by simple CLI tools)."""
-    sock.sendall(encode_frame(obj))
+def send_msg_sync(sock, obj: Any, codec: Optional[bool] = None) -> None:
+    """Blocking-socket variant (CLI tools, ctrl planes, proxy hops)."""
+    sock.sendall(encode_frame(obj, codec=codec))
 
 
 def recv_msg_sync(sock) -> Any:
     return recv_msg_sync_len(sock)[0]
 
 
-def recv_msg_sync_len(sock) -> Tuple[Any, int]:
-    """Like :func:`recv_msg_sync` but also returns the frame body length
-    (consumed by the Crossword adaptive perf model's delivery samples).
+def _recv_exact_into(sock, view: memoryview, consumed_before: int) -> None:
+    """Fill ``view`` with ``recv_into`` (no accumulation copies).
 
     Timeout semantics on timeout-armed sockets: ``socket.timeout``
     propagates ONLY when zero bytes of the frame were consumed — the
     stream is still frame-aligned and the caller may safely retry the
     recv in place.  A timeout after partial consumption raises
     :class:`SummersetError` instead: the next read would start mid-frame
-    and unpickle garbage, so the caller must treat the connection as dead
+    and decode garbage, so the caller must treat the connection as dead
     and reconnect (the ``DriverReply('disconnect')`` path in
     client/drivers.py)."""
-    consumed = 0
+    got = 0
+    n = len(view)
+    while got < n:
+        try:
+            k = sock.recv_into(view[got:])
+        except TimeoutError:
+            if consumed_before or got:
+                raise SummersetError(
+                    f"recv timed out mid-frame ({consumed_before + got} "
+                    "bytes consumed): stream no longer frame-aligned"
+                ) from None
+            raise
+        if not k:
+            raise SummersetError("connection closed mid-frame")
+        got += k
 
-    def read_exact(n: int) -> bytes:
-        nonlocal consumed
-        buf = b""
-        while len(buf) < n:
-            try:
-                chunk = sock.recv(n - len(buf))
-            except TimeoutError:
-                if consumed or buf:
-                    raise SummersetError(
-                        f"recv timed out mid-frame ({consumed + len(buf)} "
-                        "bytes consumed): stream no longer frame-aligned"
-                    ) from None
-                raise
-            if not chunk:
-                raise SummersetError("connection closed mid-frame")
-            buf += chunk
-        consumed += len(buf)
-        return buf
 
-    (length,) = _LEN.unpack(read_exact(_LEN.size))
-    if length > MAX_FRAME:
-        raise SummersetError(f"frame length {length} exceeds cap {MAX_FRAME}")
-    return pickle.loads(read_exact(length)), length
+def recv_msg_sync_len(sock) -> Tuple[Any, int]:
+    """Like :func:`recv_msg_sync` but also returns the frame body length
+    (consumed by the Crossword adaptive perf model's delivery samples).
+    One-shot form of :class:`FrameReceiver` (which hot loops hold to
+    reuse the header buffer); timeout semantics in
+    :func:`_recv_exact_into`."""
+    return FrameReceiver().recv(sock)
+
+
+class FrameReceiver:
+    """Per-connection ingress state for a hot receive loop: a reusable
+    length-prefix buffer plus exact-size body reads via ``recv_into``.
+    Body buffers stay per-frame (decoded ndarray lanes are zero-copy
+    views into them); only the 8-byte header is recycled."""
+
+    __slots__ = ("_hdr", "_hdr_mv")
+
+    def __init__(self):
+        self._hdr = bytearray(_LEN.size)
+        self._hdr_mv = memoryview(self._hdr)
+
+    def recv_raw(self, sock) -> memoryview:
+        """Receive one frame's body bytes (undecoded) — lets hot loops
+        time the decode separately from the blocking socket wait."""
+        _recv_exact_into(sock, self._hdr_mv, 0)
+        (length,) = _LEN.unpack(self._hdr)
+        if length > MAX_FRAME:
+            raise SummersetError(
+                f"frame length {length} exceeds cap {MAX_FRAME}"
+            )
+        body = bytearray(length)
+        _recv_exact_into(sock, memoryview(body), _LEN.size)
+        return memoryview(body)
+
+    def recv(self, sock) -> Tuple[Any, int]:
+        """Receive and decode one frame; returns ``(obj, body_len)``."""
+        body = self.recv_raw(sock)
+        return wirecodec.decode_body(body), len(body)
 
 
 async def tcp_bind_with_retry(

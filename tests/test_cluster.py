@@ -33,11 +33,15 @@ def free_ports(n):
 
 class Cluster:
     def __init__(self, protocol, n, tmpdir, config=None, tick=0.005,
-                 num_groups=1):
+                 num_groups=1, config_per_slot=None):
         self.protocol = protocol
         self.n = n
         self.tmpdir = str(tmpdir)
         self.config = config or {}
+        # per-slot config overlays (slot -> dict), merged over `config`:
+        # heterogeneous clusters (e.g. the wire-codec mixed-mesh test
+        # runs one pickle replica among codec replicas)
+        self.config_per_slot = config_per_slot or {}
         self.tick = tick
         self.num_groups = num_groups
         ports = free_ports(2 + 2 * n)
@@ -104,7 +108,10 @@ class Cluster:
                     ("127.0.0.1", self.api_ports[slot]),
                     ("127.0.0.1", self.p2p_ports[slot]),
                     ("127.0.0.1", self.srv_port),
-                    config=self.config,
+                    config={
+                        **self.config,
+                        **self.config_per_slot.get(slot, {}),
+                    },
                     tick_interval=self.tick,
                     window=32,
                     num_groups=self.num_groups,
