@@ -41,6 +41,12 @@ def _wire_codec_on() -> bool:
     return wirecodec.default_on()
 
 
+def _pipeline_on() -> bool:
+    from summerset_tpu.host.server import pipeline_default
+
+    return pipeline_default()
+
+
 def run_point(cluster, clients, secs, freq, put_ratio, value_size,
               num_keys, plan=None):
     from summerset_tpu.client.bench import ClientBench
@@ -77,6 +83,160 @@ def run_point(cluster, clients, secs, freq, put_ratio, value_size,
     }
 
 
+def _sweep_pipeline_metrics(points, server_metrics, plan) -> dict:
+    """Distill one sweep leg for the ``pipeline_ab`` block: the
+    saturated-throughput point (offered=0 when present, else the best
+    achieved) plus the overlap attribution off the scraped
+    ``loop_stage_us`` histograms (``host_bench.stage_overlap_sums`` —
+    the one distillation both A/B drivers share)."""
+    from host_bench import stage_overlap_sums
+
+    sat = None
+    for p in points:
+        if p["offered"] == 0:
+            sat = p
+    if sat is None and points:
+        sat = max(points, key=lambda p: p["tput"])
+    ticks, sums = stage_overlap_sums(server_metrics)
+    return {
+        "ok": any(p["tput"] > 0 for p in points),
+        "workload_digest": plan.digest() if plan is not None else None,
+        "sat_tput": sat["tput"] if sat else 0.0,
+        "sat_lat_p50_ms": sat["lat_p50_ms"] if sat else 0.0,
+        "sat_lat_p99_ms": sat["lat_p99_ms"] if sat else 0.0,
+        "ticks": ticks,
+        "overlap_us_total": sums["overlap"][0],
+        "overlap_us_per_tick": round(
+            sums["overlap"][0] / max(sums["overlap"][1], 1), 1
+        ),
+        "device_wait_us_mean": round(
+            sums["device_wait"][0] / max(sums["device_wait"][1], 1), 1
+        ),
+    }
+
+
+def check_tputlat_pipeline_ab(block: dict) -> list:
+    """The TPUTLAT pipelined-loop A/B gate (re-asserted by
+    perf_gate.py --check): the one shared inequality set
+    (``host_bench.check_pipeline_ab_core``) keyed on the saturated
+    sweep point."""
+    from host_bench import check_pipeline_ab_core
+
+    return check_pipeline_ab_core(
+        block.get("on") or {}, block.get("off") or {},
+        "sat_tput", "saturated tput",
+    )
+
+
+def run_pipeline_ab(args, plan) -> None:
+    """The pipelined-loop A/B: the full load sweep as INTERLEAVED
+    serial/pipelined round pairs (leg order alternates per round,
+    per-side medians gate — the PERF round-8 discipline shared with
+    ``host_bench.run_pipeline_ab``: a single fixed-order pair is
+    exposed to monotonic box drift), same ``WorkloadPlan`` every leg so
+    the offered op streams are byte-identical (the committed digest
+    attests it).
+
+    The legs run on ``host_bench.ProcCluster`` (one PROCESS per
+    replica, the deployment shape) instead of the in-process curve
+    harness: the pipelined loop moves host-stage Python under the
+    device step's wall window, so in a shared-interpreter cluster it
+    steals GIL time from the bench's own client threads and the A/B
+    would measure harness contention, not the serving path.  The
+    ProcCluster path takes no server config dict, so config-shaped
+    knobs (``--mesh``/``--tally``) are refused up front in main()
+    rather than silently dropped."""
+    import shutil as _shutil
+
+    from host_bench import ProcCluster, summarize_ab_side
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
+
+    def one_leg(mode: bool, rnd: int) -> dict:
+        tag = "on" if mode else "off"
+        print(f"=== pipeline_ab round {rnd}: pipeline {tag} sweep ===",
+              flush=True)
+        os.environ["SMR_PIPELINE"] = "1" if mode else "0"
+        tmp = tempfile.mkdtemp(prefix=f"tput_pl_{tag}_")
+        cl = None
+        try:
+            t0 = time.time()
+            cl = ProcCluster(
+                args.protocol, args.replicas, tmp,
+                tick=args.tick, groups=args.groups,
+            )
+            print(f"cluster up in {time.time() - t0:.1f}s "
+                  f"({args.replicas} replica processes)", flush=True)
+            # warm the jit path so the first load point measures the
+            # serving tick, not XLA compile (same discipline both legs)
+            wep = GenericEndpoint(cl.manager_addr)
+            wep.connect()
+            DriverClosedLoop(wep, timeout=30.0).checked_put("warm", "1")
+            wep.leave()
+            pts = []
+            for load in [float(x) for x in args.loads.split(",")]:
+                pt = run_point(cl, args.clients, args.secs, load,
+                               args.put_ratio, args.value_size,
+                               args.num_keys, plan=plan)
+                print(json.dumps(pt), flush=True)
+                pts.append(pt)
+            metrics = scrape_metrics(cl.manager_addr)
+        finally:
+            os.environ.pop("SMR_PIPELINE", None)
+            if cl is not None:
+                cl.stop()
+            _shutil.rmtree(tmp, ignore_errors=True)
+        leg = _sweep_pipeline_metrics(pts, metrics, plan)
+        leg["pipeline"] = mode
+        return leg
+
+    rounds = {"on": [], "off": []}
+    for rnd in range(args.ab_rounds):
+        order = (False, True) if rnd % 2 == 0 else (True, False)
+        for mode in order:
+            rounds["on" if mode else "off"].append(one_leg(mode, rnd))
+    legs = {
+        tag: summarize_ab_side(per) for tag, per in rounds.items()
+    }
+    block = {
+        "protocol": args.protocol,
+        "groups": args.groups,
+        "replicas": args.replicas,
+        "clients": args.clients,
+        "loads": args.loads,
+        "secs_per_point": args.secs,
+        "workload": args.workload,
+        "workload_seed": args.workload_seed,
+        "ab_rounds": args.ab_rounds,
+        "on": legs["on"],
+        "off": legs["off"],
+    }
+    fails = check_tputlat_pipeline_ab(block)
+    block["ok"] = not fails
+    block["failures"] = fails
+    art = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                art = json.load(f)
+        except Exception:
+            art = {}
+    art["pipeline_ab"] = block
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print("pipeline_ab: " + json.dumps({
+        "ok": block["ok"],
+        "sat_tput_on": legs["on"]["sat_tput"],
+        "sat_tput_off": legs["off"]["sat_tput"],
+        "overlap_us_per_tick": legs["on"]["overlap_us_per_tick"],
+        "failures": fails,
+    }), flush=True)
+    sys.exit(0 if block["ok"] else 1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--protocol", default="MultiPaxos")
@@ -110,8 +270,28 @@ def main():
                          "group axis shards across this host's "
                          "devices — on CPU, the 8-virtual-device "
                          "platform above).  Empty = single-device.")
+    ap.add_argument("--pipeline-ab", action="store_true",
+                    help="run the full load sweep as interleaved "
+                         "serial/pipelined round pairs (SMR_PIPELINE "
+                         "into every replica process; per-side medians "
+                         "gate) and commit the gated A/B block (same "
+                         "workload digest, saturated tput strictly up "
+                         "pipelined, measured overlap > 0) beside the "
+                         "curve")
+    ap.add_argument("--ab-rounds", type=int, default=2,
+                    help="interleaved A/B round pairs for --pipeline-ab "
+                         "(leg order alternates per round against box "
+                         "drift; medians gate)")
     ap.add_argument("--out", default=os.path.join(REPO, "TPUTLAT.json"))
     args = ap.parse_args()
+
+    if args.pipeline_ab and (args.mesh or args.tally != "pairwise"
+                             or args.config):
+        # the A/B legs run on host_bench.ProcCluster (real replica
+        # processes, no server-config path) — refuse config-shaped
+        # knobs instead of silently dropping them from both legs
+        ap.error("--pipeline-ab runs on the ProcCluster harness and "
+                 "does not take --mesh/--tally/--config")
 
     from test_cluster import Cluster
 
@@ -148,28 +328,37 @@ def main():
         check_mesh(mesh_for(*mesh_shape), args.groups, args.replicas)
         config["device_mesh"] = args.mesh
 
-    tmp = tempfile.mkdtemp(prefix="tput_lat_")
-    t0 = time.time()
-    cluster = Cluster(args.protocol, args.replicas, tmp, config=config,
-                      tick=args.tick, num_groups=args.groups)
-    print(f"cluster up in {time.time() - t0:.1f}s", flush=True)
+    def run_sweep(sweep_config):
+        """One cluster bring-up -> full load sweep -> scrape -> stop."""
+        tmp = tempfile.mkdtemp(prefix="tput_lat_")
+        t0 = time.time()
+        cl = Cluster(args.protocol, args.replicas, tmp,
+                     config=sweep_config, tick=args.tick,
+                     num_groups=args.groups)
+        print(f"cluster up in {time.time() - t0:.1f}s", flush=True)
+        pts = []
+        try:
+            for load in [float(x) for x in args.loads.split(",")]:
+                pt = run_point(cl, args.clients, args.secs, load,
+                               args.put_ratio, args.value_size,
+                               args.num_keys, plan=plan)
+                print(json.dumps(pt), flush=True)
+                pts.append(pt)
+            # scrape once after the sweep: the snapshot's histograms
+            # cover every load point (server-side breakdown for the
+            # curve above)
+            from summerset_tpu.client.endpoint import scrape_metrics
 
-    points = []
-    server_metrics = {}
-    try:
-        for load in [float(x) for x in args.loads.split(",")]:
-            pt = run_point(cluster, args.clients, args.secs, load,
-                           args.put_ratio, args.value_size,
-                           args.num_keys, plan=plan)
-            print(json.dumps(pt), flush=True)
-            points.append(pt)
-        # scrape once after the sweep: the snapshot's histograms cover
-        # every load point (server-side breakdown for the curve above)
-        from summerset_tpu.client.endpoint import scrape_metrics
+            metrics = scrape_metrics(cl.manager_addr)
+        finally:
+            cl.stop()
+        return pts, metrics
 
-        server_metrics = scrape_metrics(cluster.manager_addr)
-    finally:
-        cluster.stop()
+    if args.pipeline_ab:
+        run_pipeline_ab(args, plan)
+        return
+
+    points, server_metrics = run_sweep(config)
 
     out = {
         "protocol": args.protocol,
@@ -188,6 +377,10 @@ def main():
         # wire-plane stamp (utils/wirecodec.py): which frame format the
         # cluster's hot planes served this curve with
         "wire_codec": _wire_codec_on(),
+        # tick-loop stamp (host/server.py): pipelined (device step
+        # overlapped with WAL fsync + apply/reply + frame exchange
+        # behind the durability fence) or the strict serial order
+        "pipeline": _pipeline_on(),
         # serving-mesh stamp: which device mesh each replica's [G, R]
         # state was sharded over (None = the single-device legacy path);
         # the canonical block shared with bench.py and PROFILE.json
@@ -213,6 +406,16 @@ def main():
         )
     except Exception as e:  # the stamp must never kill the bench
         out["graftprof"] = {"error": f"{type(e).__name__}: {e}"}
+    # preserve the sibling A/B block the --pipeline-ab parent commits
+    # into this artifact (regenerated independently of the curve body)
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+            if "pipeline_ab" in prev:
+                out["pipeline_ab"] = prev["pipeline_ab"]
+        except Exception:
+            pass
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"out": args.out, "points": len(points)}), flush=True)

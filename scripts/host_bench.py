@@ -25,6 +25,7 @@ Usage:
 import argparse
 import json
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -203,21 +204,36 @@ def _pin_worker(fleet_cores) -> None:
 
 
 def scrape_tick_marks(manager_addr) -> dict:
-    """Per-replica (tick counter, step-stage histogram count/sum_us)
+    """Per-replica (tick counter, device-cost histogram count/sum_us)
     marks.  Two marks bracket a window: the tick delta over wall time is
     the LOOP rate (informational — on this in-process CPU harness the
-    loop also carries the host apply/WAL stages), while the step-stage
+    loop also carries the host apply/WAL stages), while the device-cost
     delta gives the DEVICE tick cost: mean device-scan duration per
     tick, the thing that must stay flat under 10k clients (serving load
-    belongs to the host stages and the proxy tier, never to the scan)."""
+    belongs to the host stages and the proxy tier, never to the scan).
+    The cost source is loop-mode aware: serial replicas time the scan
+    in the fused ``step`` stage; pipelined replicas (the default) pay
+    it as ``dispatch`` + ``device_wait`` (launch plus residual block —
+    the host-paid share of the async scan), summed per tick here so
+    the flatness ratio gates BOTH modes instead of reading 0 cost off
+    a pipelined replica and failing every proxied bench."""
     from summerset_tpu.client.endpoint import scrape_metrics
 
     snap = scrape_metrics(manager_addr, timeout=15.0)
     out = {}
     for sid, s in (snap or {}).items():
-        h = (s.get("host", {}).get("histograms", {})
-              .get("loop_stage_us{stage=step}") or {})
-        out[sid] = (s["tick"], h.get("count", 0), h.get("sum", 0))
+        hists = s.get("host", {}).get("histograms", {})
+        step = hists.get("loop_stage_us{stage=step}") or {}
+        if not step.get("count"):
+            # pipelined loop: the scan cost the host pays is the async
+            # launch + the drain's residual block (same count per tick)
+            n = c = 0
+            for st in ("dispatch", "device_wait"):
+                h = hists.get("loop_stage_us{stage=%s}" % st) or {}
+                c = max(c, h.get("count", 0))
+                n += h.get("sum", 0)
+            step = {"count": c, "sum": n}
+        out[sid] = (s["tick"], step.get("count", 0), step.get("sum", 0))
     return out
 
 
@@ -284,6 +300,218 @@ def _wire_metrics(art: dict) -> dict:
         ),
         "frames_timed": sums["enc"][1],
     }
+
+
+def stage_overlap_sums(server_metrics) -> tuple:
+    """Sum the pipeline-attribution ``loop_stage_us`` histograms across
+    one metrics scrape: returns ``(ticks, sums)`` where ``sums`` maps
+    stage -> ``[us_total, count]`` for overlap/device_wait/step.  The
+    ONE distillation both A/B drivers (this file and bench_tput_lat.py)
+    summarize their legs with."""
+    ticks = 0
+    sums = {"overlap": [0, 0], "device_wait": [0, 0], "step": [0, 0]}
+    for _sid, s in (server_metrics or {}).items():
+        ticks += s.get("tick", 0)
+        hists = s.get("host", {}).get("histograms", {})
+        for name, acc in sums.items():
+            h = hists.get("loop_stage_us{stage=%s}" % name)
+            if h:
+                acc[0] += h.get("sum", 0)
+                acc[1] += h.get("count", 0)
+    return ticks, sums
+
+
+def _pipeline_metrics(art: dict) -> dict:
+    """Distill one bench artifact's pipeline-plane numbers: steady tput
+    plus the overlap attribution straight off the committed
+    ``loop_stage_us`` histograms — ``overlap`` is host-stage time spent
+    while a device step was in flight (the pipelining win), and
+    ``device_wait`` is the host's residual blocked share at drain."""
+    ticks, sums = stage_overlap_sums(art.get("server_metrics"))
+    return {
+        "pipeline": art.get("pipeline"),
+        "ok": art.get("ok"),
+        "tput": art.get("tput"),
+        "lat_p50_ms": art.get("lat_p50_ms"),
+        "lat_p99_ms": art.get("lat_p99_ms"),
+        "acked": art.get("acked"),
+        "workload_digest": art.get("workload_digest"),
+        "ticks": ticks,
+        "overlap_us_total": sums["overlap"][0],
+        "overlap_us_per_tick": round(
+            sums["overlap"][0] / max(sums["overlap"][1], 1), 1
+        ),
+        "device_wait_us_mean": round(
+            sums["device_wait"][0] / max(sums["device_wait"][1], 1), 1
+        ),
+        "serial_step_us_mean": round(
+            sums["step"][0] / max(sums["step"][1], 1), 1
+        ),
+    }
+
+
+def check_pipeline_ab_core(on: dict, off: dict, tput_key: str,
+                           tput_name: str) -> list:
+    """The ONE set of pipelined-loop A/B inequalities, shared by the
+    HOSTBENCH block (``tput_key="tput"``) and the TPUTLAT block
+    (``tput_key="sat_tput"``; bench_tput_lat.py): honest loop-mode
+    labels, both legs ok, same workload digest, pipelined throughput
+    STRICTLY above serial, measured overlap (host-stage time coincident
+    with the in-flight device step) > 0 pipelined and absent serial."""
+    fails = []
+    if on.get("pipeline") is not True or off.get("pipeline") is not False:
+        fails.append("pipeline_ab: runs not labeled pipeline on/off")
+    for side, sub in (("on", on), ("off", off)):
+        if not sub.get("ok"):
+            fails.append(f"pipeline_ab: pipeline-{side} bench not ok")
+    dig_on, dig_off = on.get("workload_digest"), off.get("workload_digest")
+    if dig_on is None or dig_on != dig_off:
+        fails.append(
+            f"pipeline_ab: workload digests differ or missing "
+            f"({dig_on} vs {dig_off})"
+        )
+    t_on = on.get(tput_key) or 0.0
+    t_off = off.get(tput_key) or 0.0
+    if not t_on > t_off:
+        fails.append(
+            f"pipeline_ab: pipelined {tput_name} {t_on} not strictly "
+            f"above serial {t_off}"
+        )
+    if not (on.get("overlap_us_total") or 0) > 0:
+        fails.append("pipeline_ab: no measured overlap on the "
+                     "pipelined side")
+    if (off.get("overlap_us_total") or 0) > 0:
+        fails.append("pipeline_ab: serial side recorded overlap "
+                     "(loop mode labels are wrong)")
+    return fails
+
+
+def check_pipeline_ab(block: dict) -> list:
+    """The HOSTBENCH pipelined-loop A/B gate (shared with
+    workload_gate.py) — see :func:`check_pipeline_ab_core`."""
+    return check_pipeline_ab_core(
+        block.get("on") or {}, block.get("off") or {},
+        "tput", "tput",
+    )
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2
+
+
+def summarize_ab_side(per: list) -> dict:
+    """Summarize one A/B side's per-round leg metrics: EVERY numeric
+    field is a true per-key median (an arbitrary round's value beside
+    genuine medians would present one possibly-outlier round as the
+    summary), ``ok``/labels/digest must agree across rounds, and the
+    raw rounds ride along for provenance.  Shared by both pipelined-
+    loop A/B drivers (this file and bench_tput_lat.py)."""
+    med: dict = {}
+    for key in per[0]:
+        vals = [p.get(key) for p in per]
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in vals):
+            m = _median(vals)
+            med[key] = round(m, 3) if isinstance(m, float) else m
+        else:
+            # non-numeric (mode labels, digest, ok): all rounds must
+            # agree — a per-round mismatch is a broken A/B, surfaced
+            # by the core checks downstream
+            med[key] = vals[0] if all(v == vals[0] for v in vals) \
+                else None
+    med["ok"] = all(p.get("ok") for p in per)
+    med["rounds"] = per
+    return med
+
+
+def run_pipeline_ab(args) -> None:
+    """Parent mode: run the full bench as INTERLEAVED serial/pipelined
+    round pairs (``SMR_PIPELINE`` into every child tier; the leg order
+    alternates per round), same workload seed/digest every leg, and
+    commit the gated A/B block into the existing artifact (the body
+    itself is NOT replaced: the committed HOSTBENCH body stays the
+    canonical 10k-client capture).
+
+    Interleaved pairs + per-side medians are the PERF round-8 A/B
+    discipline: a single off-then-on pair is exposed to monotonic box
+    drift (the second leg always runs on a slower box — measured
+    swinging the verdict by more than the effect under test), while
+    alternating pairs put the drift on both sides and the median
+    discards the outlier round."""
+    child_argv = [sys.executable, os.path.abspath(__file__)]
+    skip = 0
+    for a in sys.argv[1:]:
+        if skip:
+            skip -= 1
+            continue
+        if a == "--pipeline-ab":
+            continue
+        if a in ("--out", "--ab-rounds"):
+            skip = 1
+            continue
+        if a.startswith(("--out=", "--ab-rounds=")):
+            continue
+        child_argv.append(a)
+    rounds = {"on": [], "off": []}
+    tmp = tempfile.mkdtemp(prefix="pipeline_ab_")
+    try:
+        for rnd in range(args.ab_rounds):
+            order = ("off", "on") if rnd % 2 == 0 else ("on", "off")
+            for mode in order:
+                out = os.path.join(tmp, f"hostbench_{mode}_{rnd}.json")
+                env = dict(os.environ)
+                env["SMR_PIPELINE"] = "1" if mode == "on" else "0"
+                print(f"=== pipeline_ab round {rnd}: pipeline {mode} "
+                      f"run ===", flush=True)
+                r = subprocess.run(
+                    child_argv + ["--out", out], env=env, cwd=REPO,
+                )
+                if not os.path.exists(out):
+                    print(f"pipeline_ab: pipeline-{mode} round {rnd} "
+                          f"produced no artifact (rc={r.returncode})",
+                          flush=True)
+                    sys.exit(1)
+                with open(out) as f:
+                    rounds[mode].append(json.load(f))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    sides = {
+        mode: summarize_ab_side([_pipeline_metrics(r) for r in runs_m])
+        for mode, runs_m in rounds.items()
+    }
+    first = rounds["on"][0]
+    block = {
+        "clients": first.get("clients"),
+        "proxies": first.get("proxies"),
+        "protocol": first.get("protocol"),
+        "groups": first.get("groups"),
+        "workload": first.get("workload"),
+        "workload_seed": first.get("workload_seed"),
+        "ab_rounds": args.ab_rounds,
+        "on": sides["on"],
+        "off": sides["off"],
+    }
+    fails = check_pipeline_ab(block)
+    block["ok"] = not fails
+    block["failures"] = fails
+    art = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                art = json.load(f)
+        except Exception:
+            art = {}
+    art["pipeline_ab"] = block
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print("pipeline_ab: " + json.dumps(
+        {k: v for k, v in block.items() if k != "failures"} | {
+            "failures": fails,
+        }
+    ), flush=True)
+    sys.exit(0 if block["ok"] else 1)
 
 
 def check_wire_ab(block: dict) -> list:
@@ -388,6 +616,11 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--protocol", default="MultiPaxos")
     ap.add_argument("--groups", type=int, default=16)
+    ap.add_argument("--window", type=int, default=64,
+                    help="per-group W-slot device window (the G x W "
+                         "product sets the device-scan weight per "
+                         "tick; the pipeline A/B runs a scan-heavy "
+                         "shape so the overlap is measurable on CPU)")
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--secs", type=float, default=10.0)
@@ -426,11 +659,24 @@ def main() -> None:
                          "tier) — and commit the gated A/B block "
                          "(bytes/tick + serialize us/op strictly "
                          "down, tput held)")
+    ap.add_argument("--pipeline-ab", action="store_true",
+                    help="run the whole bench as interleaved serial/"
+                         "pipelined round pairs (SMR_PIPELINE into "
+                         "every child tier) and commit the gated A/B "
+                         "block (same workload digest, median pipelined "
+                         "tput strictly up, measured overlap > 0)")
+    ap.add_argument("--ab-rounds", type=int, default=3,
+                    help="interleaved A/B round pairs for --pipeline-ab "
+                         "(medians gate; order alternates per round "
+                         "against box drift)")
     ap.add_argument("--out", default=os.path.join(REPO, "HOSTBENCH.json"))
     args = ap.parse_args()
 
     if args.wire_ab:
         run_wire_ab(args)
+        return
+    if args.pipeline_ab:
+        run_pipeline_ab(args)
         return
 
     from summerset_tpu.client.endpoint import scrape_metrics
@@ -466,7 +712,8 @@ def main() -> None:
     t0 = time.time()
     cluster = ProcCluster(
         args.protocol, args.replicas, tmp,
-        tick=args.tick, groups=args.groups, platform=_plat,
+        tick=args.tick, groups=args.groups, window=args.window,
+        platform=_plat,
     )
     print(f"cluster up in {time.time() - t0:.1f}s "
           f"({args.replicas} replica processes x {args.groups} groups)",
@@ -631,6 +878,7 @@ def main() -> None:
             f"< {args.tick_budget}"
         )
 
+    from summerset_tpu.host.server import pipeline_default
     from summerset_tpu.utils import wirecodec
 
     out = {
@@ -639,6 +887,7 @@ def main() -> None:
         "replicas": args.replicas,
         "clients": args.clients,
         "wire_codec": wirecodec.default_on(),
+        "pipeline": pipeline_default(),
         "clients_concurrent_peak": connected,
         "clients_concurrent_min": connected_min,
         "fleet": "mux",             # selector-multiplexed closed loop
@@ -687,7 +936,7 @@ def main() -> None:
         try:
             with open(args.out) as f:
                 prev = json.load(f)
-            for k in ("wire_bench", "wire_ab"):
+            for k in ("wire_bench", "wire_ab", "pipeline_ab"):
                 if k in prev:
                     out[k] = prev[k]
         except Exception:

@@ -19,7 +19,12 @@ Asserts, WITHOUT bringing up clusters (pure plan regeneration):
    mitigated/unmitigated twin pair: every cell ok against the canonical
    ``FaultPlan.failslow`` digest, the mitigated twin demoted its
    limping leader, and its fault-window throughput beat the
-   unmitigated twin by the committed ratio bar.
+   unmitigated twin by the committed ratio bar;
+6. the ``wire_ab`` and ``pipeline_ab`` equivalence rows are present and
+   hold: one soak cell run twice (codec on/off, tick loop
+   pipelined/serial), byte-identical FaultPlan digests across modes,
+   both runs linearizable — the pipeline row's ``wal_torn``/
+   ``wal_fsync`` events land between a step and its durability fence.
 
 Usage:  python scripts/nemesis_gate.py [--json NEMESIS.json]
 """
@@ -56,9 +61,13 @@ def main() -> int:
 
     failslow_rows = [r for r in rows if r.get("failslow")]
     wire_ab_rows = [r for r in rows if r.get("kind") == "wire_ab"]
+    pipeline_ab_rows = [
+        r for r in rows if r.get("kind") == "pipeline_ab"
+    ]
     rows = [
         r for r in rows
-        if not r.get("failslow") and r.get("kind") != "wire_ab"
+        if not r.get("failslow")
+        and r.get("kind") not in ("wire_ab", "pipeline_ab")
     ]
 
     failures = []
@@ -96,6 +105,43 @@ def main() -> int:
             if bool(sub.get("wire_codec")) != (mode == "codec_on"):
                 failures.append(f"{tag}: {mode} ran with wire_codec="
                                 f"{sub.get('wire_codec')}")
+
+    # ---- pipelined-loop A/B row ----------------------------------------
+    # one soak cell run pipelined AND serial: the seeded repro contract
+    # must hold across tick-loop modes — byte-identical FaultPlan
+    # digests (and identical to what the current generator produces),
+    # both runs linearizable with bounded recovery.  The schedule's
+    # wal_torn/wal_fsync events land between a pipelined step and its
+    # durability fence, so this row is also the soak-scale fence proof.
+    if not pipeline_ab_rows:
+        failures.append("pipeline_ab row missing (run "
+                        "scripts/nemesis_soak.py --pipeline-ab)")
+    for row in pipeline_ab_rows:
+        tag = (f"pipeline_ab {row.get('protocol')} "
+               f"seed={row.get('seed')}")
+        if not row.get("ok"):
+            failures.append(f"{tag}: failed ({row.get('error')})")
+        if not row.get("digests_identical"):
+            failures.append(f"{tag}: plan digests diverged across "
+                            "pipeline modes")
+        want = FaultPlan.generate(
+            row.get("seed"), DEFAULT_REPLICAS, DEFAULT_TICKS,
+            classes=SOAK_CLASSES,
+        ).digest()
+        if row.get("digest") != want:
+            failures.append(
+                f"{tag}: digest drift — committed {row.get('digest')} "
+                f"vs regenerated {want}"
+            )
+        for mode in ("pipeline_on", "pipeline_off"):
+            sub = row.get(mode) or {}
+            if not sub.get("ok"):
+                failures.append(
+                    f"{tag}: {mode} run failed ({sub.get('error')})"
+                )
+            if bool(sub.get("pipeline")) != (mode == "pipeline_on"):
+                failures.append(f"{tag}: {mode} ran with pipeline="
+                                f"{sub.get('pipeline')}")
     by_seed = {
         s: FaultPlan.generate(
             s, DEFAULT_REPLICAS, DEFAULT_TICKS, classes=SOAK_CLASSES

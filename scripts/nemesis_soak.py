@@ -146,12 +146,14 @@ def run_one(protocol: str, seed: int, args) -> dict:
     print(f"--- {protocol} seed={seed} digest={plan.digest()}")
     print(plan.timeline(), end="")
 
+    from summerset_tpu.host.server import pipeline_default
     from summerset_tpu.utils import wirecodec
 
     tmp = tempfile.mkdtemp(prefix=f"nemsoak_{protocol.lower()}_{seed}_")
     result = {
         "protocol": protocol, "seed": seed, "digest": plan.digest(),
         "wire_codec": wirecodec.default_on(),
+        "pipeline": pipeline_default(),
         "ok": False,
     }
     cluster = None
@@ -587,10 +589,55 @@ def run_wire_ab(args) -> dict:
     return row
 
 
+def run_pipeline_ab(args) -> dict:
+    """The pipelined-loop A/B cell: ONE soak cell (protocol, seed) run
+    twice — tick loop pipelined and serial — flipped through the
+    process-wide server default so every in-process replica follows.
+    The committed row asserts the repro contract holds across loop
+    modes: byte-identical FaultPlan digests (the schedule is a pure
+    function of the seed — the loop order must not leak into it) and
+    both runs linearizable with bounded recovery.  The schedule's
+    ``wal_torn``/``wal_fsync`` events land while pipelined steps are in
+    flight, so the cell exercises exactly the crash window between a
+    step and its durability fence."""
+    from summerset_tpu.host import server as host_server
+
+    sub = {}
+    for mode in (True, False):
+        prev = host_server.set_pipeline_default(mode)
+        try:
+            r = run_one(args.protocol, args.seed, args)
+        finally:
+            host_server.set_pipeline_default(prev)
+        r["pipeline"] = mode
+        tag = "pipeline_on" if mode else "pipeline_off"
+        status = "PASS" if r["ok"] else f"FAIL ({r.get('error')})"
+        print(f"=== pipeline_ab {args.protocol} seed={args.seed} "
+              f"{tag}: {status} (ops={r.get('num_ops')}, "
+              f"recovery={r.get('recovery_ticks')} ticks)")
+        sub[tag] = r
+    same = sub["pipeline_on"]["digest"] == sub["pipeline_off"]["digest"]
+    row = {
+        "kind": "pipeline_ab",
+        "protocol": args.protocol,
+        "seed": args.seed,
+        "digest": sub["pipeline_on"]["digest"],
+        "digests_identical": same,
+        "ok": bool(
+            same and sub["pipeline_on"]["ok"] and sub["pipeline_off"]["ok"]
+        ),
+        "pipeline_on": sub["pipeline_on"],
+        "pipeline_off": sub["pipeline_off"],
+    }
+    if not same:
+        row["error"] = "plan digests diverged across pipeline modes"
+    return row
+
+
 def _row_half(r: dict) -> str:
     """Which independently-regenerated artifact half a row belongs to."""
-    if r.get("kind") == "wire_ab":
-        return "wire_ab"
+    if r.get("kind") in ("wire_ab", "pipeline_ab"):
+        return r["kind"]
     return "failslow" if r.get("failslow") else "matrix"
 
 
@@ -647,10 +694,21 @@ def main():
                          "wire codec on and off — and commit the "
                          "equivalence row (byte-identical plan digests, "
                          "both runs linearizable) beside the matrix")
+    ap.add_argument("--pipeline-ab", action="store_true",
+                    help="run ONE (protocol, seed) soak cell twice — "
+                         "tick loop pipelined and serial — and commit "
+                         "the equivalence row (byte-identical plan "
+                         "digests incl. wal_torn/wal_fsync events "
+                         "landing between step and fence, both runs "
+                         "linearizable) beside the matrix")
     ap.add_argument("--out", default=os.path.join(REPO, "NEMESIS.json"))
     args = ap.parse_args()
 
-    if args.wire_ab:
+    if args.pipeline_ab:
+        row = run_pipeline_ab(args)
+        results = [row]
+        merged = merge_rows(args.out, results, replace="pipeline_ab")
+    elif args.wire_ab:
         row = run_wire_ab(args)
         results = [row]
         merged = merge_rows(args.out, results, replace="wire_ab")

@@ -346,9 +346,37 @@ def check_tally_sweep(doc: dict, errors: list) -> None:
                 )
 
 
+def check_tputlat_pipeline_ab(path: str, errors: list) -> None:
+    """The committed pipelined-tick-loop curve proof (TPUTLAT.json
+    ``pipeline_ab``): the serial-vs-pipelined load sweep must be
+    present and hold its inequalities on the committed numbers (same
+    workload digest both legs, pipelined saturated tput strictly up,
+    measured overlap > 0) — re-asserted here like every other drift
+    gate, so a hand-edited block can't pass on ``ok: true`` alone."""
+    from bench_tput_lat import check_tputlat_pipeline_ab as check_ab
+
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        errors.append(f"tputlat: cannot read {path}: {e}")
+        return
+    ab = art.get("pipeline_ab")
+    if not ab:
+        errors.append(
+            "tputlat: pipeline_ab block missing (run "
+            "scripts/bench_tput_lat.py --pipeline-ab)"
+        )
+        return
+    errors.extend(f"tputlat: {w}" for w in check_ab(ab))
+    if not ab.get("ok"):
+        errors.append("tputlat: pipeline_ab committed not ok")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--profile", default=os.path.join(REPO, "PROFILE.json"))
+    ap.add_argument("--tputlat", default=os.path.join(REPO, "TPUTLAT.json"))
     ap.add_argument("--check", action="store_true",
                     help="(the only mode; present for CI-invocation "
                          "symmetry with the other gates)")
@@ -443,6 +471,7 @@ def main() -> int:
 
         check_mesh_sweep(doc, errors)
         check_tally_sweep(doc, errors)
+        check_tputlat_pipeline_ab(args.tputlat, errors)
 
     if not errors and not args.skip_wall:
         for cell in cells:

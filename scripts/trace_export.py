@@ -53,11 +53,23 @@ import json
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
-# plane -> tid (stable small ints; names attached via metadata events)
-PLANES = ("api", "device scan", "transport", "storage", "ctrl", "proxy")
+# plane -> tid (stable small ints; names attached via metadata events).
+# "host loop" renders the PIPELINED tick's host stages: with the
+# software pipeline on, the device step genuinely overlaps the host
+# stages, so its span (from the drain-time device_step event) stays on
+# the "device scan" track while the host stopwatches move to their own
+# track — two X spans on one tid cannot overlap without the viewer
+# nesting one under the other.
+PLANES = ("api", "device scan", "transport", "storage", "ctrl", "proxy",
+          "host loop")
 TID = {name: i for i, name in enumerate(PLANES)}
 
 _STAGE_ORDER = ("intake", "exchange", "step", "log", "apply")
+# pipelined tick stage layout (ServerReplica._tick_pipelined execution
+# order; "overlap" IS a wall segment here — the host work that ran
+# while the dispatched scan was in flight)
+_PIPE_STAGE_ORDER = ("intake", "exchange", "inbox", "dispatch",
+                     "overlap", "device_wait", "apply", "log")
 
 
 def _events(dump: dict) -> list:
@@ -533,9 +545,10 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                         "args": {"g": ev["g"], "vid": ev["vid"]},
                     })
             elif k == "tick":
-                durs = [
-                    (st, int(ev.get(st, 0))) for st in _STAGE_ORDER
-                ]
+                pipelined = bool(ev.get("pipelined"))
+                order = _PIPE_STAGE_ORDER if pipelined else _STAGE_ORDER
+                tid = TID["host loop" if pipelined else "device scan"]
+                durs = [(st, int(ev.get(st, 0))) for st in order]
                 start = t - sum(d for _, d in durs)
                 for st, d in durs:
                     if d <= 0:
@@ -543,17 +556,39 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                     evs.append({
                         "ph": "X",
                         "name": (
-                            "device scan tick" if st == "step" else st
+                            "device scan tick"
+                            if st == "step" and not pipelined else st
                         ),
-                        "pid": me, "tid": TID["device scan"],
+                        "pid": me, "tid": tid,
                         "ts": max(0, start), "dur": d,
-                        "args": {"tick": ev.get("tick")},
+                        "args": {
+                            "tick": ev.get("tick"),
+                            **({"overlap_us": ev.get("overlap")}
+                               if pipelined else {}),
+                        },
                     })
-                    if st == "step" and fracs:
+                    if st == "step" and not pipelined and fracs:
                         evs.extend(_phase_children(
                             max(0, start), d, fracs, me, ev.get("tick")
                         ))
                     start += d
+            elif k == "device_step":
+                # pipelined device span, recorded at drain time: the
+                # step's true wall interval (dispatch -> results ready)
+                # on the device track — genuinely overlapping the host
+                # stages on the "host loop" track, never nested in them
+                d = int(ev.get("dur_us", 0))
+                evs.append({
+                    "ph": "X", "name": "device scan tick",
+                    "pid": me, "tid": TID["device scan"],
+                    "ts": max(0, t - d), "dur": d,
+                    "args": {"tick": ev.get("tick"),
+                             "wait_us": ev.get("wait_us")},
+                })
+                if fracs:
+                    evs.extend(_phase_children(
+                        max(0, t - d), d, fracs, me, ev.get("tick")
+                    ))
             elif k in ("frame_tx", "frame_rx"):
                 evs.append({
                     "ph": "i", "s": "t", "name": k, "pid": me,

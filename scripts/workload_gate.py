@@ -21,7 +21,11 @@ Asserts, WITHOUT bringing up clusters (pure plan regeneration):
    the ``wire_ab`` block (10k-client bench codec on/off: peer-frame
    bytes/tick + p2p serialize us/op strictly down, tput held — see
    ``host_bench.check_wire_ab``) and the ``wire_bench`` microbench
-   block (bytes down on every shape, time down on the tick shapes).
+   block (bytes down on every shape, time down on the tick shapes);
+7. the pipelined-tick-loop A/B holds in HOSTBENCH.json: the
+   ``pipeline_ab`` block (same workload digest serial vs pipelined,
+   pipelined steady tput strictly up, measured overlap > 0 — see
+   ``host_bench.check_pipeline_ab``).
 
 Usage:  python scripts/workload_gate.py [--json WORKLOADS.json]
                                         [--hostbench HOSTBENCH.json]
@@ -141,6 +145,33 @@ def check_hostbench_wire(path: str) -> list:
     return fails
 
 
+def check_hostbench_pipeline(path: str) -> list:
+    """The committed pipelined-tick-loop proof row in HOSTBENCH.json:
+    the serial-vs-pipelined A/B block must be present and hold its
+    inequalities (same workload digest both modes, pipelined tput
+    strictly up, measured overlap > 0 — ``host_bench
+    .check_pipeline_ab``), re-asserted on the committed numbers."""
+    from host_bench import check_pipeline_ab
+
+    fails = []
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except OSError:
+        return [f"hostbench: {path} missing"]
+    ab = art.get("pipeline_ab")
+    if not ab:
+        fails.append("hostbench: pipeline_ab block missing (run "
+                     "scripts/host_bench.py --pipeline-ab)")
+    else:
+        fails.extend(
+            f"hostbench: {w}" for w in check_pipeline_ab(ab)
+        )
+        if not ab.get("ok"):
+            fails.append("hostbench: pipeline_ab committed not ok")
+    return fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--json",
@@ -153,6 +184,7 @@ def main() -> int:
 
     failures = []
     failures.extend(check_hostbench_wire(args.hostbench))
+    failures.extend(check_hostbench_pipeline(args.hostbench))
     want = {(p, c, s): fs for p, c, s, fs in WL_MATRIX}
     seen = set()
     ab_rows = [r for r in rows if r.get("kind") == "proxy_ab"]

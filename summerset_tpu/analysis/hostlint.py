@@ -24,6 +24,19 @@ all four are mechanically checkable:
 - **H104 fsync outside StorageHub** — durability points belong to the
   logger thread (single-writer discipline + fault injection + fsync
   telemetry); a stray ``os.fsync`` bypasses all three.
+- **H105 unfenced egress in the pipelined tick loop** — the pipelined
+  loop's durability contract is that no vote/ack computed by step N
+  leaves the process (peer tick frame OR client reply) before step N's
+  WAL records are fsynced.  The fence is ``_fence_wait``; this rule
+  makes the contract machine-checked: every ``send_tick`` /
+  ``send_replies`` call site in the fence owner module
+  (``host/server.py``) must either be dominated by a ``_fence_wait()``
+  call earlier in the same function's straight-line body, or pass the
+  fence down as a ``fence=..._fence_wait`` keyword so the egress seam
+  itself re-checks.  The serial loop's call site carries an inline
+  waiver instead (its strict stage order — fsync at the END of tick
+  N-1, frames computed by step N-1 leaving at the TOP of tick N — IS
+  the fence), so every egress site is either dominated or reasoned.
 
 Suppressions are explicit, inline, and carry a reason::
 
@@ -100,6 +113,14 @@ BLOCKING_NAMES = frozenset({
 # blocking only without a timeout= kwarg (queue.get, thread.join)
 TIMEOUT_GATED_NAMES = frozenset({"get", "join"})
 
+# H105: the durability-fence owner module and its egress seams.  Egress
+# calls here must be fence-dominated (a `_fence_wait()` earlier in the
+# same function's straight-line body) or carry a `fence=` kwarg naming
+# the fence — anything else can leak a not-yet-durable vote/ack.
+FENCE_OWNER = "host/server.py"
+FENCE_EGRESS_NAMES = frozenset({"send_tick", "send_replies"})
+FENCE_WAIT_NAME = "_fence_wait"
+
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable=([A-Z]\d+)(?:\s*--\s*(.*))?"
 )
@@ -166,6 +187,10 @@ class _Scanner(ast.NodeVisitor):
         self._lock_lines: List[int] = []  # enclosing with-lock linenos
         self._seeded_classes = SEEDED_SCOPES.get(rel, ())
         self._mono_classes = MONOTONIC_SCOPES.get(rel, ())
+        # H105 dominance: per enclosing function, the linenos of
+        # STRAIGHT-LINE (top-level-of-body) `..._fence_wait()` call
+        # statements — a fence inside an `if` doesn't dominate
+        self._fence_lines: List[List[int]] = []
 
     # ---------------------------------------------------------- helpers
     def _qual(self) -> str:
@@ -199,7 +224,20 @@ class _Scanner(ast.NodeVisitor):
 
     def _visit_func(self, node) -> None:
         self._scope.append(node.name)
+        # H105 dominance set: fence waits that are top-level statements
+        # of THIS function's body (straight-line — unconditionally
+        # executed before anything below them)
+        fences = []
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and _call_name(stmt.value) == FENCE_WAIT_NAME
+            ):
+                fences.append(stmt.lineno)
+        self._fence_lines.append(fences)
         self.generic_visit(node)
+        self._fence_lines.pop()
         self._scope.pop()
 
     visit_FunctionDef = _visit_func
@@ -297,6 +335,27 @@ class _Scanner(ast.NodeVisitor):
                     "H103", f"{qual}:{dotted}",
                     f"module-level {dotted}() draws from the global "
                     "(unseeded) RNG inside seeded-determinism scope",
+                    node.lineno,
+                )
+
+        if name in FENCE_EGRESS_NAMES and self.rel == FENCE_OWNER:
+            fenced_kwarg = any(
+                kw.arg == "fence"
+                and _dotted(kw.value).endswith(FENCE_WAIT_NAME)
+                for kw in node.keywords
+            )
+            dominated = bool(self._fence_lines) and any(
+                ln < node.lineno for ln in self._fence_lines[-1]
+            )
+            if not (fenced_kwarg or dominated):
+                self._emit(
+                    "H105", f"{qual}:{name}",
+                    f"egress call {dotted or name}() not dominated by a "
+                    f"{FENCE_WAIT_NAME}() in this function's straight-"
+                    "line body and not passing fence= — a vote/ack "
+                    "computed by the in-flight step could leave before "
+                    "its WAL records are fsynced (the pipelined loop's "
+                    "durability fence contract)",
                     node.lineno,
                 )
 

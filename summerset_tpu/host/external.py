@@ -191,6 +191,23 @@ class ExternalApi:
             self._send(client, reply), loop
         )
 
+    def send_replies(
+        self,
+        items: List[Tuple[int, ApiReply]],
+        fence=None,
+    ) -> None:
+        """Flush a batch of ``(client, reply)`` pairs, gated on the
+        durability fence: ``fence`` (the pipelined loop's
+        ``ServerReplica._fence_wait``) runs BEFORE the first reply is
+        handed to the event loop — replies reveal applied/acked state,
+        so none may escape until the WAL records covering that state
+        are fsynced, and a failed fence raises here with every reply
+        still unsent (the crash-before-ack contract)."""
+        if fence is not None:
+            fence()
+        for client, reply in items:
+            self.send_reply(reply, client)
+
     def stop(self) -> None:
         loop = self._loop
         if loop is not None:

@@ -39,6 +39,11 @@ from ..utils.errors import SummersetError
 
 _LEN = struct.Struct("<Q")
 
+# background-channel action ids (the pipelined group-commit plane): a
+# fire-and-forget append delivers NO result; a flush carries its token
+_BG_APPEND = "__bg_append__"
+_BG_FLUSH = "__bg_flush__"
+
 
 @dataclasses.dataclass
 class LogAction:
@@ -208,6 +213,17 @@ class StorageHub:
         self._out: queue.Queue = queue.Queue()
         self._stop_lock = threading.Lock()
         self._stopped = False
+        # background group commit (the pipelined tick loop's durability
+        # fence): fire-and-forget appends + token-stamped sync points.
+        # The logger thread is a FIFO, so a token enqueued after a run
+        # of appends covers exactly those appends; completion (or the
+        # first error — a torn append, an EIO fsync) is published under
+        # the condition and re-raised at wait_flush, BEFORE any frame or
+        # reply gated on the token can leave the replica.
+        self._flush_cv = threading.Condition()
+        self._flush_next = 0          # tokens issued
+        self._flush_done = 0          # tokens completed (monotonic)
+        self._bg_error: Optional[BaseException] = None
         # telemetry seam (host/telemetry.MetricsRegistry): fsync latency
         # is THE durability cost — one sync point covers every append
         # since the last (group commit), so batch size rides along
@@ -279,6 +295,61 @@ class StorageHub:
         aid, res = self.get_result()
         assert aid is None
         return res
+
+    # -- background group commit (pipelined durability fence) ---------------
+    def append_nowait(self, entry: Any) -> None:
+        """Fire-and-forget unsynced append on the logger thread.  No
+        result is delivered; a failure (torn write, dead device) is
+        latched as the hub's background error and re-raised by the NEXT
+        ``wait_flush`` — the records it covered never became durable, so
+        the fence gating their acks must fail, not silently pass."""
+        self._in.put((_BG_APPEND, LogAction("append", entry=entry,
+                                            sync=False)))
+
+    def flush_token(self) -> int:
+        """Enqueue a background group-commit sync point covering every
+        append submitted before it (the logger is a FIFO) and return a
+        token for :meth:`wait_flush`.  The fsync runs on the logger
+        thread while the caller overlaps other work — the pipelined
+        loop's durability fence."""
+        with self._flush_cv:
+            self._flush_next += 1
+            token = self._flush_next
+        self._in.put(((_BG_FLUSH, token), LogAction("sync")))
+        return token
+
+    def poll_flush(self, token: int) -> bool:
+        """Non-blocking fence probe: True iff the ``token``'s sync point
+        already completed.  Raises the latched background error exactly
+        like :meth:`wait_flush` — a failed group commit must crash the
+        caller at the first probe, not linger behind a False."""
+        with self._flush_cv:
+            if self._bg_error is not None:
+                raise SummersetError(
+                    f"WAL background group commit failed: {self._bg_error}"
+                )
+            return self._flush_done >= token
+
+    def wait_flush(self, token: int, timeout: Optional[float] = None) -> None:
+        """Block until the ``token``'s sync point completed.  Raises the
+        first background error (failed fsync OR any earlier failed
+        background append) — the caller must treat that as fatal before
+        releasing anything gated on the token.  Raises
+        :class:`SummersetError` on timeout."""
+        with self._flush_cv:
+            ok = self._flush_cv.wait_for(
+                lambda: self._bg_error is not None
+                or self._flush_done >= token,
+                timeout=timeout,
+            )
+            if self._bg_error is not None:
+                raise SummersetError(
+                    f"WAL background group commit failed: {self._bg_error}"
+                )
+            if not ok:
+                raise SummersetError(
+                    f"WAL flush token {token} timed out after {timeout}s"
+                )
 
     def stop(self) -> None:
         # idempotent + race-safe: the replica loop's own shutdown and an
@@ -446,6 +517,31 @@ class StorageHub:
             if item is None:
                 return
             action_id, action = item
+            # background channel: no result queue round-trip — errors
+            # latch into _bg_error (sticky) and surface at wait_flush,
+            # the durability fence the pipelined loop blocks on
+            if action_id == _BG_APPEND:
+                try:
+                    self._handle(action)
+                except Exception as e:
+                    with self._flush_cv:
+                        if self._bg_error is None:
+                            self._bg_error = e
+                        self._flush_cv.notify_all()
+                continue
+            if isinstance(action_id, tuple) and action_id[0] == _BG_FLUSH:
+                token = action_id[1]
+                try:
+                    self._handle(action)
+                    with self._flush_cv:
+                        self._flush_done = max(self._flush_done, token)
+                        self._flush_cv.notify_all()
+                except Exception as e:
+                    with self._flush_cv:
+                        if self._bg_error is None:
+                            self._bg_error = e
+                        self._flush_cv.notify_all()
+                continue
             try:
                 res = self._handle(action)
             except Exception as e:  # surface backend errors to the caller

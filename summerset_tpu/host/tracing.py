@@ -60,7 +60,18 @@ EVENT_TYPES = (
                      # depth) — overload is attributable on graftscope
                      # request chains instead of vanishing silently
     "propose",       # sampled batch proposed (g, vid, tick, client, req_id)
-    "tick",          # run-loop iteration (tick, per-stage durations us)
+    "tick",          # run-loop iteration (tick, per-stage durations us;
+                     # pipelined ticks additionally carry pipelined=1
+                     # plus the overlap/device_wait attribution stages —
+                     # host-work us coincident with the in-flight device
+                     # step, and us spent blocked on its results)
+    "device_step",   # pipelined mode: one DEVICE step's true wall span
+                     # (tick, dur_us = dispatch -> results ready,
+                     # wait_us = the host's residual blocked share).
+                     # Recorded at drain time, on its own track, so the
+                     # exporter renders the scan as a genuinely
+                     # overlapping span beside the host "overlap" stage
+                     # instead of nesting it inside the host tick span
     "frame_tx",      # p2p frame sent (peer=dst, seq=sender tick, nbytes)
     "frame_rx",      # p2p frame received (peer=src, seq=sender tick, nbytes)
     "wal_append",    # WAL record appended (sync flag)

@@ -354,16 +354,25 @@ class TransportHub:
             hard_close(sock)
 
     # ------------------------------------------------------------ tick I/O
-    def send_tick(self, tick: int, per_peer: Dict[int, Any]) -> None:
+    def send_tick(self, tick: int, per_peer: Dict[int, Any],
+                  fence=None) -> None:
         """Send this tick's outbox slice to each connected peer.
 
         Egress is vectored and coalesced per peer: the frame's length
         prefix, codec chunks, and zero-copy lane-array views — times
         the dup count, when the fault plane duplicates — leave in ONE
         ``sendmsg`` syscall, with no join copy of the body (the old
-        path concatenated header + pickle body per peer per tick)."""
+        path concatenated header + pickle body per peer per tick).
+
+        ``fence`` is the pipelined loop's durability gate: a callable
+        (``ServerReplica._fence_wait``) invoked BEFORE the first byte of
+        any frame leaves — the frames carry votes/acks computed by the
+        step whose WAL records the fence covers, and a failed fence
+        raises here, before anything escapes the process."""
         import time
 
+        if fence is not None:
+            fence()
         faults = self._faults
         enc = self._enc
         reg = self.registry

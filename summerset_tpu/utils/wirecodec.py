@@ -236,6 +236,17 @@ def set_default(on: bool) -> bool:
     return prev
 
 
+def payload_nbytes(obj: Any) -> int:
+    """Wire size of a payload-plane object (the ``pp`` piggyback) as the
+    frame formats actually serialize it.  BOTH formats pickle it at
+    ``HIGHEST_PROTOCOL``: the codec tick frame carries every non-lane
+    payload key in its C-speed rest-pickle blob, and the pickle fallback
+    pickles the whole frame the same way — so the shard-economy meters
+    (``pp_bytes``) size with this one helper instead of a bare default-
+    protocol ``pickle.dumps`` that diverges from the wire."""
+    return len(pickle.dumps(obj, pickle.HIGHEST_PROTOCOL))
+
+
 def is_hot(obj: Any) -> bool:
     """Should this object take the codec fast path?  Hot = the data
     plane's steady-state kinds; everything else is rare enough that

@@ -349,6 +349,32 @@ def runner_pacing():
     return time.time()             # module-level: outside the scope
 """
 
+# H105 both-direction fixtures: every egress shape the rule must
+# decide — dominated by a straight-line fence wait (clean), carrying
+# the fence down as a kwarg (clean), fence only inside a conditional
+# (fires: not straight-line), and no fence at all (fires)
+_FENCED_EGRESS = """
+class Replica:
+    def drain(self):
+        self._fence_wait()
+        self.external.send_replies(self.queue)
+
+    def exchange(self):
+        self.transport.send_tick(self.tick, frames,
+                                 fence=self._fence_wait)
+"""
+
+_UNFENCED_EGRESS = """
+class Replica:
+    def exchange(self):
+        self.transport.send_tick(self.tick, frames)
+
+    def drain(self, ready):
+        if ready:
+            self._fence_wait()
+        self.external.send_replies(self.queue)
+"""
+
 _MONO_SCOPE = """
 import time
 
@@ -461,6 +487,53 @@ def test_hostlint_workload_scope_is_module_keyed(tmp_path):
         tmp_path, _WORKLOAD_SEEDED_SCOPE, "host/other.py"
     )
     assert findings == []
+
+
+def test_hostlint_fenced_egress_is_clean(tmp_path):
+    """H105 negative direction: an egress call dominated by a
+    straight-line ``_fence_wait()`` earlier in the same function, or
+    passing ``fence=..._fence_wait`` down to the seam, is clean."""
+    findings, _ = _scan(tmp_path, _FENCED_EGRESS, "host/server.py")
+    assert findings == []
+
+
+def test_hostlint_unfenced_egress_fires(tmp_path):
+    """H105 positive direction: an egress call with no fence at all
+    fires, and a fence wait INSIDE a conditional does not dominate —
+    the frames/replies could still leave on the branch that skipped
+    it."""
+    findings, _ = _scan(tmp_path, _UNFENCED_EGRESS, "host/server.py")
+    assert sorted((f.code, f.scope) for f in findings) == [
+        ("H105", "Replica.drain:send_replies"),
+        ("H105", "Replica.exchange:send_tick"),
+    ]
+
+
+def test_hostlint_fence_rule_is_module_keyed(tmp_path):
+    """The fence contract is owned by host/server.py — the same source
+    elsewhere (e.g. the transport hub's own internals, the test
+    harnesses) is not in scope."""
+    findings, _ = _scan(tmp_path, _UNFENCED_EGRESS, "host/other.py")
+    assert findings == []
+
+
+def test_hostlint_real_server_fence_sites():
+    """The live host/server.py holds the fence contract: the pipelined
+    loop's egress seams are all fenced (no H105 findings), and the
+    serial loop's send site carries its reasoned waiver on record."""
+    import summerset_tpu
+
+    pkg = os.path.dirname(summerset_tpu.__file__)
+    findings, suppressed = hostlint.scan_file(
+        os.path.join(pkg, "host", "server.py"), "host/server.py"
+    )
+    assert [f for f in findings if f.code == "H105"] == []
+    waived = [
+        (f.scope, r) for f, r in suppressed if f.code == "H105"
+    ]
+    assert len(waived) == 1
+    assert waived[0][0] == "ServerReplica._tick_serial:send_tick"
+    assert "fence" in waived[0][1]
 
 
 def test_hostlint_real_workload_module_is_clean():
