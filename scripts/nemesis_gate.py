@@ -49,7 +49,8 @@ from nemesis_soak import (  # noqa: E402  (scripts/ sibling import)
 from summerset_tpu.host.nemesis import FaultPlan  # noqa: E402
 
 DEFAULT_REPLICAS = 3
-LONG_LIVED = ("device_reset", "conf_change", "take_snapshot")
+LONG_LIVED = ("device_reset", "conf_change", "take_snapshot",
+              "range_change")
 
 
 def main() -> int:

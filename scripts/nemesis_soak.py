@@ -63,6 +63,9 @@ SOAK_CLASSES = (
     # long-lived cluster classes: durable device/host crash-restart,
     # membership ConfChange under faults, compaction on the serving path
     "device_reset", "conf_change", "take_snapshot",
+    # live resharding: a range split driven mid-schedule through the
+    # ctrl plane (seal -> barrier -> adopt under partitions/crashes)
+    "range_change",
 )
 # end-of-soak boundedness: compaction events must keep every survivor's
 # WAL from growing without bound, and the device window ring can never

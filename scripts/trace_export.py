@@ -28,7 +28,9 @@ process per replica and one track per plane:
                     every frame — no wire-format change;
 - **storage**     — wal_fsync ``X`` spans (duration + group-commit
                     batch) and wal_append instants;
-- **ctrl**        — fault_ctl / demote / crash / restart instants.
+- **ctrl**        — fault_ctl / demote / crash / restart instants, plus
+                    the live-resharding cutover pair (range_seal /
+                    range_adopt).
 
 Cross-server clock alignment: monotonic bases are unrelated across
 processes, so per-server offsets are estimated NTP-style from the paired
@@ -628,7 +630,8 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                     "tid": TID["device scan"], "ts": t,
                     "args": {"g": ev["g"], "vid": ev["vid"]},
                 })
-            elif k in ("fault_ctl", "demote", "crash", "restart"):
+            elif k in ("fault_ctl", "demote", "crash", "restart",
+                       "range_seal", "range_adopt"):
                 evs.append({
                     "ph": "i", "s": "p", "name": k, "pid": me,
                     "tid": TID["ctrl"], "ts": t,

@@ -43,8 +43,9 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from workload_soak import (  # noqa: E402  (scripts/ sibling import)
-    DEFAULT_BUDGET_TICKS, P99_BUDGET_S, PROXY_AB_MIN_RATIO, PROXY_CELL,
-    PROXY_COUNT, RECOVER_FRAC, WL_MATRIX, build_plans, build_proxy_plan,
+    DEFAULT_BUDGET_TICKS, FAULT_CLASSES, P99_BUDGET_S,
+    PROXY_AB_MIN_RATIO, PROXY_CELL, PROXY_COUNT, RECOVER_FRAC,
+    RESHARD_GROUPS, WL_MATRIX, build_plans, build_proxy_plan,
 )
 
 DEFAULT_REPLICAS = 3
@@ -102,6 +103,75 @@ def check_proxy_ab(row) -> list:
                 f"{tag}: {mode} post-burst throughput did not "
                 f"recover ({rec}/s tail vs {st}/s offered steady)"
             )
+    return fails
+
+
+def check_reshard_ab(row) -> list:
+    """Gate the live-resharding on/off A/B row: same WorkloadPlan AND
+    FaultPlan digests regenerate byte-identically, >= 1 live split and
+    >= 1 live merge executed (server-side adoption counters) in the on
+    run while the faults played, zero values both acked and shed in
+    either mode, and both runs linearizable inside the fused p99 +
+    recovery budgets."""
+    from workload_soak import AB_SEED, DEFAULT_CLIENTS, DEFAULT_KEYS, \
+        DEFAULT_HORIZON
+    from summerset_tpu.host.nemesis import FaultPlan
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    fails = []
+    tag = "reshard_ab"
+    if not row.get("ok"):
+        fails.append(f"{tag}: failed ({row.get('error')})")
+    wplan = WorkloadPlan.generate(
+        AB_SEED, "hot_burst", clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    if row.get("wl_digest") != wplan.digest():
+        fails.append(
+            f"{tag}: workload digest drift — committed "
+            f"{row.get('wl_digest')} vs regenerated {wplan.digest()}"
+        )
+    fdig = FaultPlan.generate(
+        AB_SEED, DEFAULT_REPLICAS, DEFAULT_HORIZON,
+        classes=FAULT_CLASSES,
+    ).digest()
+    if row.get("fault_digest") != fdig:
+        fails.append(
+            f"{tag}: fault digest drift — committed "
+            f"{row.get('fault_digest')} vs regenerated {fdig}"
+        )
+    if row.get("num_groups") != RESHARD_GROUPS:
+        fails.append(f"{tag}: ran over {row.get('num_groups')} groups "
+                     f"(need {RESHARD_GROUPS})")
+    on = row.get("on") or {}
+    if on.get("splits", 0) < 1:
+        fails.append(f"{tag}: no live split executed "
+                     f"(adopted {on.get('splits')})")
+    if on.get("merges", 0) < 1:
+        fails.append(f"{tag}: no live merge executed "
+                     f"(adopted {on.get('merges')})")
+    off = row.get("off") or {}
+    if off.get("splits", 0) or off.get("merges", 0):
+        fails.append(f"{tag}: off run executed range changes")
+    for mode in ("off", "on"):
+        sub = row.get(mode) or {}
+        if not sub.get("linearizable"):
+            fails.append(f"{tag}: {mode} history not linearizable")
+        if sub.get("ack_shed_overlap", 0) != 0:
+            fails.append(f"{tag}: {mode} lost an ack to a shed "
+                         "across the cutover")
+        if (sub.get("p99_s") or 1e9) > P99_BUDGET_S:
+            fails.append(f"{tag}: {mode} accepted-op p99 "
+                         f"{sub.get('p99_s')}s over budget")
+        rec = sub.get("recover_tput")
+        st = sub.get("offered_steady")
+        if rec is None or st is None or rec < RECOVER_FRAC * st:
+            fails.append(
+                f"{tag}: {mode} post-burst throughput did not "
+                f"recover ({rec}/s tail vs {st}/s offered steady)"
+            )
+        if not sub.get("recovered"):
+            fails.append(f"{tag}: {mode} no bounded recovery write")
     return fails
 
 
@@ -193,8 +263,14 @@ def main() -> int:
                         "scripts/workload_soak.py --proxy-ab)")
     for ab in ab_rows:
         failures.extend(check_proxy_ab(ab))
+    rab_rows = [r for r in rows if r.get("kind") == "reshard_ab"]
+    if not rab_rows:
+        failures.append("reshard_ab row missing (run "
+                        "scripts/workload_soak.py --reshard-ab)")
+    for rab in rab_rows:
+        failures.extend(check_reshard_ab(rab))
     for row in rows:
-        if row.get("kind") == "proxy_ab":
+        if row.get("kind") in ("proxy_ab", "reshard_ab"):
             continue
         cell = (row.get("protocol"), row.get("wl_class"),
                 row.get("seed"))

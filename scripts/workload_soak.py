@@ -123,6 +123,20 @@ PROXY_CRASH_RESTART = 10
 AB_SEED = 1
 RAMP_MAX_X = 8.0
 PROXY_AB_MIN_RATIO = 1.5
+# ---- live resharding A/B (host/resharding.py) -----------------------
+# The reshard_ab row runs the hot_burst overload cell TWICE over a
+# 4-group keyspace on the same WorkloadPlan digest — resharding off,
+# then on with the heat-driven ResharderPolicy live — while the cell's
+# message-plane FaultPlan plays in both modes.  The "on" run must
+# execute >= 1 live split and >= 1 live merge through the seal/adopt
+# cutover with zero acked-and-shed overlap and the fused p99/recovery
+# budgets held in BOTH modes (sheds allowed during cutover, lost acks
+# never).  The policy consumes per-interval heat DELTAS (cumulative
+# counts never cool; the delta is the live "cold" signal).
+RESHARD_GROUPS = 4
+RESHARD_HOT_FRAC = 0.15    # split when a key draws this much heat
+RESHARD_COLD_FRAC = 0.05   # merge a moved key back below this
+RESHARD_SCRAPE_S = 1.2     # policy scrape/decide interval
 # shared with scripts/workload_gate.py (digest regeneration)
 DEFAULT_CLIENTS = 3
 DEFAULT_KEYS = 24
@@ -865,6 +879,321 @@ def run_shed_ab(args) -> dict:
     return row
 
 
+def run_reshard_ab(args) -> dict:
+    """Live-resharding A/B on the hot_burst overload row: the SAME
+    WorkloadPlan (same seed, same digest) runs twice over a 4-group
+    keyspace — resharding off, then on — while the cell's message-plane
+    FaultPlan plays in both modes.  In the "on" run a ResharderPolicy
+    driver scrapes the servers' per-key ``range_heat`` gauges, feeds
+    per-interval deltas to ``decide``, and issues the resulting
+    ``range_change`` requests over the ctrl plane: >= 1 live split and
+    >= 1 live merge must execute (server-side ``reshard_splits`` /
+    ``reshard_merges`` counters) through the seal/adopt cutover, with
+    both histories linearizable-with-sheds, zero values both acked and
+    shed, and accepted-op p99 + post-burst recovery inside the fused
+    budgets in BOTH modes.  Committed as the ``kind == "reshard_ab"``
+    WORKLOADS.json row, gated by scripts/workload_gate.py."""
+    import zlib
+
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
+    from summerset_tpu.client.tester import start_workload_clients
+    from summerset_tpu.host.messages import CtrlRequest
+    from summerset_tpu.host.nemesis import FaultPlan, NemesisRunner
+    from summerset_tpu.host.resharding import (
+        RangeChange, ResharderPolicy, single_key_range,
+    )
+    from summerset_tpu.host.workload import WorkloadPlan
+    from summerset_tpu.utils.linearize import check_history
+
+    wplan = WorkloadPlan.generate(
+        AB_SEED, "hot_burst", clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    fplan = FaultPlan.generate(
+        AB_SEED, args.replicas, DEFAULT_HORIZON, classes=FAULT_CLASSES,
+    )
+    burst = wplan.phases[1]
+    row = {
+        "kind": "reshard_ab", "protocol": "MultiPaxos",
+        "seed": AB_SEED, "wl_digest": wplan.digest(),
+        "fault_digest": fplan.digest(),
+        "num_groups": RESHARD_GROUPS, "ok": False,
+    }
+    cap_unit = None
+
+    def hash_group(key: str) -> int:
+        # mirrors ServerReplica.group_of — the hash-home placement the
+        # policy splits away from and merges back to
+        return zlib.crc32(key.encode()) % RESHARD_GROUPS
+
+    def run_mode(mode: str) -> dict:
+        nonlocal cap_unit
+        sub = {"mode": mode}
+        tmp = tempfile.mkdtemp(prefix=f"wlreshard_{mode}_")
+        cluster = None
+        stop = threading.Event()
+        ops: list = []
+        stats: list = []
+        threads: list = []
+        runner = None
+        nem_thread = None
+        try:
+            cluster = Cluster(
+                "MultiPaxos", args.replicas, tmp,
+                config=protocol_config("MultiPaxos"), tick=args.tick,
+                num_groups=RESHARD_GROUPS,
+            )
+            wep = GenericEndpoint(cluster.manager_addr)
+            wep.connect()
+            DriverClosedLoop(wep, timeout=10.0).checked_put("warm", "1")
+            wep.leave()
+            if cap_unit is None:
+                # the OFF run calibrates once; both runs share the
+                # offered-rate axis so the budgets compare 1:1
+                cap_unit = calibrate_capacity(
+                    cluster.manager_addr, wplan.clients,
+                    timeout=args.op_timeout,
+                )
+                row["capacity_ops_s"] = round(cap_unit, 1)
+                time.sleep(min(2.0, API_MAX_PENDING / cap_unit + 0.3))
+            print(f"--- reshard_ab {mode}: hot_burst over "
+                  f"{RESHARD_GROUPS} groups at {cap_unit:.1f} ops/s, "
+                  f"faults {fplan.digest()}")
+            t0 = time.monotonic()
+
+            def rate_total_of() -> float:
+                tick = (time.monotonic() - t0) / args.tick_len
+                return wplan.rate_x_at(tick) * cap_unit
+
+            threads = start_workload_clients(
+                cluster.manager_addr, wplan, rate_total_of, stop, ops,
+                stats, timeout=args.op_timeout,
+            )
+            runner = NemesisRunner(
+                cluster.manager_addr, fplan, tick_len=args.tick_len,
+            )
+            nem_thread = threading.Thread(target=runner.play,
+                                          daemon=True)
+            nem_thread.start()
+
+            issued = {"split": 0, "merge": 0}
+            moved: list = []   # keys split off their hash-home
+            if mode == "on":
+                def drive_policy() -> None:
+                    pol = ResharderPolicy(
+                        RESHARD_GROUPS, hash_group,
+                        hot_frac=RESHARD_HOT_FRAC,
+                        cold_frac=RESHARD_COLD_FRAC, min_total=10,
+                    )
+                    prev: dict = {}
+                    ep = GenericEndpoint(cluster.manager_addr)
+
+                    def request(ch) -> None:
+                        try:
+                            rep = ep.ctrl.request(
+                                CtrlRequest("range_change",
+                                            payload=ch.as_dict()),
+                                timeout=60.0,
+                            )
+                        except Exception as e:
+                            sub.setdefault("ctrl_errors", []).append(
+                                repr(e))
+                            return
+                        if rep is None or rep.kind == "error":
+                            return
+                        issued[ch.op] += 1
+                        if ch.op == "split":
+                            moved.append(ch.start)
+                        elif ch.start in moved:
+                            moved.remove(ch.start)
+
+                    while not stop.is_set():
+                        time.sleep(RESHARD_SCRAPE_S)
+                        if stop.is_set():
+                            break
+                        try:
+                            full = scrape_metrics(
+                                cluster.manager_addr, timeout=10.0)
+                        except Exception:
+                            continue
+                        cum: dict = {}
+                        for sid, snap in (full or {}).items():
+                            gauges = (snap.get("host", {})
+                                          .get("gauges", {}) or {})
+                            for name, v in gauges.items():
+                                if name.startswith("range_heat{key="):
+                                    k = name[len("range_heat{key="):-1]
+                                    cum[k] = cum.get(k, 0) + int(v)
+                        delta = {k: max(0, v - prev.get(k, 0))
+                                 for k, v in cum.items()}
+                        prev = cum
+                        tick = (time.monotonic() - t0) / args.tick_len
+                        ch = pol.decide(delta)
+                        if (ch is None and not issued["split"] and cum
+                                and tick >= burst.tick
+                                + burst.ticks // 2):
+                            # backstop split: mid-burst with nothing
+                            # moved yet, split the cumulatively hottest
+                            # key (scrape cadence must not flake the
+                            # >= 1 live split acceptance)
+                            hot = max(cum.items(),
+                                      key=lambda t: t[1])[0]
+                            if hot not in moved:
+                                s, e = single_key_range(hot)
+                                ch = RangeChange(
+                                    "split", s, e,
+                                    (hash_group(hot) + 1)
+                                    % RESHARD_GROUPS,
+                                )
+                        if (ch is None and moved
+                                and tick >= burst.tick + burst.ticks
+                                + 8):
+                            # cool-down merge: the burst is over, move
+                            # still-split ranges back to their
+                            # hash-home (early in the recover phase so
+                            # the cutover shed clears the measured
+                            # recovery tail)
+                            key = moved[0]
+                            s, e = single_key_range(key)
+                            ch = RangeChange("merge", s, e,
+                                             hash_group(key))
+                        if ch is not None:
+                            request(ch)
+                    try:
+                        ep.ctrl.close()
+                    except Exception:
+                        pass
+
+                pt = threading.Thread(target=drive_policy, daemon=True)
+                pt.start()
+                threads.append(pt)
+
+            horizon_s = wplan.horizon() * args.tick_len
+            time.sleep(max(0.0, t0 + horizon_s - time.monotonic()))
+            time.sleep(2.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            if nem_thread is not None:
+                nem_thread.join(timeout=120)
+            runner.heal_all()
+
+            # bounded recovery: a checked write within the tick budget
+            t_heal = time.monotonic()
+            budget_s = args.budget_ticks * args.tick
+            rep_ep = GenericEndpoint(cluster.manager_addr)
+            rep_ep.connect()
+            drv = DriverClosedLoop(rep_ep, timeout=min(5.0, budget_s))
+            recovered = False
+            while time.monotonic() - t_heal < budget_s:
+                r = drv.put("reshard_recovery", f"m-{mode}")
+                if r.kind == "success":
+                    recovered = True
+                    break
+                drv._retry_pause(r)
+            rep_ep.leave()
+            sub["recovered"] = recovered
+            sub["recovery_ticks"] = int(
+                (time.monotonic() - t_heal) / args.tick)
+
+            sub["num_ops"] = len(ops)
+            sub["issued"] = sum(s["issued"] for s in stats)
+            sub["acked"] = sum(s["acked"] for s in stats)
+            sub["shed"] = sum(s["shed"] for s in stats)
+            sub["splits_issued"] = issued["split"]
+            sub["merges_issued"] = issued["merge"]
+
+            # server-side evidence that cutovers EXECUTED (adoption
+            # applied), not just that requests were issued
+            full = scrape_metrics(cluster.manager_addr)
+            splits, merges = {}, {}
+            api_shed = {}
+            for sid, snap in (full or {}).items():
+                ctr = snap.get("host", {}).get("counters", {})
+                splits[sid] = ctr.get("reshard_splits", 0)
+                merges[sid] = ctr.get("reshard_merges", 0)
+                api_shed[sid] = ctr.get("api_shed", 0)
+            sub["reshard_splits"] = splits
+            sub["reshard_merges"] = merges
+            sub["api_shed"] = api_shed
+            sub["splits"] = max(splits.values(), default=0)
+            sub["merges"] = max(merges.values(), default=0)
+
+            # no ack lost to a shed across the cutover: a value must
+            # never be both acked and negatively acked
+            acked_vals = {o.value for o in ops
+                          if o.kind == "put" and o.acked and not o.shed}
+            shed_vals = {o.value for o in ops if o.shed}
+            sub["ack_shed_overlap"] = len(acked_vals & shed_vals)
+
+            lat = [o.t_resp - o.t_inv
+                   for o in ops if o.acked and not o.shed]
+            sub["p99_s"] = round(p99(lat), 3)
+            win_rec = phase_window(wplan, 2, t0, args.tick_len)
+            r_lo = win_rec[0] + 0.6 * (win_rec[1] - win_rec[0])
+            rec_acc = accepted_in(ops, r_lo, win_rec[1])
+            rec_tput = len(rec_acc) / max(win_rec[1] - r_lo, 1e-9)
+            sub["recover_tput"] = round(rec_tput, 1)
+            sub["offered_steady"] = round(
+                wplan.phases[0].rate_x * cap_unit, 1)
+
+            ok, diag = check_history(ops)
+            sub["linearizable"] = bool(ok)
+            if not ok:
+                sub["error"] = diag
+            return sub
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            if runner is not None:
+                if not sub.get("linearizable"):
+                    sub["flight"] = runner.flight_tails(last_n=256)
+                runner.close()
+            if cluster is not None:
+                cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    row["off"] = run_mode("off")
+    row["on"] = run_mode("on")
+    errs = []
+    for mode in ("off", "on"):
+        sub = row[mode]
+        if not sub.get("linearizable"):
+            errs.append(f"{mode} history not linearizable "
+                        f"({sub.get('error')})")
+        if sub.get("ack_shed_overlap"):
+            errs.append(f"{mode}: {sub['ack_shed_overlap']} values "
+                        "both acked and shed")
+        if sub.get("num_ops", 0) < args.min_ops:
+            errs.append(f"{mode} history too small: "
+                        f"{sub.get('num_ops')}")
+        if sub.get("p99_s", 1e9) > args.p99_budget:
+            errs.append(f"{mode} accepted-op p99 {sub.get('p99_s')}s "
+                        f"over budget {args.p99_budget}s")
+        if sub.get("recover_tput", 0.0) < (
+            args.recover_frac * sub.get("offered_steady", 1e9)
+        ):
+            errs.append(f"{mode} post-burst throughput did not recover")
+        if not sub.get("recovered"):
+            errs.append(f"{mode} no recovery within budget")
+    if row["on"].get("splits", 0) < 1:
+        errs.append("no live split executed in the on run")
+    if row["on"].get("merges", 0) < 1:
+        errs.append("no live merge executed in the on run")
+    if row["off"].get("splits", 0) or row["off"].get("merges", 0):
+        errs.append("off run executed range changes")
+    row["ok"] = not errs
+    if errs:
+        row["error"] = "; ".join(errs)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--protocol", default="MultiPaxos")
@@ -876,6 +1205,9 @@ def main():
     ap.add_argument("--proxy-ab", action="store_true",
                     help="run ONLY the fused-vs-proxy shed-point A/B "
                          "(appends/replaces the proxy_ab row)")
+    ap.add_argument("--reshard-ab", action="store_true",
+                    help="run ONLY the live-resharding on/off A/B "
+                         "(appends/replaces the reshard_ab row)")
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--tick", type=float, default=0.005,
                     help="server tick interval (with api_max_batch="
@@ -892,7 +1224,7 @@ def main():
                     default=os.path.join(REPO, "WORKLOADS.json"))
     args = ap.parse_args()
 
-    if args.proxy_ab:
+    if args.proxy_ab or args.reshard_ab:
         runs = []
     elif args.matrix:
         runs = list(WL_MATRIX)
@@ -931,6 +1263,23 @@ def main():
                     if r.get("kind") != "proxy_ab"
                 ]
         results.append(ab)
+    if args.matrix or args.reshard_ab:
+        rab = run_reshard_ab(args)
+        status = "PASS" if rab["ok"] else f"FAIL ({rab.get('error')})"
+        on = rab.get("on") or {}
+        print(f"=== reshard_ab: {status} (splits={on.get('splits')}, "
+              f"merges={on.get('merges')}, "
+              f"p99 off={rab.get('off', {}).get('p99_s')}s / "
+              f"on={on.get('p99_s')}s)")
+        if args.reshard_ab and os.path.exists(args.out):
+            # surgical update: keep every committed row, swap the
+            # reshard_ab row
+            with open(args.out) as f:
+                results = [
+                    r for r in json.load(f)
+                    if r.get("kind") != "reshard_ab"
+                ]
+        results.append(rab)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {args.out}")

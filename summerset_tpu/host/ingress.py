@@ -73,6 +73,7 @@ from ..utils.keyrange import KeyRangeMap
 from ..utils.logging import pf_info, pf_logger, pf_warn
 from .external import ExternalApi
 from .messages import ApiReply, ApiRequest, CtrlRequest
+from .resharding import RangeHeat
 from .statemach import CommandResult
 from .telemetry import MetricsRegistry, PROXY_DECLARED
 from .tracing import FlightRecorder
@@ -135,6 +136,9 @@ class RoutingTable:
         self.responders: List[int] = []
         self._owners: KeyRangeMap = KeyRangeMap()
         self._overrides: List[Tuple[str, Optional[str], int]] = []
+        # manager-announced installed ranges (live resharding): replaced
+        # wholesale on refresh, applied below manual overrides
+        self._ranges: List[Tuple[str, Optional[str], int]] = []
         self._hint_fresh_until = 0.0
 
     # -- update side (refresher thread + redirect hints) ------------------
@@ -171,8 +175,23 @@ class RoutingTable:
     def set_owner(self, start: str, end: Optional[str], sid: int) -> None:
         """Install a per-key-range owner override (kept across leader
         updates; later inserts overwrite overlapped spans — rangemap
-        semantics)."""
-        self._overrides.append((start, end, int(sid)))
+        semantics).  Re-setting the same span replaces its entry instead
+        of growing the override list without bound."""
+        self._overrides = [
+            o for o in self._overrides if (o[0], o[1]) != (start, end)
+        ] + [(start, end, int(sid))]
+        self._rebuild()
+
+    def set_ranges(
+        self, triples: List[Tuple[str, Optional[str], int]],
+    ) -> None:
+        """Replace the manager-announced installed-range set (live
+        resharding, host/resharding.py) wholesale.  No-op when unchanged
+        so the 0.5s refresh loop doesn't churn the routing version."""
+        triples = [(s, e, int(sid)) for s, e, sid in triples]
+        if triples == self._ranges:
+            return
+        self._ranges = triples
         self._rebuild()
 
     def _rebuild(self) -> None:
@@ -182,8 +201,16 @@ class RoutingTable:
             default = min(self.servers) if self.servers else None
         if default is not None:
             m.full_range(default)
-        for start, end, sid in self._overrides:
-            m.insert(start, end, sid)
+        # overrides whose owner is gone from the address book fall back
+        # to the default instead of wedging their range: _flush can
+        # never resolve an upstream for a dead sid, and the leftover
+        # would park every op in the range until the backlog shed
+        for start, end, sid in self._ranges:
+            if sid in self.servers:
+                m.insert(start, end, sid)
+        for start, end, sid in self._overrides:  # manual overrides win
+            if sid in self.servers:
+                m.insert(start, end, sid)
         self._owners = m  # atomic ref swap
         self.version += 1
 
@@ -511,6 +538,9 @@ class IngressProxy:
             self.metrics.counter_add(name, 0)
         for name in ("proxy_backlog", "read_tier_backlog"):
             self.metrics.gauge_set(name, 0)
+        # per-key-range heat lane (live resharding, host/resharding.py)
+        self.metrics.gauge_set("range_heat", 0.0)
+        self._range_heat = RangeHeat()
 
         # control plane: register with the manager; identity = ctrl cid
         # (liveness and registration share one socket — deregistration
@@ -587,6 +617,17 @@ class IngressProxy:
             leader=info.leader,
             responders=responders,
         )
+        # live resharding: installed ranges arrive on the SAME refresh
+        # round (manager re-announce path).  Every replica process holds
+        # every group, so the forward target for an installed range is
+        # the leader sid — installing it as an explicit range keeps the
+        # table's version tracking cutovers (and generalizes unchanged
+        # once per-group leaders diverge into distinct processes).
+        if info.leader is not None:
+            self.routing.set_ranges([
+                (e["start"], e.get("end"), int(info.leader))
+                for e in (getattr(info, "ranges", None) or ())
+            ])
 
     def _refresh_loop(self) -> None:
         while not self._stop.wait(self.refresh_s):
@@ -683,6 +724,8 @@ class IngressProxy:
                 "error", req_id=req.req_id, success=False,
             ), client)
             return
+        # per-key-range heat at the proxy seam (live resharding input)
+        self._range_heat.note(req.cmd.key)
         prid = self._mint(client, req, "req")
         if (
             req.cmd.kind == "get"
@@ -980,6 +1023,11 @@ class IngressProxy:
             self._drop_pend(prid)
 
     def metrics_snapshot(self) -> dict:
+        self.metrics.gauge_set(
+            "range_heat", float(self._range_heat.total())
+        )
+        for k, n in self._range_heat.top(8):
+            self.metrics.gauge_set("range_heat", float(n), key=k)
         return {
             "cid": self.cid,
             "tier": "proxy",

@@ -118,6 +118,14 @@ class CtrlMsg:
     #   flight_dump -> flight_reply: flight (graftscope scrape;
     #     server.flight_snapshot() — the typed-event ring + drop
     #     accounting; request payload may carry {"last_n": n})
+    #   range_change -> range_reply: change (host/resharding.RangeChange
+    #     as_dict) — every replica seals the range and acks; the
+    #     destination leader later proposes the adopt through its log
+    #   range_installed: entry — proposer -> manager adoption notice
+    #   install_ranges: seq, installed, pending — manager -> servers
+    #     re-announce (newest seq wins; the ConfChange install_conf
+    #     pattern) so late joiners learn installed ranges + re-seal
+    #     pending ones
     #   leave / leave_reply
     payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -129,6 +137,9 @@ class CtrlRequest:
     kind: str  # query_info | query_conf | reset_servers | pause_servers
     #            | resume_servers | take_snapshot | inject_faults
     #            | metrics_dump | flight_dump | proxy_join | leave
+    #            | range_change (payload: op/start/end/dst_group —
+    #              validated into a host/resharding.RangeChange, fanned
+    #              to every server, replied with conf={"rc_id": n})
     servers: Optional[List[int]] = None  # None = all
     durable: bool = True                 # reset: keep durable files?
     payload: Optional[Dict[str, Any]] = None  # inject_faults: fault spec
@@ -165,3 +176,8 @@ class CtrlReply:
     # its ctrl connection drops, so rediscovery after a proxy crash is
     # one fresh query_info away)
     proxies: Optional[Dict[int, Any]] = None
+    # installed range overrides (host/resharding.py), in adoption order:
+    # query_info returns them so proxies learn live splits/merges through
+    # their existing refresh round (the same late-joiner re-announce
+    # contract as `conf`)
+    ranges: Optional[list] = None

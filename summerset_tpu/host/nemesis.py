@@ -91,6 +91,15 @@ ALL_CLASSES = (
     "mem_pressure",  # bounded WAL write-back buffer (`arg` bytes): group
                    # commit degrades to constant forced fsyncs + reclaim
                    # stalls (memory pressure on the durability path)
+    "range_change",  # live resharding under fire (host/resharding.py):
+                   # drive a key-range split through the manager ctrl
+                   # plane WHILE partitions/crashes play — the seal ->
+                   # barrier -> adopt cutover's adversarial coverage
+                   # (arg selects which canonical runner key moves; the
+                   # destination group rides the first target id,
+                   # normalized mod G server-side).  Leaderless
+                   # protocols answer with an explicit refusal — the
+                   # reply path is still exercised, like conf_change
     "proxy_crash",  # serving-plane tier fault (host/ingress.py): kill an
                    # ingress PROXY (targets = proxy indices, not replica
                    # ids) and restart it after `duration` ticks — its
@@ -115,6 +124,9 @@ SLOW_PEER_BW = 48_000.0  # bytes/second egress
 HOST_ONLY = (
     "delay", "dup", "wal_torn", "wal_fsync", "conf_change",
     "take_snapshot",
+    # the resharding ctrl plane is host machinery (manager fan-out +
+    # host seal/adopt state); the lockstep device plane has no analog
+    "range_change",
     # fail-slow classes are host-only like wal_*: the lockstep device
     # plane has no notion of a replica running SLOWER than the tick (the
     # closest device analog, duty-cycled aliveness, is already
@@ -126,7 +138,7 @@ HOST_ONLY = (
 )
 # instantaneous events: no heal action at tick + duration
 INSTANT = ("crash", "wal_torn", "wal_fsync", "conf_change",
-           "take_snapshot")
+           "take_snapshot", "range_change")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -231,6 +243,10 @@ class FaultPlan:
                 # the WAL truncate — the window where a half-finished
                 # compaction must still recover losslessly
                 arg = 1.0 if rng.random() < 0.34 else 0.0
+            elif kind == "range_change":
+                # which canonical runner key to split off (the runner's
+                # range_keys tuple); targets[0] seeds the destination
+                arg = float(rng.randint(0, 2))
             if kind in INSTANT:
                 dur = 0
             events.append(FaultEvent(t, kind, targets, dur, arg))
@@ -481,6 +497,14 @@ class FaultPlan:
             elif ev.kind == "take_snapshot":
                 acts.append((ev.tick, "take_snapshot", ev.render(),
                              {"servers": ts, "crash": bool(ev.arg)}))
+            elif ev.kind == "range_change":
+                # a live split driven through the ctrl plane while the
+                # rest of the schedule keeps playing (normalized mod G
+                # at the servers — a G=1 cluster still exercises the
+                # full seal/barrier/adopt cutover as a self-move)
+                acts.append((ev.tick, "range_change", ev.render(),
+                             {"sel": int(ev.arg),
+                              "dst": ts[0] if ts else 0}))
             elif ev.kind == "slow_disk":
                 acts.append((ev.tick, "wal", ev.render(),
                              {"servers": ts, "spec": {"slow": ev.arg}}))
@@ -550,6 +574,10 @@ class NemesisRunner:
         # attached record the action error (not fatal) like any other
         # impossible fault action
         self.proxy_ctl: Optional[Callable[[str, dict], None]] = None
+        # canonical keys range_change events move (sel = arg indexes
+        # this tuple); soaks override it with keys their workload
+        # actually writes so the cutover carries real state
+        self.range_keys: Tuple[str, ...] = ("nem0", "nem1", "nem2")
         # in-flight conf_change driver threads: conf entries ride the log
         # and may take many ticks to install under faults — the schedule
         # must keep playing WHILE they do (that concurrency is the point)
@@ -594,6 +622,8 @@ class NemesisRunner:
             self._inject(spec["servers"], {"skew": spec["factor"]})
         elif action == "conf_change":
             self._start_conf_change(list(spec["responders"]))
+        elif action == "range_change":
+            self._start_range_change(int(spec["sel"]), int(spec["dst"]))
         elif action in ("proxy_crash", "proxy_restart"):
             if self.proxy_ctl is None:
                 raise SummersetError(
@@ -637,6 +667,32 @@ class NemesisRunner:
                         ep.leave()
                     except Exception:
                         pass
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        self._conf_threads.append(t)
+
+    def _start_range_change(self, sel: int, dst: int) -> None:
+        """Fire a live range split through the manager ctrl plane from a
+        background driver; the schedule keeps playing WHILE the seal ->
+        barrier -> adopt cutover is in flight (that concurrency is the
+        coverage).  The manager normalizes dst mod G, so any seeded
+        target id is a valid destination group."""
+        from ..host.resharding import single_key_range
+
+        key = self.range_keys[sel % len(self.range_keys)]
+        start, end = single_key_range(key)
+
+        def drive() -> None:
+            try:
+                self._request(CtrlRequest("range_change", payload={
+                    "op": "split", "start": start, "end": end,
+                    "dst_group": int(dst),
+                }), timeout=60.0)
+            except Exception as e:
+                # expected under adversity: a partitioned manager fan-
+                # out may time out — the attempt itself is the coverage
+                pf_warn(logger, f"range_change {key!r} gave up: {e}")
 
         t = threading.Thread(target=drive, daemon=True)
         t.start()

@@ -58,7 +58,8 @@ from .external import ExternalApi
 from .health import HealthScorer
 from .messages import ApiReply, ApiRequest, CtrlMsg, ShardPayload
 from .payload import PayloadStore
-from .statemach import CommandResult, StateMachine, apply_command
+from .resharding import RangeHeat, RangeTable
+from .statemach import Command, CommandResult, StateMachine, apply_command
 from .storage import LogAction, StorageHub
 from .telemetry import MetricsRegistry, SlotTraces
 from .tracing import FlightRecorder
@@ -333,6 +334,13 @@ class ServerReplica:
         self._demote_restore_resp: Optional[List[int]] = None
         self.metrics.counter_add("leader_demotions", 0)
         self.metrics.gauge_set("health_score", 1.0)
+        # live resharding (host/resharding.py): counters/gauges declared
+        # up front so scrapes see them at zero; the cutover histogram
+        # gets one zero sample for the same always-present contract
+        self.metrics.counter_add("reshard_splits", 0)
+        self.metrics.counter_add("reshard_merges", 0)
+        self.metrics.gauge_set("range_heat", 0.0)
+        self.metrics.observe("reshard_cutover_us", 0)
 
         # protocol kernel over [G, R]; host applier drives the exec bar
         kercfg_cls = type(
@@ -458,6 +466,19 @@ class ServerReplica:
         # (None, request) for manager-relayed installs
         self._conf_queue: List[Tuple[Optional[int], ApiRequest]] = []
         self._conf_seq_seen = 0
+        # live resharding plane (host/resharding.py): installed range
+        # overrides (rangetab), ranges sealed awaiting adoption (rc_id ->
+        # change dict + sealed_at), adopted rc_ids (idempotency), the
+        # newest install_ranges seq seen, adopt re-propose marks (tick of
+        # last proposal per rc_id), the adopt proposals awaiting intake,
+        # and per-key heat at the api seam
+        self.rangetab = RangeTable()
+        self._range_sealed: Dict[int, dict] = {}
+        self._range_adopted: Set[int] = set()
+        self._range_seq_seen = 0
+        self._range_adopt_mark: Dict[int, int] = {}
+        self._range_adopt_ready: List[Tuple[int, ApiRequest]] = []
+        self._range_heat = RangeHeat()
         # EPaxos: leaderless — every replica proposes into its own row;
         # execution runs through the exact host Tarjan applier.  Every
         # key bucket with pending requests proposes in the SAME tick
@@ -653,6 +674,24 @@ class ServerReplica:
             return 0
         return zlib.crc32(key.encode()) % self.G
 
+    def route_group(self, key: str) -> int:
+        """Live placement: installed range overrides first (adopted
+        splits/merges, host/resharding.py), hash placement otherwise."""
+        if len(self.rangetab):
+            e = self.rangetab.lookup(key)
+            if e is not None:
+                return int(e["group"]) % self.G
+        return self.group_of(key)
+
+    def _range_sealed_for(self, key: str) -> Optional[dict]:
+        """The sealed-range change covering ``key``, if any (the set is
+        tiny — at most the in-flight cutovers — so a scan is fine)."""
+        for ch in self._range_sealed.values():
+            end = ch.get("end")
+            if key >= ch["start"] and (end is None or key < end):
+                return ch
+        return None
+
     # ----------------------------------------------------- host state views
     def _np_state(self, k: str) -> np.ndarray:
         """Host view of one state leaf, pinned to the last DRAINED step.
@@ -711,6 +750,18 @@ class ServerReplica:
             self.applied[g] = max(self.applied[g], int(fl))
         for k, s in meta.get("wslots", {}).items():
             self._wslot[k] = max(self._wslot.get(k, -1), int(s))
+        for entry in meta.get("ranges", []):
+            # adopted range installs are snapshot state like the KV they
+            # moved: restore the override table + idempotency set
+            self._range_adopted.add(int(entry["rc_id"]))
+            self.rangetab.install(entry)
+        for ch in meta.get("rseals", []):
+            # sealed-but-unadopted at snapshot time: re-seal (fresh
+            # sealed_at — the cutover clock restarts with the process)
+            if int(ch["rc_id"]) not in self._range_adopted:
+                ch = dict(ch)
+                ch["sealed_at"] = time.monotonic()
+                self._range_sealed[int(ch["rc_id"])] = ch
         for g, rows in enumerate(meta.get("ep_rows", [])[: self.G]):
             ex = self._ep_exec.get(g)
             if ex is not None:
@@ -781,6 +832,16 @@ class ServerReplica:
                         )
                     self.payloads.note_seen(g, vid)
                     self._logged_vids[g].add(vid)
+            elif isinstance(rec, tuple) and rec and rec[0] == "rseal":
+                # a range sealed before the crash and (as far as this WAL
+                # knows) never adopted: re-seal it so recovery cannot
+                # admit ops the pre-crash replica was already refusing.
+                # A later adopt record (ours or a manager re-announce)
+                # clears it exactly as it would have live.
+                ch = dict(rec[1])
+                if int(ch["rc_id"]) not in self._range_adopted:
+                    ch["sealed_at"] = time.monotonic()
+                    self._range_sealed[int(ch["rc_id"])] = ch
             elif isinstance(rec, tuple) and rec and rec[0] == "eapply":
                 # EPaxos exec record: replay in logged (= execution)
                 # order; per-row floors advance contiguously
@@ -801,10 +862,35 @@ class ServerReplica:
                 self.payloads.install(g, vid, batch)
                 if batch is not None and slot >= self.applied[g]:
                     for client, req in batch:
-                        if req.cmd is not None:
-                            apply_command(self.statemach._kv, req.cmd)
-                            if req.cmd.kind == "put":
-                                self._wslot[req.cmd.key] = slot
+                        if req.cmd is None:
+                            continue
+                        if req.cmd.kind == "adopt":
+                            # replicated range adoption replays exactly
+                            # like it applied live (idempotent per rc_id)
+                            self._apply_adopt(
+                                req.cmd.value, announce=False,
+                                recovery=True,
+                            )
+                            continue
+                        if req.cmd.kind == "put":
+                            ent = self.rangetab.lookup(req.cmd.key)
+                            if ent is not None and \
+                                    int(ent["group"]) % self.G != g:
+                                floors = ent.get("floors") or []
+                                fg = int(floors[g]) if g < len(floors) \
+                                    else 0
+                                if slot < fg:
+                                    # straggler below the handoff floor:
+                                    # its value already rode the adopt
+                                    # snapshot — re-applying would
+                                    # regress the moved key
+                                    continue
+                        apply_command(self.statemach._kv, req.cmd)
+                        if req.cmd.kind == "put":
+                            k = req.cmd.key
+                            self._wslot[k] = max(
+                                self._wslot.get(k, -1), slot
+                            )
                 self.applied[g] = max(self.applied[g], slot + 1)
             off = res.end_offset
             n += 1
@@ -990,6 +1076,14 @@ class ServerReplica:
             # replica report wslot -1 for keys it actually holds NEWER
             # values of, letting a lagging peer's older value win
             "wslots": dict(self._wslot),
+            # live resharding: adopted range installs travel with the KV
+            # they moved; still-sealed changes re-seal on recovery
+            "ranges": self.rangetab.entries(),
+            "rseals": [
+                {k: ch[k] for k in
+                 ("rc_id", "op", "start", "end", "dst_group")}
+                for ch in self._range_sealed.values()
+            ],
         }
         if self._epaxos:
             meta["ep_rows"] = [
@@ -1252,8 +1346,12 @@ class ServerReplica:
         where the fused serving path reads it."""
         ok = False
         if req.cmd is not None and req.cmd.kind == "get":
-            g = self.group_of(req.cmd.key)
-            if self._is_leader[g]:
+            g = self.route_group(req.cmd.key)
+            if self._range_sealed_for(req.cmd.key) is not None:
+                # mid-cutover: the range is sealed here — no local read
+                # can be proven fresh against the adopting group
+                ok = False
+            elif self._is_leader[g]:
                 ok = self._leader_read_ok(g) and not self._tail_writes_key(
                     g, req.cmd.key
                 )
@@ -1298,12 +1396,20 @@ class ServerReplica:
         vbase = np.zeros((self.G,), np.int32)
         piggy: Dict[Tuple[int, int], Any] = {}
         batch = self.external.get_req_batch(timeout=0)
-        if not batch:
+        if not batch and not self._range_adopt_ready:
             if self._epaxos and any(self._ep_defer.values()):
                 # deferred buckets must drain even on idle intake ticks
                 return self._intake_epaxos({}, n_prop, vbase, piggy)
             return n_prop, vbase, piggy
         by_group: Dict[int, list] = {}
+        if self._range_adopt_ready:
+            # barrier-cleared range adoptions enter the DESTINATION
+            # group's log like any write (client None = internal; if
+            # leadership moved, the non-leader path below drops it and
+            # _range_progress re-proposes after its mark expires)
+            for g, areq in self._range_adopt_ready:
+                by_group.setdefault(g, []).append((None, areq))
+            self._range_adopt_ready = []
         for client, req in batch:
             if req.kind == "conf":
                 self._handle_conf_req(client, req)
@@ -1316,8 +1422,20 @@ class ServerReplica:
                 for prid, cmd in (req.batch or ()):
                     if cmd is None:
                         continue
+                    if self._range_sealed_for(cmd.key) is not None:
+                        # mid-cutover seal: refuse BEFORE proposal, so a
+                        # shed op can never have been acked (the same
+                        # guarantee the bounded-queue shed gives, and the
+                        # proxy relays it per prid)
+                        self._reply(client, ApiReply(
+                            "shed", req_id=int(prid), success=False,
+                            retry_after_ms=50,
+                        ))
+                        self.metrics.counter_add("api_shed", 1)
+                        continue
+                    self._range_heat.note(cmd.key)
                     by_group.setdefault(
-                        self.group_of(cmd.key), []
+                        self.route_group(cmd.key), []
                     ).append((client, ApiRequest(
                         "req", req_id=int(prid), cmd=cmd,
                     )))
@@ -1329,9 +1447,16 @@ class ServerReplica:
                 self._reply(client, ApiReply(
                     "error", req_id=req.req_id, success=False,
                 ))
+            elif self._range_sealed_for(req.cmd.key) is not None:
+                self._reply(client, ApiReply(
+                    "shed", req_id=req.req_id, success=False,
+                    retry_after_ms=50,
+                ))
+                self.metrics.counter_add("api_shed", 1)
             else:
+                self._range_heat.note(req.cmd.key)
                 by_group.setdefault(
-                    self.group_of(req.cmd.key), []
+                    self.route_group(req.cmd.key), []
                 ).append((client, req))
         if self._epaxos:
             return self._intake_epaxos(by_group, n_prop, vbase, piggy)
@@ -1341,6 +1466,11 @@ class ServerReplica:
                 pending = []
                 local_ok = self._can_local_read(g)
                 for client, req in reqs:
+                    if client is None:
+                        # internal adopt proposal and we no longer lead
+                        # the destination: drop — re-proposed by
+                        # _range_progress once its mark expires
+                        continue
                     if local_ok and req.cmd.kind == "get":
                         res = apply_command(self.statemach._kv, req.cmd)
                         self._reply(client, ApiReply(
@@ -1389,7 +1519,8 @@ class ServerReplica:
             # that joins the request span to the slot span at export.
             self.traces.maybe_start(
                 g, vid, self.tick, time.monotonic(),
-                client=reqs[0][0], req_id=reqs[0][1].req_id,
+                client=-1 if reqs[0][0] is None else reqs[0][0],
+                req_id=reqs[0][1].req_id,
             )
             n_prop[g] = 1
             vbase[g] = vid
@@ -1502,6 +1633,46 @@ class ServerReplica:
                     and req.cmd.key == key
                 ):
                     return True
+        return False
+
+    def _tail_writes_range(self, ch: dict) -> bool:
+        """Does ANY group's voted-but-unexecuted tail possibly hold a
+        write inside the sealed range ``ch``?  The adopt barrier: the
+        handoff snapshot is only complete once every straggler the seal
+        raced has executed (same conservative rules as
+        ``_tail_writes_key``, over a key-range predicate and all
+        groups — the flat per-process KV means any group's tail could
+        still touch the range).  Kernel families mark votes in
+        different leaves (ballot families in ``win_bal``, the raft
+        family in ``win_term``); a family with neither (epaxos' 2-D
+        instance space has no linear window at all) is uninspectable
+        and the barrier stays conservatively closed until the adopt
+        mark expires."""
+        start, end = ch["start"], ch.get("end")
+        marker_leaf = next(
+            (k for k in ("win_bal", "win_term") if k in self.state), None
+        )
+        if marker_leaf is None or "win_abs" not in self.state:
+            return True
+        for g in range(self.G):
+            win_abs = self._np_state("win_abs")[g, self.me]
+            win_mark = self._np_state(marker_leaf)[g, self.me]
+            win_val = self._np_state(self.kernel.VALUE_WINDOW)[g, self.me]
+            tail = (win_mark > 0) & (win_abs >= self.applied[g])
+            for vid in set(int(v) for v in win_val[tail]):
+                if vid == 0:
+                    continue
+                batch = self.payloads.get(g, vid)
+                if batch is None:
+                    return True  # can't inspect: be conservative
+                for _c, req in batch:
+                    if (
+                        req.cmd is not None
+                        and req.cmd.kind == "put"
+                        and req.cmd.key >= start
+                        and (end is None or req.cmd.key < end)
+                    ):
+                        return True
         return False
 
     def _local_read_sample(self, g: int, key: str) -> Tuple[Any, int, bool]:
@@ -1732,6 +1903,133 @@ class ServerReplica:
                     "conf", req_id=a["req_id"], success=False,
                 ))
             self._conf_active = None
+
+    # ------------------------------------------------- live resharding
+    def _range_begin(self, ch: dict, replayed: bool = False) -> None:
+        """Seal a range for cutover (the revoke half of revoke-then-
+        adopt): from this point no new op on the range is admitted —
+        shed at intake, never silently dropped — until the destination
+        group's adopt applies.  The seal is WAL-durable so a crashed
+        replica cannot resurrect admitting (``replayed`` installs skip
+        the append: the manager re-announces pending changes to every
+        rejoiner)."""
+        rc_id = int(ch.get("rc_id", 0))
+        if rc_id in self._range_adopted or rc_id in self._range_sealed:
+            return
+        if self._epaxos:
+            # leaderless: no single commit-slot barrier to drain against
+            # — refuse the cutover (the ctrl reply still flows, so the
+            # manager sees the op answered rather than hung)
+            pf_warn(logger, f"range_change {rc_id} refused: leaderless "
+                            "protocol has no seal barrier")
+            return
+        ch = dict(ch)
+        ch["sealed_at"] = time.monotonic()
+        self._range_sealed[rc_id] = ch
+        if not replayed:
+            self._wal_append(("rseal", {
+                k: ch[k]
+                for k in ("rc_id", "op", "start", "end", "dst_group")
+            }))
+        self.flight.record(
+            "range_seal", rc_id=rc_id, op=str(ch.get("op")),
+            tick=self.tick,
+        )
+
+    def _range_progress(self) -> None:
+        """Propose adoption for sealed ranges whose barrier cleared: we
+        must lead the destination group and no voted-but-unexecuted
+        tail write to the range may remain in ANY group (the commit-
+        slot barrier) — then the range-filtered KV, write-slot
+        watermarks, and per-group apply floors ride ONE ``adopt``
+        command through the destination group's own log, making the
+        cutover itself replicated and recoverable."""
+        if not self._range_sealed or self._epaxos:
+            return
+        for rc_id in sorted(self._range_sealed):
+            ch = self._range_sealed[rc_id]
+            dst = int(ch["dst_group"]) % self.G
+            if not bool(self._is_leader[dst]):
+                continue
+            mark = self._range_adopt_mark.get(rc_id)
+            if mark is not None and self.tick - mark < 400:
+                # an adopt is in flight (or recently lost to a leader
+                # change); adoption is idempotent, so a re-propose after
+                # the mark expires is safe even if both land
+                continue
+            if self._tail_writes_range(ch):
+                continue
+            start, end = ch["start"], ch.get("end")
+
+            def _inr(k: str) -> bool:
+                return k >= start and (end is None or k < end)
+
+            val = {
+                "rc_id": rc_id, "op": ch.get("op", "split"),
+                "start": start, "end": end, "dst_group": dst,
+                "kv": {
+                    k: v for k, v in self.statemach._kv.items()
+                    if _inr(k)
+                },
+                "wslots": {
+                    k: s for k, s in self._wslot.items() if _inr(k)
+                },
+                "floors": list(self.applied),
+            }
+            self._range_adopt_ready.append((dst, ApiRequest(
+                "req", req_id=0,
+                cmd=Command("adopt", key=f"__adopt__{rc_id}", value=val),
+            )))
+            self._range_adopt_mark[rc_id] = self.tick
+
+    def _apply_adopt(self, val: Any, announce: bool,
+                     recovery: bool = False) -> None:
+        """Execute an ``adopt`` command at its destination-group slot:
+        install the range override, merge the handed-off KV + write-slot
+        watermarks, unseal, and (at the proposer, live only) notify the
+        manager so proxies and late joiners learn the install.
+        Idempotent per rc_id — a duplicate adopt from a re-propose race
+        is a no-op."""
+        val = dict(val or {})
+        rc_id = int(val.get("rc_id", 0))
+        if rc_id in self._range_adopted:
+            return
+        self._range_adopted.add(rc_id)
+        entry = {
+            "rc_id": rc_id, "op": val.get("op", "split"),
+            "start": val["start"], "end": val.get("end"),
+            "group": int(val.get("dst_group", 0)) % self.G,
+            "floors": [int(f) for f in (val.get("floors") or [])],
+        }
+        self.rangetab.install(entry)
+        kv = dict(val.get("kv") or {})
+        self.statemach._kv.update(kv)
+        for k, v in kv.items():
+            # moved keys re-enter the commit feed so read-tier learners
+            # converge on the post-cutover placement's values
+            self._note_put(k, v)
+        for k, s in (val.get("wslots") or {}).items():
+            self._wslot[k] = max(self._wslot.get(k, -1), int(s))
+        sealed = self._range_sealed.pop(rc_id, None)
+        self._range_adopt_mark.pop(rc_id, None)
+        self.metrics.counter_add(
+            "reshard_splits" if entry["op"] == "split"
+            else "reshard_merges", 1,
+        )
+        if sealed is not None and not recovery \
+                and "sealed_at" in sealed:
+            self.metrics.observe(
+                "reshard_cutover_us",
+                int((time.monotonic() - sealed["sealed_at"]) * 1e6),
+            )
+        self.flight.record(
+            "range_adopt", rc_id=rc_id, op=str(entry["op"]),
+            dst=entry["group"], keys=len(kv), tick=self.tick,
+        )
+        if announce and not recovery:
+            self.ctrl.send_ctrl(CtrlMsg(
+                "range_installed", {"entry": entry}
+            ))
 
     # --------------------------------------------------------- main loop
     def run(self) -> bool:
@@ -2054,6 +2352,7 @@ class ServerReplica:
         self._flush_durability()
         self._qread_expire()
         self._conf_progress()
+        self._range_progress()
         self._leader_edges(fx)
         self._health_tick()
         _stage("apply")  # apply + reply
@@ -2158,6 +2457,7 @@ class ServerReplica:
         self._ingest_payloads(got)
         self._qread_expire()
         self._conf_progress()
+        self._range_progress()
         self._health_tick()
         _stage("overlap")
 
@@ -2371,7 +2671,7 @@ class ServerReplica:
         if not ok_groups:
             return
         upd = {
-            k: v for k, v in kv.items() if self.group_of(k) in ok_groups
+            k: v for k, v in kv.items() if self.route_group(k) in ok_groups
         }
         self.statemach._kv.update(upd)
         # install-snapshot jumps bypass the per-slot apply loop, so the
@@ -2531,10 +2831,49 @@ class ServerReplica:
             if batch is not None:
                 mine = (g, vid) in self.origin
                 for client, req in batch:
+                    if req.cmd is not None and req.cmd.kind == "adopt":
+                        # replicated range adoption: executes at its
+                        # destination-group slot on every replica; only
+                        # the proposer announces to the manager
+                        self._apply_adopt(req.cmd.value, announce=mine)
+                        continue
+                    if req.cmd.kind == "put":
+                        ent = self.rangetab.lookup(req.cmd.key)
+                        if ent is not None \
+                                and int(ent["group"]) % self.G != g:
+                            # a write to a moved-away range surfacing in
+                            # its OLD group's log: below the handoff
+                            # floor its value already rode the adopt
+                            # snapshot — ack without applying (applying
+                            # would regress the moved key); above the
+                            # floor is unreachable given seal + barrier,
+                            # but if it ever fires, never lose the ack
+                            floors = ent.get("floors") or []
+                            fg = int(floors[g]) if g < len(floors) else 0
+                            if slot < fg:
+                                if mine:
+                                    self._reply_queue.append((
+                                        client, ApiReply(
+                                            "reply", req_id=req.req_id,
+                                            result=CommandResult("put"),
+                                        ),
+                                    ))
+                                continue
+                            pf_warn(
+                                logger,
+                                f"post-floor write to moved range at "
+                                f"g{g} slot {slot} key "
+                                f"{req.cmd.key!r}: applying",
+                            )
                     res = apply_command(self.statemach._kv, req.cmd)
                     if req.cmd.kind == "put":
-                        self._wslot[req.cmd.key] = slot
-                        self._note_put(req.cmd.key, req.cmd.value)
+                        k = req.cmd.key
+                        # monotone across group moves: the handed-off
+                        # watermark may exceed this group's slot numbers
+                        self._wslot[k] = max(
+                            slot, self._wslot.get(k, -1) + 1
+                        )
+                        self._note_put(k, req.cmd.value)
                     if mine:
                         self._reply_queue.append((client, ApiReply(
                             "reply", req_id=req.req_id, result=res,
@@ -2750,6 +3089,31 @@ class ServerReplica:
                     self._conf_queue.append((None, ApiRequest(
                         "conf", conf_delta=d,
                     )))
+        elif msg.kind == "range_change":
+            # live resharding seal (host/resharding.py): every replica
+            # seals immediately; the adopting leader proposes the adopt
+            # once the barrier clears.  Always ack — a refused change
+            # (leaderless protocol) still answers the manager's fan-out.
+            self._range_begin(dict(msg.payload.get("change") or {}))
+            self.ctrl.send_ctrl(CtrlMsg("range_reply"))
+        elif msg.kind == "install_ranges":
+            # manager re-announce (late joiners + fan-out stragglers),
+            # newest-seq-wins like install_conf.  Installed entries land
+            # WITHOUT their KV data — the moved keys reach this replica
+            # through its own adopt apply or the install-snapshot plane.
+            seq = int(msg.payload.get("seq", 0))
+            if seq > self._range_seq_seen:
+                self._range_seq_seen = seq
+                for entry in msg.payload.get("installed", []):
+                    rc_id = int(entry["rc_id"])
+                    if rc_id not in self._range_adopted:
+                        self._range_adopted.add(rc_id)
+                        self.rangetab.install(entry)
+                        self._range_sealed.pop(rc_id, None)
+                        self._range_adopt_mark.pop(rc_id, None)
+                for ch in msg.payload.get("pending", []):
+                    if int(ch.get("rc_id", 0)) not in self._range_adopted:
+                        self._range_begin(dict(ch), replayed=True)
         elif msg.kind == "fault_ctl":
             # nemesis fault injection (host/nemesis.py): swap the message-
             # plane and/or disk-plane fault specs.  A key present with a
@@ -2835,6 +3199,14 @@ class ServerReplica:
             self.metrics.gauge_set("pp_bytes", self.pp_bytes[dst], peer=dst)
             self.metrics.gauge_set("pp_items", self.pp_items[dst], peer=dst)
             self.metrics.gauge_set("cw_bytes", self.cw_bytes[dst], peer=dst)
+        # per-key-range heat at the api seam: top-K as labeled gauges
+        # (the ResharderPolicy's input when driving a fused cluster)
+        # plus the bare total
+        self.metrics.gauge_set(
+            "range_heat", float(self._range_heat.total())
+        )
+        for k, n in self._range_heat.top(8):
+            self.metrics.gauge_set("range_heat", float(n), key=k)
         return {
             "me": self.me,
             "protocol": self.protocol,

@@ -329,6 +329,12 @@ DECLARED = (
     "commits_applied_total",
     "pp_bytes",
     "pp_items",
+    # live resharding (host/resharding.py): per-key-range heat at the
+    # api seam, executed split/merge cutovers, and seal->adopt latency
+    "range_heat",
+    "reshard_splits",
+    "reshard_merges",
+    "reshard_cutover_us",
 )
 
 # canonical metric names every INGRESS PROXY (host/ingress.py) must
@@ -354,4 +360,5 @@ PROXY_DECLARED = (
     "proxy_backlog",         # internal forward backlog depth gauge
     "read_tier_served",      # gets served from the learner's state
     "read_tier_backlog",     # in-flight freshness probes gauge
+    "range_heat",            # per-key-range heat at the proxy seam
 )

@@ -96,6 +96,11 @@ EVENT_TYPES = (
     "crash",         # supervisor-observed crash (error)
     "restart",       # bring-up recovery completed (wal records, applied
                      # floor; cold=True means first boot, empty backer)
+    "range_seal",    # live resharding: a key range sealed for cutover
+                     # (rc_id, op, tick) — ops on it shed until adopted
+    "range_adopt",   # live resharding: the destination group applied
+                     # the adopt (rc_id, op, dst, keys, tick) — the
+                     # cutover instant on the exported ctrl track
 )
 _EVENT_SET = frozenset(EVENT_TYPES)
 

@@ -13,9 +13,12 @@ addresses of lower-id peers (the reference's proactive-connect rule,
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
 from ..host.messages import CtrlMsg, CtrlReply, CtrlRequest
+from ..host.resharding import RangeChange
+from ..utils.errors import SummersetError
 from ..utils import safetcp
 from ..utils.logging import pf_info, pf_logger, pf_warn, set_me
 from ..utils.timer import Timer
@@ -73,6 +76,13 @@ class ClusterManager:
         # relayed still observes it (receivers apply newest-seq-wins, so
         # the replay can never regress a fresher conf)
         self._conf_last: Optional[dict] = None
+        # live resharding (host/resharding.py): rc_id assignment plus the
+        # installed/pending range sets, re-announced to proxies via
+        # query_info and to (late-joining) servers via install_ranges —
+        # the same newest-seq-wins contract as install_conf
+        self._range_seq = 0
+        self._ranges_installed: Dict[int, dict] = {}
+        self._ranges_pending: Dict[int, dict] = {}
         # kind -> list of waiter queues: every waiter sees every reply of
         # that kind (and filters by sid), so concurrent ctrl clients can't
         # steal each other's acks
@@ -165,6 +175,17 @@ class ClusterManager:
                     )
                 except (ConnectionError, OSError):
                     pass
+            if self._ranges_installed or self._ranges_pending:
+                # same late-joiner contract for range installs: a server
+                # re-joining after a RangeChange must converge on the
+                # installed range table (and re-seal still-pending ones)
+                try:
+                    await safetcp.send_msg(
+                        conn.writer,
+                        CtrlMsg("install_ranges", self._ranges_payload()),
+                    )
+                except (ConnectionError, OSError):
+                    pass
             pf_info(logger, f"server {conn.sid} joined")
         elif msg.kind == "leader_status":
             if p.get("step_up"):
@@ -198,6 +219,33 @@ class ClusterManager:
                         pass
             pf_info(logger, f"conf relayed (seq {self._conf_seq}): "
                             f"{p.get('delta')}")
+        elif msg.kind == "range_installed":
+            # the adopting proposer's notice that a RangeChange finished
+            # its cutover; move it pending -> installed and re-announce
+            # the whole table (newest-seq-wins at receivers) so every
+            # server — including ones that missed the original fan-out —
+            # converges on the same installed set
+            entry = dict(p.get("entry") or {})
+            rc_id = int(entry.get("rc_id", 0))
+            fresh = rc_id not in self._ranges_installed
+            self._ranges_pending.pop(rc_id, None)
+            self._ranges_installed[rc_id] = entry
+            if fresh:
+                self._range_seq += 1
+                payload = self._ranges_payload()
+                for s in list(self.servers.values()):
+                    if s.joined and not s.writer.is_closing():
+                        try:
+                            await safetcp.send_msg(
+                                s.writer,
+                                CtrlMsg("install_ranges", payload),
+                            )
+                        except (ConnectionError, OSError):
+                            pass
+                pf_info(logger, f"range {rc_id} installed: "
+                                f"[{entry.get('start')!r}, "
+                                f"{entry.get('end')!r}) -> "
+                                f"group {entry.get('group')}")
         elif msg.kind == "snapshot_up_to":
             pf_info(
                 logger,
@@ -205,7 +253,7 @@ class ClusterManager:
             )
         elif msg.kind in (
             "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
-            "fault_reply", "metrics_reply", "flight_reply",
+            "fault_reply", "metrics_reply", "flight_reply", "range_reply",
         ):
             # waiters get (sid, payload): orchestration kinds ignore the
             # payload, gather kinds (metrics_reply) collect it per sid
@@ -237,6 +285,22 @@ class ClusterManager:
             # pop here IS the deregistration clients rediscover through
             if self.proxies.pop(cid, None) is not None:
                 pf_warn(logger, f"proxy {cid} deregistered")
+
+    def _ranges_payload(self) -> dict:
+        """install_ranges payload: full installed + pending sets under a
+        monotone seq (receivers apply newest-seq-wins, the install_conf
+        convergence rule)."""
+        return {
+            "seq": self._range_seq,
+            "installed": [
+                self._ranges_installed[k]
+                for k in sorted(self._ranges_installed)
+            ],
+            "pending": [
+                self._ranges_pending[k]
+                for k in sorted(self._ranges_pending)
+            ],
+        }
 
     def _targets(self, req: CtrlRequest):
         ids = req.servers
@@ -394,6 +458,10 @@ class ClusterManager:
                 },
                 leader=self.leader,
                 proxies=dict(self.proxies),
+                ranges=[
+                    self._ranges_installed[k]
+                    for k in sorted(self._ranges_installed)
+                ],
             )
         if req.kind == "proxy_join":
             # ingress-proxy registration (host/ingress.py): the proxy's
@@ -423,6 +491,27 @@ class ClusterManager:
             return await self._fanout_wait(
                 "fault_ctl", "fault_reply", req, extra=req.payload
             )
+        if req.kind == "range_change":
+            # live resharding: validate, assign the rc_id, fan the seal
+            # to EVERY server (each replica of the source group must stop
+            # admitting ops for the range before the destination adopts),
+            # and await their acks; adoption then rides the destination
+            # group's own log asynchronously — the reply means "sealed
+            # everywhere reachable", with conf carrying the rc_id for the
+            # caller to poll installation via query_info
+            try:
+                change = RangeChange.from_payload(dict(req.payload or {}))
+            except SummersetError as e:
+                pf_warn(logger, f"range_change refused: {e}")
+                return CtrlReply("error")
+            self._range_seq += 1
+            change = dataclasses.replace(change, rc_id=self._range_seq)
+            self._ranges_pending[change.rc_id] = change.as_dict()
+            reply = await self._fanout_wait(
+                "range_change", "range_reply", req,
+                extra={"change": change.as_dict()},
+            )
+            return dataclasses.replace(reply, conf={"rc_id": change.rc_id})
         if req.kind == "metrics_dump":
             # telemetry scrape: gather each live server's snapshot
             # (device metric lanes + host registry + sampled traces)

@@ -76,6 +76,41 @@ class TestRoutingTable:
     def test_declared_proxy_series_unique(self):
         assert len(PROXY_DECLARED) == len(set(PROXY_DECLARED))
 
+    def test_set_owner_same_span_replaces(self):
+        rt = RoutingTable()
+        rt.update({0: ("h", 1), 1: ("h", 2)}, leader=0)
+        rt.set_owner("a", "b", 1)
+        rt.set_owner("a", "b", 0)
+        rt.set_owner("a", "b", 1)
+        # re-setting the same span replaces the entry instead of
+        # growing the override list without bound
+        assert len(rt._overrides) == 1
+        assert rt.owner_for("a") == 1
+
+    def test_dead_sid_override_falls_back(self):
+        rt = RoutingTable()
+        rt.update({0: ("h", 1), 1: ("h", 2)}, leader=0)
+        rt.set_owner("a", "b", 1)
+        assert rt.owner_for("a") == 1
+        # the override's owner drops out of the address book: its range
+        # must fall back to the default, not wedge on an unreachable sid
+        rt.update({0: ("h", 1)}, leader=0)
+        assert rt.owner_for("a") == 0
+        # ...and come back once the owner rejoins
+        rt.update({0: ("h", 1), 1: ("h", 2)}, leader=0)
+        assert rt.owner_for("a") == 1
+
+    def test_installed_ranges_below_manual_overrides(self):
+        rt = RoutingTable()
+        rt.update({0: ("h", 1), 1: ("h", 2), 2: ("h", 3)}, leader=0)
+        rt.set_ranges([("a", "c", 1), ("c", "d", 2)])
+        assert rt.owner_for("b") == 1 and rt.owner_for("c") == 2
+        rt.set_owner("a", "b", 2)  # manual override wins
+        assert rt.owner_for("a") == 2 and rt.owner_for("b") == 1
+        v = rt.version
+        rt.set_ranges([("a", "c", 1), ("c", "d", 2)])  # unchanged
+        assert rt.version == v  # refresh loop must not churn versions
+
 
 class _FakeProxy:
     """Duck-typed IngressProxy core for LearnerReadTier unit tests."""
@@ -371,6 +406,54 @@ class TestLiveProxyServing:
             for p in plane.proxies if p is not None
         )
         assert after == before + 1
+        ep.leave()
+
+    def test_range_override_steers_forwarded_batches(
+        self, proxied_cluster,
+    ):
+        """A per-range owner override must actually steer forwarded
+        batches — live: the op forwards to the overridden (follower)
+        sid first, survives the redirect retry, AND the override holds
+        across the 0.5s routing refresh (which rebuilds the table and
+        folds in manager-announced ranges below manual overrides)."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.host.messages import CtrlRequest
+
+        cluster, plane = proxied_cluster
+        ep = _fresh_ep(cluster)
+        assert ep.proxy_mode
+        info = ep.ctrl.request(CtrlRequest("query_info"))
+        leader = info.leader if info.leader is not None else 0
+        follower = next(
+            s for s in sorted(info.servers) if s != leader
+        )
+        live = [p for p in plane.proxies if p is not None]
+
+        def fwd_to(sid):
+            return sum(
+                1 for p in live
+                for e in p.flight.dump()["events"]
+                if e["type"] == "proxy_fwd" and e.get("sid") == sid
+            )
+
+        before = fwd_to(follower)
+        for p in live:
+            p.routing.set_owner("ovq", "ovr", follower)
+        # cross at least one refresh cycle: the refresher rebuilds the
+        # table (leader + installed ranges) and must NOT flush the
+        # manual override — the dormant-override regression
+        time.sleep(0.8)
+        assert all(
+            p.routing.owner_for("ovq1") == follower for p in live
+        )
+        drv = DriverClosedLoop(ep, timeout=10.0)
+        drv.checked_put("ovq1", "steered")   # in ["ovq", "ovr")
+        drv.checked_get("ovq1", expect="steered")
+        # the forward went to the overridden sid (then the shard's
+        # redirect hint bounced it to the leader — op still completed)
+        assert fwd_to(follower) > before
+        for p in live:   # steer back: later tests use default routing
+            p.routing.set_owner("ovq", "ovr", leader)
         ep.leave()
 
     def test_commit_feed_subscribe_note_probe(self, proxied_cluster):

@@ -47,6 +47,12 @@ def _skeleton(tmp_path, me=0, G=1, R=3, W=32):
     rep._wslot = {}
     rep._ep_exec = {}
     rep._epaxos = False
+    # live-resharding recovery state: WAL replay consults the range
+    # table for straggler floor-filtering and re-seals rseal records
+    from summerset_tpu.host.resharding import RangeTable
+    rep.rangetab = RangeTable()
+    rep._range_sealed = {}
+    rep._range_adopted = set()
     rep.codewords = None
     rep._logged_vids = {g: set() for g in range(G)}
     rep._logged_keys = np.empty(0, np.int64)
