@@ -228,9 +228,10 @@ class ResharderPolicy:
             if n > self.cold_frac * total:
                 continue
             home = self.hash_group(key)
-            if self._moved[key] == home:
-                continue
             start, end = single_key_range(key)
-            self._moved[key] = home
+            # forget the key entirely: a merged-back key that re-heats
+            # must be eligible for a future split (leaving it in _moved
+            # mapped to its hash-home would pin it forever)
+            del self._moved[key]
             return RangeChange("merge", start, end, home)
         return None

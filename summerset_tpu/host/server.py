@@ -471,10 +471,17 @@ class ServerReplica:
         # change dict + sealed_at), adopted rc_ids (idempotency), the
         # newest install_ranges seq seen, adopt re-propose marks (tick of
         # last proposal per rc_id), the adopt proposals awaiting intake,
-        # and per-key heat at the api seam
+        # and per-key heat at the api seam.  _range_adopted means the
+        # adopt command EXECUTED here (its KV/wslot merge happened);
+        # _range_override means only the routing override was learned
+        # from a manager re-announce — the replicated adopt at this
+        # replica's destination-group slot must still merge, so the two
+        # sets are kept strictly apart (conflating them skipped the
+        # merge and permanently diverged re-announced replicas)
         self.rangetab = RangeTable()
         self._range_sealed: Dict[int, dict] = {}
         self._range_adopted: Set[int] = set()
+        self._range_override: Set[int] = set()
         self._range_seq_seen = 0
         self._range_adopt_mark: Dict[int, int] = {}
         self._range_adopt_ready: List[Tuple[int, ApiRequest]] = []
@@ -750,15 +757,27 @@ class ServerReplica:
             self.applied[g] = max(self.applied[g], int(fl))
         for k, s in meta.get("wslots", {}).items():
             self._wslot[k] = max(self._wslot.get(k, -1), int(s))
+        # pre-fix snapshots carried no radopted list: every install was a
+        # local adoption then, so default to treating all of them as such
+        radopted = meta.get("radopted")
+        radopted = None if radopted is None else {int(r) for r in radopted}
         for entry in meta.get("ranges", []):
-            # adopted range installs are snapshot state like the KV they
-            # moved: restore the override table + idempotency set
-            self._range_adopted.add(int(entry["rc_id"]))
+            # range installs are snapshot state like the KV they moved:
+            # restore the override table, and restore each rc_id into
+            # the SAME idempotency set it lived in — an override-only
+            # install must leave the adopt replay free to merge
+            rc_id = int(entry["rc_id"])
+            if radopted is None or rc_id in radopted:
+                self._range_adopted.add(rc_id)
+            else:
+                self._range_override.add(rc_id)
             self.rangetab.install(entry)
         for ch in meta.get("rseals", []):
             # sealed-but-unadopted at snapshot time: re-seal (fresh
-            # sealed_at — the cutover clock restarts with the process)
-            if int(ch["rc_id"]) not in self._range_adopted:
+            # sealed_at — the cutover clock restarts with the process;
+            # seal-complete is re-learned from the manager re-announce)
+            if int(ch["rc_id"]) not in self._range_adopted \
+                    and int(ch["rc_id"]) not in self._range_override:
                 ch = dict(ch)
                 ch["sealed_at"] = time.monotonic()
                 self._range_sealed[int(ch["rc_id"])] = ch
@@ -839,7 +858,8 @@ class ServerReplica:
                 # A later adopt record (ours or a manager re-announce)
                 # clears it exactly as it would have live.
                 ch = dict(rec[1])
-                if int(ch["rc_id"]) not in self._range_adopted:
+                if int(ch["rc_id"]) not in self._range_adopted \
+                        and int(ch["rc_id"]) not in self._range_override:
                     ch["sealed_at"] = time.monotonic()
                     self._range_sealed[int(ch["rc_id"])] = ch
             elif isinstance(rec, tuple) and rec and rec[0] == "eapply":
@@ -1077,8 +1097,13 @@ class ServerReplica:
             # values of, letting a lagging peer's older value win
             "wslots": dict(self._wslot),
             # live resharding: adopted range installs travel with the KV
-            # they moved; still-sealed changes re-seal on recovery
+            # they moved; still-sealed changes re-seal on recovery.
+            # radopted marks which installs were true local adoptions
+            # (merge executed) vs re-announced overrides whose adopt
+            # slot is still ahead of the floor — recovery must keep the
+            # distinction or the replayed adopt skips its merge
             "ranges": self.rangetab.entries(),
+            "radopted": sorted(self._range_adopted),
             "rseals": [
                 {k: ch[k] for k in
                  ("rc_id", "op", "start", "end", "dst_group")}
@@ -1644,10 +1669,12 @@ class ServerReplica:
         groups — the flat per-process KV means any group's tail could
         still touch the range).  Kernel families mark votes in
         different leaves (ballot families in ``win_bal``, the raft
-        family in ``win_term``); a family with neither (epaxos' 2-D
-        instance space has no linear window at all) is uninspectable
-        and the barrier stays conservatively closed until the adopt
-        mark expires."""
+        family in ``win_term``); a family with neither is
+        uninspectable and reads as a permanent conservative hit —
+        ``_range_begin`` refuses the seal for those families up front
+        (and for epaxos' 2-D instance space, which has no linear
+        window at all), so an uninspectable seal never exists to
+        wedge."""
         start, end = ch["start"], ch.get("end")
         marker_leaf = next(
             (k for k in ("win_bal", "win_term") if k in self.state), None
@@ -1914,7 +1941,8 @@ class ServerReplica:
         the append: the manager re-announces pending changes to every
         rejoiner)."""
         rc_id = int(ch.get("rc_id", 0))
-        if rc_id in self._range_adopted or rc_id in self._range_sealed:
+        if rc_id in self._range_adopted or rc_id in self._range_sealed \
+                or rc_id in self._range_override:
             return
         if self._epaxos:
             # leaderless: no single commit-slot barrier to drain against
@@ -1922,6 +1950,18 @@ class ServerReplica:
             # manager sees the op answered rather than hung)
             pf_warn(logger, f"range_change {rc_id} refused: leaderless "
                             "protocol has no seal barrier")
+            return
+        if "win_abs" not in self.state or not any(
+            k in self.state for k in ("win_bal", "win_term")
+        ):
+            # no inspectable vote window (chain_rep / simple_push /
+            # rep_nothing mark votes in neither win_bal nor win_term):
+            # _tail_writes_range could never prove the tail drained, so
+            # the barrier would never clear and a sealed range would
+            # shed its ops FOREVER.  Refuse up front, exactly like the
+            # leaderless refusal, instead of sealing unadoptably.
+            pf_warn(logger, f"range_change {rc_id} refused: kernel "
+                            "family has no inspectable vote window")
             return
         ch = dict(ch)
         ch["sealed_at"] = time.monotonic()
@@ -1938,18 +1978,29 @@ class ServerReplica:
 
     def _range_progress(self) -> None:
         """Propose adoption for sealed ranges whose barrier cleared: we
-        must lead the destination group and no voted-but-unexecuted
-        tail write to the range may remain in ANY group (the commit-
-        slot barrier) — then the range-filtered KV, write-slot
-        watermarks, and per-group apply floors ride ONE ``adopt``
-        command through the destination group's own log, making the
-        cutover itself replicated and recoverable."""
+        must lead the destination group, the manager must have granted
+        seal-complete (EVERY server acked the seal fan-out — the local
+        vote window can't see a write a not-yet-sealed peer admitted),
+        and no voted-but-unexecuted tail write to the range may remain
+        in ANY group (the commit-slot barrier) — then the range-
+        filtered KV, write-slot watermarks, and per-group apply floors
+        ride ONE ``adopt`` command through the destination group's own
+        log, making the cutover itself replicated and recoverable."""
         if not self._range_sealed or self._epaxos:
             return
         for rc_id in sorted(self._range_sealed):
             ch = self._range_sealed[rc_id]
             dst = int(ch["dst_group"]) % self.G
             if not bool(self._is_leader[dst]):
+                continue
+            if not ch.get("sealed_ok"):
+                # cluster-wide seal unconfirmed: a server the fan-out
+                # has not reached yet could still admit (and commit) a
+                # write to the range above our barrier — adopting now
+                # would let the old group overwrite a newer destination
+                # write of a moved key after the cutover.  The manager
+                # re-announces the flag (install_ranges pending) once
+                # all acks are in; until then the range sheds.
                 continue
             mark = self._range_adopt_mark.get(rc_id)
             if mark is not None and self.tick - mark < 400:
@@ -1995,6 +2046,10 @@ class ServerReplica:
         if rc_id in self._range_adopted:
             return
         self._range_adopted.add(rc_id)
+        # a manager re-announce may have installed the routing override
+        # first; this is the real adoption (the merge below), so the
+        # override-only marker retires
+        self._range_override.discard(rc_id)
         entry = {
             "rc_id": rc_id, "op": val.get("op", "split"),
             "start": val["start"], "end": val.get("end"),
@@ -2846,7 +2901,8 @@ class ServerReplica:
                             # floor its value already rode the adopt
                             # snapshot — ack without applying (applying
                             # would regress the moved key); above the
-                            # floor is unreachable given seal + barrier,
+                            # floor is unreachable given the cluster-
+                            # wide seal confirmation + tail barrier,
                             # but if it ever fires, never lose the ack
                             floors = ent.get("floors") or []
                             fg = int(floors[g]) if g < len(floors) else 0
@@ -3101,18 +3157,37 @@ class ServerReplica:
             # newest-seq-wins like install_conf.  Installed entries land
             # WITHOUT their KV data — the moved keys reach this replica
             # through its own adopt apply or the install-snapshot plane.
+            # Crucially the re-announce installs only the routing
+            # OVERRIDE (and unseals): the rc_id is NOT marked adopted,
+            # so when the replicated adopt command later executes at
+            # this replica's destination-group slot, _apply_adopt still
+            # merges the handed-off KV/wslots.  Marking it adopted here
+            # made that merge a no-op, and a replica with unexecuted
+            # below-floor source slots then had NO path to the moved
+            # keys' committed values short of a full install-snapshot.
             seq = int(msg.payload.get("seq", 0))
             if seq > self._range_seq_seen:
                 self._range_seq_seen = seq
                 for entry in msg.payload.get("installed", []):
                     rc_id = int(entry["rc_id"])
-                    if rc_id not in self._range_adopted:
-                        self._range_adopted.add(rc_id)
+                    if rc_id not in self._range_adopted \
+                            and rc_id not in self._range_override:
+                        self._range_override.add(rc_id)
                         self.rangetab.install(entry)
                         self._range_sealed.pop(rc_id, None)
                         self._range_adopt_mark.pop(rc_id, None)
                 for ch in msg.payload.get("pending", []):
-                    if int(ch.get("rc_id", 0)) not in self._range_adopted:
+                    rc_id = int(ch.get("rc_id", 0))
+                    sealed = self._range_sealed.get(rc_id)
+                    if sealed is not None:
+                        # already sealed: only the seal-complete flag can
+                        # change (the manager grants it once every server
+                        # acked the seal fan-out — the adopt barrier's
+                        # cluster-wide half)
+                        if ch.get("sealed_ok"):
+                            sealed["sealed_ok"] = True
+                    elif rc_id not in self._range_adopted \
+                            and rc_id not in self._range_override:
                         self._range_begin(dict(ch), replayed=True)
         elif msg.kind == "fault_ctl":
             # nemesis fault injection (host/nemesis.py): swap the message-

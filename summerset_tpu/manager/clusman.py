@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..host.messages import CtrlMsg, CtrlReply, CtrlRequest
@@ -79,8 +80,14 @@ class ClusterManager:
         # live resharding (host/resharding.py): rc_id assignment plus the
         # installed/pending range sets, re-announced to proxies via
         # query_info and to (late-joining) servers via install_ranges —
-        # the same newest-seq-wins contract as install_conf
-        self._range_seq = 0
+        # the same newest-seq-wins contract as install_conf.  The seq is
+        # seeded from the wall clock, NOT 0: surviving servers keep their
+        # adopted-rc_id idempotency sets and newest-seq-seen watermarks
+        # across a manager restart, so a reborn manager restarting at 0
+        # would mint colliding rc_ids (seals silently skipped yet acked)
+        # and re-announce seqs below every survivor's watermark —
+        # resharding would silently stop converging
+        self._range_seq = int(time.time() * 1000)
         self._ranges_installed: Dict[int, dict] = {}
         self._ranges_pending: Dict[int, dict] = {}
         # kind -> list of waiter queues: every waiter sees every reply of
@@ -186,6 +193,13 @@ class ClusterManager:
                     )
                 except (ConnectionError, OSError):
                     pass
+            if any(not ch.get("sealed_ok")
+                   for ch in self._ranges_pending.values()):
+                # a pending cutover is still waiting for cluster-wide
+                # seal confirmation (a server was down during the
+                # original fan-out — possibly this very rejoiner):
+                # re-drive the seal now that the membership changed
+                asyncio.ensure_future(self._retry_pending_seals())
             pf_info(logger, f"server {conn.sid} joined")
         elif msg.kind == "leader_status":
             if p.get("step_up"):
@@ -301,6 +315,61 @@ class ClusterManager:
                 for k in sorted(self._ranges_pending)
             ],
         }
+
+    async def _announce_ranges(self) -> None:
+        """Fan the current install_ranges payload to every joined server
+        (fire-and-forget; receivers converge newest-seq-wins)."""
+        payload = self._ranges_payload()
+        for s in list(self.servers.values()):
+            if s.joined and not s.writer.is_closing():
+                try:
+                    await safetcp.send_msg(
+                        s.writer, CtrlMsg("install_ranges", payload)
+                    )
+                except (ConnectionError, OSError):
+                    pass
+
+    async def _maybe_seal_complete(self, rc_id: int, reply) -> None:
+        """Grant seal-complete for a pending RangeChange iff EVERY member
+        of the population acked the seal fan-out, then re-announce so the
+        adopting leader's barrier can clear (_range_progress gates on the
+        flag).  A partial fan-out must NOT clear it: an unreached server
+        is still admitting writes to the range, and an adopt proposed
+        against only the local vote window could let the old group
+        overwrite a newer destination-group write after the cutover."""
+        ch = self._ranges_pending.get(rc_id)
+        if ch is None or ch.get("sealed_ok"):
+            return
+        done = set(reply.done or ())
+        if len(done) < self.population:
+            pf_warn(
+                logger,
+                f"range {rc_id}: seal acked by {sorted(done)} of "
+                f"{self.population} — cutover held (sheds) until every "
+                "server seals",
+            )
+            return
+        ch["sealed_ok"] = True
+        self._range_seq += 1
+        await self._announce_ranges()
+        pf_info(logger, f"range {rc_id}: seal confirmed cluster-wide")
+
+    async def _retry_pending_seals(self) -> None:
+        """Re-drive the seal fan-out for pending RangeChanges still
+        missing cluster-wide confirmation (a server was down or
+        unreachable the first time).  Sealing is idempotent per rc_id and
+        every server always acks the fan-out, so re-fanning is safe; on a
+        full-population ack the cutover finally unblocks."""
+        for rc_id in sorted(self._ranges_pending):
+            ch = self._ranges_pending.get(rc_id)
+            if ch is None or ch.get("sealed_ok"):
+                continue
+            reply = await self._fanout_wait(
+                "range_change", "range_reply",
+                CtrlRequest("range_change"),
+                extra={"change": dict(ch)},
+            )
+            await self._maybe_seal_complete(rc_id, reply)
 
     def _targets(self, req: CtrlRequest):
         ids = req.servers
@@ -495,10 +564,14 @@ class ClusterManager:
             # live resharding: validate, assign the rc_id, fan the seal
             # to EVERY server (each replica of the source group must stop
             # admitting ops for the range before the destination adopts),
-            # and await their acks; adoption then rides the destination
-            # group's own log asynchronously — the reply means "sealed
-            # everywhere reachable", with conf carrying the rc_id for the
-            # caller to poll installation via query_info
+            # and await their acks.  Only when the FULL population acked
+            # does the manager grant seal-complete (re-announced via
+            # install_ranges) — the adopting leader's barrier gates on
+            # that flag, making the cutover two-phase; adoption then
+            # rides the destination group's own log asynchronously.  The
+            # reply means "sealed everywhere reachable", with conf
+            # carrying the rc_id for the caller to poll installation via
+            # query_info.
             try:
                 change = RangeChange.from_payload(dict(req.payload or {}))
             except SummersetError as e:
@@ -511,6 +584,7 @@ class ClusterManager:
                 "range_change", "range_reply", req,
                 extra={"change": change.as_dict()},
             )
+            await self._maybe_seal_complete(change.rc_id, reply)
             return dataclasses.replace(reply, conf={"rc_id": change.rc_id})
         if req.kind == "metrics_dump":
             # telemetry scrape: gather each live server's snapshot

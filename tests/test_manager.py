@@ -316,6 +316,24 @@ class TestConfReannounce:
 
         asyncio.run(run())
 
+    def test_range_seq_reseeds_monotone_across_restart(self):
+        """Regression (REVIEW r16): _range_seq restarting at 0 reused
+        rc_ids that surviving servers already hold in their adopted
+        idempotency sets (the seal was silently skipped yet acked) and
+        regressed re-announce seqs below their newest-seq-seen
+        watermarks — resharding silently stopped converging after a
+        manager restart.  The wall-clock seed keeps both monotone."""
+        import time as _time
+
+        man_a = make_manager()
+        base = man_a._range_seq
+        assert base > 0
+        man_a._range_seq += 3  # three RangeChanges minted this lifetime
+        _time.sleep(0.01)
+        man_b = make_manager()  # the restarted manager
+        assert man_b._range_seq > man_a._range_seq
+        assert man_b._range_seq > base + 3
+
     def test_joiner_before_any_conf_gets_no_install(self):
         from summerset_tpu.host.messages import CtrlMsg
 
@@ -330,5 +348,74 @@ class TestConfReannounce:
             ))
             kinds = [m.kind for m in _decode_frames(conn.writer)]
             assert "install_conf" not in kinds
+
+        asyncio.run(run())
+
+
+class TestRangeSealTwoPhase:
+    """Two-phase cutover (REVIEW r16): the manager grants seal-complete
+    (the flag _range_progress gates the adopt proposal on) only once
+    EVERY member of the population acked the seal fan-out — a partial
+    fan-out leaves an unreached server admitting writes to the range,
+    which the adopting leader's local vote window cannot see."""
+
+    PAYLOAD = {"op": "split", "start": "k", "end": "k\x00",
+               "dst_group": 1}
+
+    @staticmethod
+    async def _ack_range(man, sids, delay=0.05):
+        await asyncio.sleep(delay)
+        for q in man._pending_replies.get("range_reply", ()):
+            for sid in sids:
+                q.put_nowait((sid, {}))
+
+    def test_partial_fanout_withholds_seal_complete(self):
+        async def run():
+            man = make_manager(3)
+            add_server(man, 0)
+            add_server(man, 1)  # server 2 is down
+            asyncio.ensure_future(self._ack_range(man, (0, 1)))
+            rep = await man._handle_request(
+                CtrlRequest("range_change", payload=dict(self.PAYLOAD))
+            )
+            rc_id = (rep.conf or {}).get("rc_id")
+            assert rc_id in man._ranges_pending
+            # sealed everywhere reachable, but NOT cluster-wide: held
+            assert not man._ranges_pending[rc_id].get("sealed_ok")
+
+            # the downed server rejoins: the retry fan-out re-drives the
+            # seal and, on a full-population ack, grants the flag and
+            # re-announces it to every server
+            add_server(man, 2)
+            asyncio.ensure_future(self._ack_range(man, (0, 1, 2)))
+            seq_before = man._range_seq
+            await man._retry_pending_seals()
+            assert man._ranges_pending[rc_id].get("sealed_ok") is True
+            assert man._range_seq == seq_before + 1
+            for sid in (0, 1, 2):
+                msgs = _decode_frames(man.servers[sid].writer)
+                anns = [m for m in msgs if m.kind == "install_ranges"]
+                assert anns, f"server {sid} never got the re-announce"
+                pend = anns[-1].payload["pending"]
+                assert len(pend) == 1 and pend[0]["rc_id"] == rc_id
+                assert pend[0]["sealed_ok"] is True
+
+        asyncio.run(run())
+
+    def test_full_fanout_grants_seal_complete_inline(self):
+        async def run():
+            man = make_manager(3)
+            for sid in range(3):
+                add_server(man, sid)
+            asyncio.ensure_future(self._ack_range(man, (0, 1, 2)))
+            rep = await man._handle_request(
+                CtrlRequest("range_change", payload=dict(self.PAYLOAD))
+            )
+            rc_id = (rep.conf or {}).get("rc_id")
+            assert man._ranges_pending[rc_id].get("sealed_ok") is True
+            # retry is a no-op once granted
+            seq = man._range_seq
+            await man._retry_pending_seals()
+            assert man._range_seq == seq
 
         asyncio.run(run())

@@ -134,6 +134,21 @@ class TestResharderPolicy:
         one = ResharderPolicy(1, lambda k: 0)
         assert one.decide({"hot": 1000}) is None
 
+    def test_resplit_after_merge_back(self):
+        """Regression: a key that split, cooled, and merged back used to
+        stay in the policy's moved-set (mapped to its hash-home), so a
+        re-heat could never split it again — the heat loop permanently
+        pinned it.  The merge must forget the key entirely."""
+        pol = self._pol(hot_frac=0.25, cold_frac=0.05, min_total=10)
+        assert pol.decide({"hot": 50, "a": 5}).op == "split"
+        cooled = {"hot": 0, **{f"k{i}": 2 for i in range(10)}}
+        assert pol.decide(cooled).op == "merge"
+        assert "hot" not in pol._moved
+        # the key re-heats: it must be eligible to split again
+        ch = pol.decide({"hot": 50, "a": 5})
+        assert ch is not None and ch.op == "split"
+        assert ch.contains("hot") and ch.dst_group == away_of("hot")
+
 
 class TestTailWritesRangeFamilies:
     """Regression: the adopt barrier's voted-tail scan must work for
@@ -189,6 +204,217 @@ class TestTailWritesRangeFamilies:
         # epaxos-like state: no linear window leaves at all
         srv.state = {"abs2": srv.state["win_abs"]}
         assert srv._tail_writes_range({"start": "a", "end": None}) is True
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **kw):
+        self.events.append((kind, kw))
+
+
+class _Metrics:
+    def __init__(self):
+        self.counters = {}
+
+    def counter_add(self, name, n=1, **kw):
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe(self, *a, **kw):
+        pass
+
+
+class _Ctrl:
+    def __init__(self):
+        self.inbox = []
+        self.sent = []
+
+    def try_recv_ctrl(self):
+        return self.inbox.pop(0) if self.inbox else None
+
+    def send_ctrl(self, msg):
+        self.sent.append(msg)
+
+
+def _reshard_server(state_leaves=("win_bal",)):
+    """A bare 2-group replica with just enough wiring for the seal/
+    adopt/re-announce plane (no transport, no kernel step)."""
+    import types
+
+    import numpy as np
+
+    from summerset_tpu.host.payload import PayloadStore
+    from summerset_tpu.host.resharding import RangeTable
+    from summerset_tpu.host.server import ServerReplica as Server
+
+    srv = Server.__new__(Server)
+    srv.me = 0
+    srv.G = 2
+    srv.applied = [0, 0]
+    srv.tick = 0
+    srv._epaxos = False
+    srv.payloads = PayloadStore(2)
+    srv.state = {
+        k: np.zeros((2, 1, 4), np.int32)
+        for k in ("win_abs", "win_val") + tuple(state_leaves)
+    }
+
+    class _Ker:
+        VALUE_WINDOW = "win_val"
+
+    srv.kernel = _Ker()
+    srv.rangetab = RangeTable()
+    srv._range_sealed = {}
+    srv._range_adopted = set()
+    srv._range_override = set()
+    srv._range_seq_seen = 0
+    srv._range_adopt_mark = {}
+    srv._range_adopt_ready = []
+    srv._is_leader = np.asarray([True, True])
+    srv._wslot = {}
+    srv._subs = {}
+    srv._sub_seq = 0
+    srv._sub_notes = []
+    srv.statemach = types.SimpleNamespace(_kv={})
+    srv.flight = _Recorder()
+    srv.metrics = _Metrics()
+    srv.ctrl = _Ctrl()
+    srv._wal_append = lambda rec: None
+    return srv
+
+
+class TestReannounceAdoptInterplay:
+    """Regression (REVIEW r16 high): the manager's install_ranges
+    re-announce used to add rc_id to the ADOPTED idempotency set, so
+    when the replicated adopt command later executed at this replica's
+    destination-group slot, _apply_adopt early-returned and the
+    handed-off KV/wslot merge was silently skipped — a replica that saw
+    the re-announce first (plus below-floor source slots it ack-skips)
+    had NO path to the moved keys' committed values and diverged
+    permanently.  The re-announce may only install the routing
+    OVERRIDE; the log-replayed adopt must still merge."""
+
+    ENTRY = {"rc_id": 7, "op": "split", "start": "mk", "end": "mk\x00",
+             "group": 1, "floors": [3, 0]}
+    ADOPT_VAL = {"rc_id": 7, "op": "split", "start": "mk",
+                 "end": "mk\x00", "dst_group": 1,
+                 "kv": {"mk": "moved-v"}, "wslots": {"mk": 9},
+                 "floors": [3, 0]}
+
+    def _announce(self, srv, seq=1, installed=(), pending=()):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        srv.ctrl.inbox.append(CtrlMsg("install_ranges", {
+            "seq": seq, "installed": list(installed),
+            "pending": list(pending),
+        }))
+        assert srv._handle_ctrl() is None
+
+    def test_reannounce_does_not_suppress_adopt_merge(self):
+        srv = _reshard_server()
+        self._announce(srv, installed=[dict(self.ENTRY)])
+        # the override routed, but the rc_id is NOT marked adopted
+        assert srv.rangetab.group_for("mk") == 1
+        assert 7 in srv._range_override
+        assert 7 not in srv._range_adopted
+        # the replicated adopt executes at its slot: the merge must land
+        srv._apply_adopt(dict(self.ADOPT_VAL), announce=False)
+        assert srv.statemach._kv.get("mk") == "moved-v"
+        assert srv._wslot.get("mk") == 9
+        assert 7 in srv._range_adopted
+        assert 7 not in srv._range_override
+        # ... and adoption stays idempotent for a duplicate re-propose
+        srv.statemach._kv["mk"] = "newer"
+        srv._apply_adopt(dict(self.ADOPT_VAL), announce=False)
+        assert srv.statemach._kv["mk"] == "newer"
+
+    def test_reannounce_unseals_and_blocks_reseal(self):
+        srv = _reshard_server()
+        srv._range_begin({"rc_id": 7, "op": "split", "start": "mk",
+                          "end": "mk\x00", "dst_group": 1})
+        assert 7 in srv._range_sealed
+        self._announce(srv, installed=[dict(self.ENTRY)])
+        assert 7 not in srv._range_sealed
+        # a straggling seal fan-out for the same rc_id must not re-seal
+        srv._range_begin({"rc_id": 7, "op": "split", "start": "mk",
+                          "end": "mk\x00", "dst_group": 1})
+        assert 7 not in srv._range_sealed
+
+    def test_snapshot_meta_keeps_override_distinct(self):
+        """An override learned from a re-announce must survive recovery
+        as an override (adopt replay still merges), not get promoted to
+        adopted by the snapshot round-trip."""
+        srv = _reshard_server()
+        self._announce(srv, installed=[dict(self.ENTRY)])
+        meta_ranges = srv.rangetab.entries()
+        meta_radopted = sorted(srv._range_adopted)
+        assert meta_radopted == []  # what _take_snapshot would persist
+        # a recovered replica restores the same split sets
+        srv2 = _reshard_server()
+        radopted = {int(r) for r in meta_radopted}
+        for entry in meta_ranges:
+            rc_id = int(entry["rc_id"])
+            if rc_id in radopted:
+                srv2._range_adopted.add(rc_id)
+            else:
+                srv2._range_override.add(rc_id)
+            srv2.rangetab.install(entry)
+        srv2._apply_adopt(dict(self.ADOPT_VAL), announce=False)
+        assert srv2.statemach._kv.get("mk") == "moved-v"
+
+
+class TestSealRefusalAndTwoPhase:
+    CH = {"rc_id": 3, "op": "split", "start": "mk", "end": "mk\x00",
+          "dst_group": 1}
+
+    def test_no_vote_window_family_refuses_seal(self):
+        """Regression (REVIEW r16): kernels with neither win_bal nor
+        win_term (chain_rep / simple_push / rep_nothing) used to accept
+        the seal while _tail_writes_range stayed conservatively True
+        forever — the range shed every op permanently.  The seal must be
+        refused up front, like the epaxos leaderless refusal."""
+        srv = _reshard_server(state_leaves=())
+        srv._range_begin(dict(self.CH))
+        assert srv._range_sealed == {}
+
+    def test_epaxos_still_refuses(self):
+        srv = _reshard_server()
+        srv._epaxos = True
+        srv._range_begin(dict(self.CH))
+        assert srv._range_sealed == {}
+
+    def test_progress_gates_on_cluster_wide_seal_confirmation(self):
+        """Regression (REVIEW r16): the adopt barrier inspected only the
+        LOCAL vote window, so the destination leader could propose the
+        adopt before every replica had processed the seal fan-out — a
+        write admitted by a not-yet-sealed replica could then commit
+        above the handoff floor and overwrite a newer destination-group
+        value after cutover.  The proposal must wait for the manager's
+        seal-complete grant (every server acked)."""
+        srv = _reshard_server()
+        srv._range_begin(dict(self.CH))
+        assert 3 in srv._range_sealed
+        srv._range_progress()
+        assert srv._range_adopt_ready == []       # unconfirmed: held
+        srv._range_sealed[3]["sealed_ok"] = True  # manager re-announce
+        srv._range_progress()
+        assert len(srv._range_adopt_ready) == 1
+        dst, areq = srv._range_adopt_ready[0]
+        assert dst == 1 and areq.cmd.kind == "adopt"
+        assert areq.cmd.value["rc_id"] == 3
+
+    def test_install_ranges_pending_updates_seal_flag(self):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        srv = _reshard_server()
+        srv._range_begin(dict(self.CH))
+        srv.ctrl.inbox.append(CtrlMsg("install_ranges", {
+            "seq": 1, "installed": [],
+            "pending": [dict(self.CH, sealed_ok=True)],
+        }))
+        assert srv._handle_ctrl() is None
+        assert srv._range_sealed[3].get("sealed_ok") is True
 
 
 # ------------------------------------------------------------- live tier --
