@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""AUTOPILOT.json drift + closed-loop-autopilot gate (ci.sh).
+
+Asserts, WITHOUT bringing up clusters (pure schedule regeneration over
+the committed twin-soak artifact from scripts/autopilot_soak.py):
+
+1. the committed ``autopilot_ab`` row passed (``ok``) and both twin
+   cells' histories were linearizable with zero acked-and-shed values
+   and a bounded recovery;
+2. the schedule digest is byte-identical to what the current
+   generators produce (both WorkloadPlan timelines, the FaultPlan, the
+   shift/window axis, AND the policy knob line) — any change to the
+   schedule or the policy's knobs must regenerate the artifact in the
+   same PR (the drift gate); the per-plan digests must match too;
+3. graceful degradation beat the static twin: the ON cell accepted
+   >= ``MIN_WIN_RATIO`` x the OFF cell in EVERY post-shift window;
+4. bounded convergence: the policy fired nothing after the schedule
+   tail opened, total fires stayed under ``MAX_TOTAL_FIRES``, and the
+   recorded per-window spend never exceeded the committed budget;
+5. observe mode is byte-identical to off: the OFF cell's observing
+   driver sent ZERO ctrl mutations;
+6. actuator coverage: the ON cell fired >= 1 ``lead_move`` and >= 1
+   ``batch`` actuation (the levers the schedule's shifts target).
+
+Regenerate with:  python scripts/autopilot_soak.py
+
+Usage:  python scripts/autopilot_gate.py [--json AUTOPILOT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from autopilot_soak import (  # noqa: E402  (scripts/ sibling import)
+    MAX_TOTAL_FIRES, MIN_WIN_RATIO, SHIFTS, WINDOWS, build_schedule,
+    make_policy, schedule_digest,
+)
+
+
+def check_autopilot_ab(row) -> list:
+    errs = []
+    if not row.get("ok"):
+        errs.append(f"row not ok: {row.get('error')}")
+
+    # ---- drift: the committed schedule must regenerate byte-for-byte
+    wa, wb, fp = build_schedule()
+    pol = make_policy()
+    if row.get("wl_digest_a") != wa.digest():
+        errs.append(f"workload plan A digest drift: committed "
+                    f"{row.get('wl_digest_a')} vs {wa.digest()}")
+    if row.get("wl_digest_b") != wb.digest():
+        errs.append(f"workload plan B digest drift: committed "
+                    f"{row.get('wl_digest_b')} vs {wb.digest()}")
+    if row.get("fault_digest") != fp.digest():
+        errs.append(f"fault plan digest drift: committed "
+                    f"{row.get('fault_digest')} vs {fp.digest()}")
+    if row.get("schedule_digest") != schedule_digest():
+        errs.append(f"schedule digest drift: committed "
+                    f"{row.get('schedule_digest')} vs "
+                    f"{schedule_digest()}")
+    if row.get("policy_config_digest") != pol.config_digest():
+        errs.append(f"policy knob drift: committed "
+                    f"{row.get('policy_config_digest')} vs "
+                    f"{pol.config_digest()}")
+    if list(row.get("shifts") or []) != list(SHIFTS):
+        errs.append("shift axis drift")
+    if [tuple(w) for w in (row.get("windows") or [])] != list(WINDOWS):
+        errs.append("measurement window drift")
+
+    # ---- both twin cells: linearizable, no lost acks, recovered
+    for mode in ("off", "on"):
+        sub = row.get(mode) or {}
+        if not sub.get("linearizable"):
+            errs.append(f"{mode} cell history not linearizable")
+        if sub.get("ack_shed_overlap"):
+            errs.append(f"{mode} cell lost acks to sheds: "
+                        f"{sub['ack_shed_overlap']}")
+        if not sub.get("recovered"):
+            errs.append(f"{mode} cell never recovered post-schedule")
+
+    # ---- graceful degradation after EVERY shift
+    ratios = row.get("window_ratios") or []
+    if len(ratios) != len(WINDOWS):
+        errs.append(f"expected {len(WINDOWS)} window ratios, "
+                    f"got {len(ratios)}")
+    for i, r in enumerate(ratios):
+        if r < MIN_WIN_RATIO:
+            errs.append(f"W{i + 1} on/off ratio {r} < {MIN_WIN_RATIO}")
+
+    on = row.get("on") or {}
+    # ---- bounded convergence
+    if on.get("tail_decisions") != 0:
+        errs.append(f"policy still actuating in the tail: "
+                    f"{on.get('tail_decisions')} decisions")
+    total_fires = sum((on.get("fires") or {}).values())
+    if total_fires > MAX_TOTAL_FIRES:
+        errs.append(f"unbounded actuation: {total_fires} fires "
+                    f"> {MAX_TOTAL_FIRES}")
+    if on.get("max_window_spend", 0) > on.get("budget_per_window", 0):
+        errs.append(
+            f"window budget blown: spend {on.get('max_window_spend')} "
+            f"> budget {on.get('budget_per_window')}"
+        )
+
+    # ---- observe mode byte-identical to off
+    off = row.get("off") or {}
+    if off.get("n_actuations") != 0:
+        errs.append(f"observe-mode driver sent "
+                    f"{off.get('n_actuations')} ctrl mutations")
+
+    # ---- actuator coverage
+    fires = on.get("fires") or {}
+    if fires.get("lead_move", 0) < 1:
+        errs.append("no lead_move actuation in the on cell")
+    if fires.get("batch", 0) < 1:
+        errs.append("no batch actuation in the on cell")
+    return errs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json",
+                    default=os.path.join(REPO, "AUTOPILOT.json"))
+    args = ap.parse_args()
+
+    if not os.path.exists(args.json):
+        print(f"FAIL: {args.json} missing — run "
+              "scripts/autopilot_soak.py")
+        return 1
+    with open(args.json) as f:
+        rows = json.load(f)
+    ab = [r for r in rows if r.get("kind") == "autopilot_ab"]
+    if len(ab) != 1:
+        print(f"FAIL: expected exactly one autopilot_ab row, "
+              f"found {len(ab)}")
+        return 1
+    errs = check_autopilot_ab(ab[0])
+    if errs:
+        for e in errs:
+            print(f"FAIL: {e}")
+        return 1
+    on = ab[0].get("on") or {}
+    print(f"autopilot gate OK: schedule {ab[0]['schedule_digest']}, "
+          f"window ratios {ab[0].get('window_ratios')}, "
+          f"fires {on.get('fires')}, "
+          f"tail quiet, observe byte-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

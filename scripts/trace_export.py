@@ -631,7 +631,8 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                     "args": {"g": ev["g"], "vid": ev["vid"]},
                 })
             elif k in ("fault_ctl", "demote", "crash", "restart",
-                       "range_seal", "range_adopt"):
+                       "range_seal", "range_adopt", "range_unseal",
+                       "autopilot_act"):
                 evs.append({
                     "ph": "i", "s": "p", "name": k, "pid": me,
                     "tid": TID["ctrl"], "ts": t,

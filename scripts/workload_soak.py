@@ -985,10 +985,26 @@ def run_reshard_ab(args) -> dict:
             moved: list = []   # keys split off their hash-home
             if mode == "on":
                 def drive_policy() -> None:
+                    from summerset_tpu.host.autopilot import (
+                        AutopilotPolicy,
+                    )
+
                     pol = ResharderPolicy(
                         RESHARD_GROUPS, hash_group,
                         hot_frac=RESHARD_HOT_FRAC,
                         cold_frac=RESHARD_COLD_FRAC, min_total=10,
+                    )
+                    # PR 17: reshard decisions answer to an autopilot's
+                    # actuation budget (streaks, cooldowns, one change
+                    # per group per window) instead of firing on every
+                    # scrape — the AutopilotPolicy ctor installs
+                    # pol.budget_gate.  Short streak/cooldown: the
+                    # scrape cadence is 1.2s against a ~10s burst.
+                    ap = AutopilotPolicy(
+                        seed=AB_SEED, population=args.replicas,
+                        num_groups=RESHARD_GROUPS, streak_need=2,
+                        cooldown_rounds=2, window_rounds=4,
+                        budget_per_window=2, resharder=pol,
                     )
                     prev: dict = {}
                     ep = GenericEndpoint(cluster.manager_addr)
@@ -1033,7 +1049,33 @@ def run_reshard_ab(args) -> dict:
                                  for k, v in cum.items()}
                         prev = cum
                         tick = (time.monotonic() - t0) / args.tick_len
-                        ch = pol.decide(delta)
+                        # one autopilot round per scrape: quorum senses
+                        # from query_info, heat deltas as the reshard
+                        # signal; pol.decide runs INSIDE evaluate, past
+                        # the streak + budget admission
+                        try:
+                            info = ep.ctrl.request(
+                                CtrlRequest("query_info"), timeout=10.0,
+                            )
+                        except Exception:
+                            continue
+                        alive = len(getattr(info, "servers", None)
+                                    or {})
+                        decisions = ap.evaluate({
+                            "population": args.replicas,
+                            "alive": alive,
+                            "leader": getattr(info, "leader", None),
+                            "heat": delta,
+                        })
+                        ch = None
+                        for d in decisions:
+                            if d.actuator == "reshard":
+                                ch = RangeChange(
+                                    d.arg["op"], d.arg["start"],
+                                    d.arg.get("end"),
+                                    int(d.arg["dst_group"]),
+                                )
+                                break
                         if (ch is None and not issued["split"] and cum
                                 and tick >= burst.tick
                                 + burst.ticks // 2):

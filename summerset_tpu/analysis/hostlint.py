@@ -76,6 +76,13 @@ STORAGE_OWNER = "host/storage.py"
 SEEDED_SCOPES: Dict[str, Tuple[str, ...]] = {
     "host/nemesis.py": ("FaultPlan", "FaultEvent"),
     "host/workload.py": ("WorkloadPlan", "WorkloadPhase", "OpStream"),
+    # the autopilot's DECISION core: same seed + same senses sequence
+    # must yield a byte-identical decision timeline/digest, so the
+    # policy's notion of time is the evaluate-round counter, never a
+    # clock.  AutopilotDriver (the wall-clock scrape/actuate loop) is
+    # exempt by not being listed, like NemesisRunner.
+    "host/autopilot.py": ("AutopilotPolicy", "Decision",
+                          "ActuatorState"),
 }
 
 # monotonic-only scopes: module -> class names (or "*" for the whole

@@ -122,10 +122,21 @@ class CtrlMsg:
     #     as_dict) — every replica seals the range and acks; the
     #     destination leader later proposes the adopt through its log
     #   range_installed: entry — proposer -> manager adoption notice
-    #   install_ranges: seq, installed, pending — manager -> servers
-    #     re-announce (newest seq wins; the ConfChange install_conf
-    #     pattern) so late joiners learn installed ranges + re-seal
-    #     pending ones
+    #   install_ranges: seq, installed, pending, expired — manager ->
+    #     servers re-announce (newest seq wins; the ConfChange
+    #     install_conf pattern) so late joiners learn installed ranges,
+    #     re-seal pending ones, and un-seal expired ones
+    #   adopt_intent -> adopt_decision: rc_id (+ ok on the decision) —
+    #     the adopting leader asks the manager to pin the cutover
+    #     before proposing; a grant makes the change non-expirable, a
+    #     refusal (already expired) rolls the seal back
+    #   range_expire: rc_id — a source server reports a sealed range
+    #     whose destination stayed leaderless past seal_ttl_ticks; the
+    #     manager expires the pending change iff no adopt grant exists
+    #   autopilot_ctl -> autopilot_reply: act ("demote" | "retune" |
+    #     "announce") + actuator fields (reason / api_max_batch /
+    #     pipeline / mode / cooldowns) — the autopilot driver's
+    #     actuation fan-out (host/autopilot.py)
     #   leave / leave_reply
     payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -140,6 +151,9 @@ class CtrlRequest:
     #            | range_change (payload: op/start/end/dst_group —
     #              validated into a host/resharding.RangeChange, fanned
     #              to every server, replied with conf={"rc_id": n})
+    #            | autopilot_ctl (payload: act + actuator fields,
+    #              relayed verbatim to target servers; the autopilot
+    #              driver's actuation plane — host/autopilot.py)
     servers: Optional[List[int]] = None  # None = all
     durable: bool = True                 # reset: keep durable files?
     payload: Optional[Dict[str, Any]] = None  # inject_faults: fault spec

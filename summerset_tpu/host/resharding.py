@@ -31,7 +31,7 @@ decomposition this subsystem's routing rides on.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils.errors import SummersetError
 from ..utils.keyrange import KeyRangeMap
@@ -178,6 +178,15 @@ class ResharderPolicy:
     issues the returned :class:`RangeChange` requests over the ctrl
     plane.  One decision per call keeps cutovers serialized (each seals
     its range until adopted; flooding seals would just shed).
+
+    When an autopilot owns this policy (host/autopilot.py) it installs
+    ``budget_gate``: ``decide`` consults it with the candidate
+    destination group BEFORE committing to a change, so reshard
+    decisions answer to the autopilot's per-window actuation budget and
+    per-group change cap instead of firing independently — a heat spike
+    can no longer race a leader re-placement on the same group.  A
+    refused candidate is left untouched (``_moved`` unchanged), so the
+    same decision stays available next call.
     """
 
     def __init__(
@@ -187,12 +196,14 @@ class ResharderPolicy:
         hot_frac: float = 0.25,
         cold_frac: float = 0.02,
         min_total: int = 20,
+        budget_gate: Optional[Callable[[int], bool]] = None,
     ):
         self.G = int(num_groups)
         self.hash_group = hash_group
         self.hot_frac = float(hot_frac)
         self.cold_frac = float(cold_frac)
         self.min_total = int(min_total)
+        self.budget_gate = budget_gate
         self._moved: Dict[str, int] = {}  # key -> installed dst group
 
     def decide(
@@ -220,6 +231,9 @@ class ResharderPolicy:
                 break  # ranked: nothing below is hotter
             start, end = single_key_range(key)
             dst = (self.hash_group(key) + 1) % self.G
+            if self.budget_gate is not None \
+                    and not self.budget_gate(dst):
+                break  # budget-refused; candidate stays for next call
             self._moved[key] = dst
             return RangeChange("split", start, end, dst)
         for key, n in sorted(ranked, key=lambda t: (t[1], t[0])):
@@ -229,6 +243,9 @@ class ResharderPolicy:
                 continue
             home = self.hash_group(key)
             start, end = single_key_range(key)
+            if self.budget_gate is not None \
+                    and not self.budget_gate(home):
+                continue  # budget-refused; candidate stays for next call
             # forget the key entirely: a merged-back key that re-heats
             # must be eligible for a future split (leaving it in _moved
             # mapped to its hash-home would pin it forever)

@@ -332,6 +332,11 @@ class ServerReplica:
         # indictment clears while the revoke ConfChange is in flight —
         # a false alarm must not leave lease-local reads revoked forever
         self._demote_restore_resp: Optional[List[int]] = None
+        # autopilot-initiated demotion: when the policy tier (rather
+        # than the health plane's own indictment path) started a
+        # QL/Bodega revoke-then-demote, the health plane's false-alarm
+        # restore must not cancel it — _autopilot_tick owns resolution
+        self._ap_demote_pending = False
         self.metrics.counter_add("leader_demotions", 0)
         self.metrics.gauge_set("health_score", 1.0)
         # live resharding (host/resharding.py): counters/gauges declared
@@ -341,6 +346,12 @@ class ServerReplica:
         self.metrics.counter_add("reshard_merges", 0)
         self.metrics.gauge_set("range_heat", 0.0)
         self.metrics.observe("reshard_cutover_us", 0)
+        self.metrics.counter_add("reshard_seal_expired", 0)
+        # autopilot series (host/autopilot.py): zero until a driver in
+        # act mode announces / actuates here
+        self.metrics.counter_add("autopilot_actions", 0)
+        self.metrics.gauge_set("autopilot_mode", 0.0)
+        self.metrics.gauge_set("autopilot_cooldown", 0.0)
 
         # protocol kernel over [G, R]; host applier drives the exec bar
         kercfg_cls = type(
@@ -486,6 +497,20 @@ class ServerReplica:
         self._range_adopt_mark: Dict[int, int] = {}
         self._range_adopt_ready: List[Tuple[int, ApiRequest]] = []
         self._range_heat = RangeHeat()
+        # seal-TTL escape hatch: a sealed range whose destination group
+        # never produced a leader (so the manager never granted adopt
+        # intent) is un-sealed after seal_ttl_ticks and the source
+        # resumes serving it — bounding worst-case unavailability at the
+        # cost of rolling back the pending change.  The manager's
+        # adopt_intent grant is the linearizability pivot: expiry is
+        # only honored pre-grant, and the grant set makes adopt-vs-
+        # expire race-free (both resolve on the manager's event loop).
+        # seal_ttl_ticks=0 disables expiry.
+        self.seal_ttl_ticks = int(cfg.pop("seal_ttl_ticks", 2400))
+        self._range_adopt_granted: Set[int] = set()
+        self._range_expired: Set[int] = set()
+        self._range_intent_sent: Dict[int, int] = {}
+        self._range_expire_sent: Dict[int, int] = {}
         # EPaxos: leaderless — every replica proposes into its own row;
         # execution runs through the exact host Tarjan applier.  Every
         # key bucket with pending requests proposes in the SAME tick
@@ -1942,7 +1967,8 @@ class ServerReplica:
         rejoiner)."""
         rc_id = int(ch.get("rc_id", 0))
         if rc_id in self._range_adopted or rc_id in self._range_sealed \
-                or rc_id in self._range_override:
+                or rc_id in self._range_override \
+                or rc_id in self._range_expired:
             return
         if self._epaxos:
             # leaderless: no single commit-slot barrier to drain against
@@ -1965,6 +1991,11 @@ class ServerReplica:
             return
         ch = dict(ch)
         ch["sealed_at"] = time.monotonic()
+        # seal-TTL base: WAL-replayed seals restart their TTL from the
+        # recovery tick (the manager's pending re-announce keeps the
+        # change alive; the TTL bounds LEADERLESS-destination time, not
+        # wall time since the original seal)
+        ch["sealed_tick"] = self.tick
         self._range_sealed[rc_id] = ch
         if not replayed:
             self._wal_append(("rseal", {
@@ -1991,6 +2022,23 @@ class ServerReplica:
         for rc_id in sorted(self._range_sealed):
             ch = self._range_sealed[rc_id]
             dst = int(ch["dst_group"]) % self.G
+            if (self.seal_ttl_ticks > 0
+                    and rc_id not in self._range_adopt_granted
+                    and self.tick - int(ch.get("sealed_tick", 0))
+                    > self.seal_ttl_ticks):
+                # seal-TTL escape hatch: no adopt grant within the TTL
+                # (destination leaderless, or the grant round itself is
+                # starved) — ask the manager to expire the change.  The
+                # manager refuses if a grant raced ahead (its event
+                # loop serializes grant-vs-expire), so a stale expire
+                # request cannot roll back an adoption in flight.
+                last = self._range_expire_sent.get(rc_id)
+                if last is None or self.tick - last >= 200:
+                    self._range_expire_sent[rc_id] = self.tick
+                    self.ctrl.send_ctrl(CtrlMsg(
+                        "range_expire", {"rc_id": rc_id},
+                    ))
+                continue
             if not bool(self._is_leader[dst]):
                 continue
             if not ch.get("sealed_ok"):
@@ -2009,6 +2057,20 @@ class ServerReplica:
                 # the mark expires is safe even if both land
                 continue
             if self._tail_writes_range(ch):
+                continue
+            if rc_id not in self._range_adopt_granted:
+                # barrier cleared — ask the manager for the adopt grant
+                # before proposing.  The grant pins the change against
+                # seal-TTL expiry: once granted, only the (idempotent,
+                # re-proposable) adopt resolves the cutover, so adopt
+                # and expire can never both win.  Rate-limited like the
+                # adopt re-propose; a lost decision just re-asks.
+                last = self._range_intent_sent.get(rc_id)
+                if last is None or self.tick - last >= 200:
+                    self._range_intent_sent[rc_id] = self.tick
+                    self.ctrl.send_ctrl(CtrlMsg(
+                        "adopt_intent", {"rc_id": rc_id},
+                    ))
                 continue
             start, end = ch["start"], ch.get("end")
 
@@ -2085,6 +2147,38 @@ class ServerReplica:
             self.ctrl.send_ctrl(CtrlMsg(
                 "range_installed", {"entry": entry}
             ))
+
+    def _range_unseal(self, rc_id: int, why: str) -> None:
+        """Roll back a sealed-but-never-adopted range change: drop the
+        seal so the source resumes serving the range, and remember the
+        rc_id as expired so a straggling re-announce of the same change
+        cannot re-seal it.  Only reached via the manager's expired list
+        (install_ranges) — the manager already refused expiry for any
+        change whose adopt intent was granted, so there is no adoption
+        in flight to race."""
+        rc_id = int(rc_id)
+        if rc_id in self._range_expired or rc_id in self._range_adopted:
+            return
+        self._range_expired.add(rc_id)
+        self._range_adopt_granted.discard(rc_id)
+        self._range_intent_sent.pop(rc_id, None)
+        self._range_expire_sent.pop(rc_id, None)
+        sealed = self._range_sealed.pop(rc_id, None)
+        self._range_adopt_mark.pop(rc_id, None)
+        # un-propose: an adopt batch still waiting in the intake queue
+        # for this rc_id must not reach the log after the rollback
+        self._range_adopt_ready = [
+            (g, req) for g, req in self._range_adopt_ready
+            if int((req.cmd.value or {}).get("rc_id", -1)) != rc_id
+        ]
+        if sealed is None:
+            return
+        self.metrics.counter_add("reshard_seal_expired", 1)
+        self.flight.record(
+            "range_unseal", rc_id=rc_id, why=str(why), tick=self.tick,
+        )
+        pf_warn(logger, f"range_change {rc_id} un-sealed ({why}): "
+                        "source resumes serving the range")
 
     # --------------------------------------------------------- main loop
     def run(self) -> bool:
@@ -2410,6 +2504,7 @@ class ServerReplica:
         self._range_progress()
         self._leader_edges(fx)
         self._health_tick()
+        self._autopilot_tick()
         _stage("apply")  # apply + reply
         # per-tick flight event: the loop_stage_us stopwatches become
         # child spans of this tick at export (the `step` stage is the
@@ -2514,6 +2609,7 @@ class ServerReplica:
         self._conf_progress()
         self._range_progress()
         self._health_tick()
+        self._autopilot_tick()
         _stage("overlap")
 
         # 5. drain step N (residual wait only — the scan had stage 4
@@ -3022,6 +3118,12 @@ class ServerReplica:
         self._health_self_bad = self.me in verdict.indicted
         if not (self.health_mitigation and self._demote_supported):
             return
+        if self._ap_demote_pending:
+            # an autopilot-initiated revoke-then-demote is in flight;
+            # _autopilot_tick owns its resolution — the health plane's
+            # false-alarm restore must not cancel a deliberate,
+            # policy-driven re-placement
+            return
         if self._demote_revoke_deadline is not None:
             # an in-flight lease-revoke must RESOLVE either way — a
             # frozen deadline would both strand the revoked responders
@@ -3102,6 +3204,80 @@ class ServerReplica:
             f"health: replica {self.me} stepping down "
             f"(outlier on {verdict.outliers.get(self.me)})",
         )
+
+    # ---------------------------------------------------------- autopilot
+    def _autopilot_demote(self, reason: str) -> bool:
+        """Targeted voluntary demotion on behalf of the autopilot's
+        lead_move actuator.  Reuses the health plane's machinery — the
+        same kernel ``demote`` input, the same QL/Bodega revoke-first
+        barrier, the same cooldown stamps — but is driven by policy
+        (leader re-placement near traffic) rather than an indictment.
+        Returns False when the demotion cannot apply here (family
+        without the demote input, cooldown, not a leader, or a revoke
+        already in flight)."""
+        if not self._demote_supported:
+            return False
+        if self._ap_demote_pending \
+                or self._demote_revoke_deadline is not None:
+            return False
+        if self.tick < max(self._demote_cooldown_until,
+                           self._demote_until):
+            return False
+        if not self._is_leader.any():
+            return False
+        if self._conf_kind is not None:
+            # lease protocols: revoke responders first, exactly like
+            # the health path; _autopilot_tick resolves the barrier
+            self._demote_restore_resp = self._current_responders()
+            self._handle_conf_req(None, ApiRequest(
+                "conf", conf_delta={"responders": []},
+            ))
+            self._demote_revoke_deadline = self.tick + 600
+            self._ap_demote_pending = True
+            pf_warn(logger, f"autopilot: replica {self.me} revoking "
+                            f"leases before demotion ({reason})")
+            return True
+        self._ap_arm_demotion(reason)
+        return True
+
+    def _ap_arm_demotion(self, reason: str) -> None:
+        """The autopilot twin of ``_arm_demotion``: same kernel input
+        and pacing stamps, attributed to the policy tier."""
+        self._demote_until = self.tick + self.health_demote_ticks
+        self._demote_cooldown_until = (
+            self._demote_until + self.health_cooldown_ticks
+        )
+        self.metrics.counter_add("leader_demotions")
+        self.metrics.counter_add(
+            "autopilot_actions", 1, actuator="lead_move",
+        )
+        self.flight.record(
+            "autopilot_act", act="demote", reason=str(reason),
+            tick=self.tick,
+        )
+        pf_warn(logger, f"autopilot: replica {self.me} stepping down "
+                        f"({reason})")
+
+    def _autopilot_tick(self) -> None:
+        """Resolve an autopilot-initiated lease revoke (the barrier the
+        health plane's ``_health_tick`` deliberately skips while
+        ``_ap_demote_pending`` is set): once the empty-responders
+        ConfChange installs — or its deadline passes with the conf
+        plane wedged — arm the demotion.  Unlike the health path there
+        is no false-alarm restore: the policy decided to move the
+        leader, so the demotion always completes."""
+        if not self._ap_demote_pending:
+            return
+        if self._demote_revoke_deadline is None:
+            self._ap_demote_pending = False
+            return
+        conf_idle = self._conf_active is None and not self._conf_queue
+        if not conf_idle and self.tick <= self._demote_revoke_deadline:
+            return  # still installing
+        self._demote_revoke_deadline = None
+        self._demote_restore_resp = None
+        self._ap_demote_pending = False
+        self._ap_arm_demotion("lease-revoke-complete")
 
     # ----------------------------------------------------------- control
     def _handle_ctrl(self) -> Optional[bool]:
@@ -3189,6 +3365,22 @@ class ServerReplica:
                     elif rc_id not in self._range_adopted \
                             and rc_id not in self._range_override:
                         self._range_begin(dict(ch), replayed=True)
+                for rc_id in msg.payload.get("expired", []):
+                    # seal-TTL rollback: the manager expired a pending
+                    # change (destination leaderless past the TTL, no
+                    # adopt grant issued) — un-seal and resume serving
+                    self._range_unseal(int(rc_id), why="seal-ttl")
+        elif msg.kind == "adopt_decision":
+            # manager's answer to our adopt_intent: a grant pins the
+            # change against seal-TTL expiry (adopt proceeds next
+            # _range_progress); a refusal means the change expired
+            # under us — roll it back here too
+            rc_id = int(msg.payload.get("rc_id", 0))
+            if msg.payload.get("ok"):
+                if rc_id in self._range_sealed:
+                    self._range_adopt_granted.add(rc_id)
+            else:
+                self._range_unseal(rc_id, why="adopt-refused")
         elif msg.kind == "fault_ctl":
             # nemesis fault injection (host/nemesis.py): swap the message-
             # plane and/or disk-plane fault specs.  A key present with a
@@ -3235,6 +3427,59 @@ class ServerReplica:
                 ),
             )
             self.ctrl.send_ctrl(CtrlMsg("fault_reply"))
+        elif msg.kind == "autopilot_ctl":
+            # autopilot actuation fan-out (host/autopilot.py driver in
+            # act mode).  Three acts: "demote" re-places leadership
+            # through the health plane's own machinery; "retune" turns
+            # the live serving knobs (api_max_batch, pipeline);
+            # "announce" exports the policy state through the gauges.
+            # Always ack with what actually applied — the driver logs
+            # refusals rather than retrying blindly.
+            p = msg.payload or {}
+            act = str(p.get("act", ""))
+            applied: Dict[str, Any] = {"act": act, "ok": True}
+            if act == "demote":
+                applied["ok"] = self._autopilot_demote(
+                    str(p.get("reason", "autopilot"))
+                )
+            elif act == "retune":
+                if "api_max_batch" in p:
+                    nb = max(1, int(p["api_max_batch"]))
+                    self.api_max_batch = nb
+                    self.external.max_batch_size = nb
+                    applied["api_max_batch"] = nb
+                if "pipeline" in p:
+                    want = bool(p["pipeline"])
+                    if want != self.pipeline:
+                        # settle the in-flight device step before the
+                        # loop switches tick bodies (same barrier the
+                        # graceful paths use); safe here because
+                        # _handle_ctrl runs on the loop thread
+                        self._pipeline_flush()
+                        self.pipeline = want
+                    applied["pipeline"] = want
+                self.metrics.counter_add(
+                    "autopilot_actions", 1,
+                    actuator="pipeline" if "pipeline" in p else "batch",
+                )
+                self.flight.record(
+                    "autopilot_act", act="retune", tick=self.tick,
+                    **{k: p[k] for k in ("api_max_batch", "pipeline")
+                       if k in p},
+                )
+            elif act == "announce":
+                self.metrics.gauge_set(
+                    "autopilot_mode",
+                    1.0 if p.get("mode") == "act" else 0.0,
+                )
+                for a, cd in (p.get("cooldowns") or {}).items():
+                    self.metrics.gauge_set(
+                        "autopilot_cooldown", float(cd),
+                        actuator=str(a),
+                    )
+            else:
+                applied["ok"] = False
+            self.ctrl.send_ctrl(CtrlMsg("autopilot_reply", applied))
         elif msg.kind == "metrics_dump":
             # ctrl-plane scrape: one deterministic snapshot combining the
             # device metric lanes, the host registry, and sampled traces
@@ -3288,6 +3533,7 @@ class ServerReplica:
             "tick": self.tick,
             "wire_codec": self.wire_codec,
             "pipeline": self.pipeline,
+            "api_max_batch": self.api_max_batch,
             "applied": list(self.applied),
             "device": dev_telemetry.snapshot_row(
                 self._np_state(dev_telemetry.TELEM_KEY), self.me

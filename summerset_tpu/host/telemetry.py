@@ -335,6 +335,18 @@ DECLARED = (
     "reshard_splits",
     "reshard_merges",
     "reshard_cutover_us",
+    # seal-TTL escape hatch (host/server.py _range_unseal): sealed
+    # ranges whose destination stayed leaderless past seal_ttl_ticks
+    # and rolled back to serving from the source
+    "reshard_seal_expired",
+    # autopilot policy tier (host/autopilot.py): actuations applied on
+    # THIS server labeled by actuator, the announced driver mode
+    # (0 = none/observe, 1 = act), and per-actuator remaining-cooldown
+    # gauges — pre-registered so "no autopilot attached" reads as zero
+    # series, not missing ones
+    "autopilot_actions",
+    "autopilot_mode",
+    "autopilot_cooldown",
 )
 
 # canonical metric names every INGRESS PROXY (host/ingress.py) must

@@ -101,6 +101,13 @@ EVENT_TYPES = (
     "range_adopt",   # live resharding: the destination group applied
                      # the adopt (rc_id, op, dst, keys, tick) — the
                      # cutover instant on the exported ctrl track
+    "range_unseal",  # seal-TTL escape hatch: the source un-sealed a
+                     # range whose destination never adopted (rc_id,
+                     # why, tick) and resumed serving it
+    "autopilot_act", # autopilot actuation applied on this server
+                     # (act, plus actuator-specific fields like reason/
+                     # api_max_batch/pipeline, tick) — the policy
+                     # tier's instant on the exported ctrl track
 )
 _EVENT_SET = frozenset(EVENT_TYPES)
 

@@ -90,6 +90,19 @@ class ClusterManager:
         self._range_seq = int(time.time() * 1000)
         self._ranges_installed: Dict[int, dict] = {}
         self._ranges_pending: Dict[int, dict] = {}
+        # seal-TTL escape hatch: pending changes expired on a source
+        # server's range_expire request (destination leaderless past
+        # seal_ttl_ticks).  Kept forever (rc_ids are unique) so a
+        # straggling re-announce can list them and a late seal replay
+        # cannot resurrect a rolled-back change.  _adopt_granted is the
+        # pivot that makes adopt-vs-expire race-free: both the grant
+        # (adopt_intent) and the expiry resolve HERE, on the one event
+        # loop, and an expiry is refused once the grant was issued.
+        # Grants are deliberately non-revocable — post-grant liveness
+        # rides the idempotent adopt re-propose (a new destination
+        # leader re-asks and gets ok=True again), not the TTL.
+        self._ranges_expired: Dict[int, dict] = {}
+        self._adopt_granted: set = set()
         # kind -> list of waiter queues: every waiter sees every reply of
         # that kind (and filters by sid), so concurrent ctrl clients can't
         # steal each other's acks
@@ -182,7 +195,8 @@ class ClusterManager:
                     )
                 except (ConnectionError, OSError):
                     pass
-            if self._ranges_installed or self._ranges_pending:
+            if self._ranges_installed or self._ranges_pending \
+                    or self._ranges_expired:
                 # same late-joiner contract for range installs: a server
                 # re-joining after a RangeChange must converge on the
                 # installed range table (and re-seal still-pending ones)
@@ -260,6 +274,51 @@ class ClusterManager:
                                 f"[{entry.get('start')!r}, "
                                 f"{entry.get('end')!r}) -> "
                                 f"group {entry.get('group')}")
+        elif msg.kind == "adopt_intent":
+            # the adopting leader's barrier cleared and it asks to
+            # propose the cutover.  Granting here — on the single event
+            # loop that also resolves range_expire — is what makes
+            # adopt-vs-seal-TTL-expiry race-free: once granted, the
+            # change can no longer expire; once expired, the intent is
+            # refused (the server rolls its seal back).  Re-asks after a
+            # grant (a new destination leader re-driving an idempotent
+            # adopt) are answered ok again.
+            rc_id = int(p.get("rc_id", 0))
+            ch = self._ranges_pending.get(rc_id)
+            ok = (
+                rc_id not in self._ranges_expired
+                and (rc_id in self._adopt_granted
+                     or (ch is not None and bool(ch.get("sealed_ok"))))
+            )
+            if ok:
+                self._adopt_granted.add(rc_id)
+            try:
+                await safetcp.send_msg(conn.writer, CtrlMsg(
+                    "adopt_decision", {"rc_id": rc_id, "ok": ok},
+                ))
+            except (ConnectionError, OSError):
+                pass
+            if not ok and rc_id in self._ranges_pending:
+                pf_warn(logger, f"range {rc_id}: adopt intent from "
+                                f"server {conn.sid} refused")
+        elif msg.kind == "range_expire":
+            # seal-TTL escape hatch: a source server reports the sealed
+            # range's destination stayed leaderless past its TTL.
+            # Honored only while the change is pending AND un-granted;
+            # the rollback is a normal re-announce (the expired list
+            # rides install_ranges), so paused/partitioned servers
+            # un-seal when they drain their queues — per-connection
+            # FIFO puts the expiry after any straggling seal.
+            rc_id = int(p.get("rc_id", 0))
+            ch = self._ranges_pending.get(rc_id)
+            if ch is not None and rc_id not in self._adopt_granted:
+                self._ranges_pending.pop(rc_id, None)
+                self._ranges_expired[rc_id] = ch
+                self._range_seq += 1
+                await self._announce_ranges()
+                pf_warn(logger, f"range {rc_id}: seal expired "
+                                f"(reported by server {conn.sid}) — "
+                                "change rolled back")
         elif msg.kind == "snapshot_up_to":
             pf_info(
                 logger,
@@ -268,6 +327,7 @@ class ClusterManager:
         elif msg.kind in (
             "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
             "fault_reply", "metrics_reply", "flight_reply", "range_reply",
+            "autopilot_reply",
         ):
             # waiters get (sid, payload): orchestration kinds ignore the
             # payload, gather kinds (metrics_reply) collect it per sid
@@ -314,6 +374,7 @@ class ClusterManager:
                 self._ranges_pending[k]
                 for k in sorted(self._ranges_pending)
             ],
+            "expired": sorted(self._ranges_expired),
         }
 
     async def _announce_ranges(self) -> None:
@@ -586,6 +647,15 @@ class ClusterManager:
             )
             await self._maybe_seal_complete(change.rc_id, reply)
             return dataclasses.replace(reply, conf={"rc_id": change.rc_id})
+        if req.kind == "autopilot_ctl":
+            # autopilot actuation (host/autopilot.py driver in act
+            # mode): relay the act to the target servers and await
+            # their applied-acks — the same orchestration shape as
+            # inject_faults
+            return await self._fanout_wait(
+                "autopilot_ctl", "autopilot_reply", req,
+                extra=req.payload,
+            )
         if req.kind == "metrics_dump":
             # telemetry scrape: gather each live server's snapshot
             # (device metric lanes + host registry + sampled traces)

@@ -29,6 +29,7 @@ effectively linear.
 from __future__ import annotations
 
 import dataclasses
+import sys
 from typing import Dict, List, Optional, Tuple
 
 INF = float("inf")
@@ -77,6 +78,14 @@ def check_history(ops: List[Op]) -> Tuple[bool, Optional[str]]:
     by_key: Dict[str, List[Op]] = {}
     for op in ops:
         by_key.setdefault(op.key, []).append(op)
+    # the per-key search recurses one frame per placed op, so a long
+    # soak's hottest zipfian key (thousands of ops) outruns CPython's
+    # default 1000-frame limit long before time or memory matter — a
+    # clean history resolves greedily in O(n) placements
+    deepest = max((len(k) for k in by_key.values()), default=0)
+    want = 2000 + 4 * deepest
+    if sys.getrecursionlimit() < want:
+        sys.setrecursionlimit(want)
     for key, kops in by_key.items():
         ok = _check_key(kops)
         if not ok:

@@ -1,0 +1,315 @@
+"""Decision-table coverage for the autopilot policy tier
+(host/autopilot.py): hysteresis/cooldown anti-flap, quorum gating,
+per-window actuation budget, observe-mode zero-mutation, seeded
+determinism of the decision trace, each actuator's lowering against a
+fake ctrl endpoint, and the satellite regression that reshard decisions
+share the same budget as every other actuator."""
+
+from typing import Any, Dict, List, Optional
+
+from summerset_tpu.host.autopilot import (
+    ACTUATORS, AutopilotDriver, AutopilotPolicy, Decision, build_senses,
+)
+from summerset_tpu.host.resharding import ResharderPolicy
+
+
+def base_senses(**over) -> Dict[str, Any]:
+    """A healthy, quiet 3-replica cluster's senses."""
+    s = {
+        "population": 3, "alive": 3, "leader": 0,
+        "health": {0: 1.0, 1: 1.0, 2: 1.0},
+        "ingress": {0: 50.0, 1: 10.0, 2: 10.0},
+        "shed_rate": 0.0, "queue_depth": 0.0,
+        "api_max_batch": 2, "pipeline": False,
+        "heat": {}, "lease_protocol": False, "responders": None,
+        "sids": [0, 1, 2],
+    }
+    s.update(over)
+    return s
+
+
+def pol(**over) -> AutopilotPolicy:
+    kw = dict(seed=7, population=3, streak_need=3, cooldown_rounds=10,
+              window_rounds=8, budget_per_window=2)
+    kw.update(over)
+    return AutopilotPolicy(**kw)
+
+
+class TestHysteresisAndCooldown:
+    def test_oscillating_shed_never_flaps_inside_cooldown(self):
+        """A shed signal that flips every round must never build a
+        streak; a sustained one fires ONCE and then sits out the
+        cooldown even if the signal keeps screaming."""
+        p = pol(shed_alpha=1.0)  # no EWMA smoothing: raw oscillation
+        fired: List[Decision] = []
+        for i in range(40):
+            fired += p.evaluate(base_senses(
+                shed_rate=0.5 if i % 2 == 0 else 0.0,
+            ))
+        assert fired == []  # oscillation flaps the streak, not the knob
+
+        p2 = pol()
+        fired2: List[Decision] = []
+        for _ in range(12):
+            fired2 += p2.evaluate(base_senses(shed_rate=0.5))
+        batch = [d for d in fired2 if d.actuator == "batch"]
+        # streak_need=3 ⇒ first fire at round 2; cooldown(10) holds the
+        # next until round >= 13 — within 12 rounds exactly one fire
+        assert len(batch) == 1
+        assert batch[0].arg == 4  # 2 -> 4 on the doubling ladder
+
+    def test_sub_threshold_signal_never_fires(self):
+        p = pol()
+        fired = []
+        for _ in range(30):
+            fired += p.evaluate(base_senses(shed_rate=0.001))
+        assert fired == []
+
+
+class TestQuorumGate:
+    def test_no_quorum_actuates_nothing_and_resets_streaks(self):
+        p = pol()
+        # bank 2 rounds of streak, then lose quorum with the same
+        # screaming signals — nothing may fire, and the banked streak
+        # must NOT carry across the churn window
+        for _ in range(2):
+            p.evaluate(base_senses(shed_rate=0.5))
+        for _ in range(10):
+            out = p.evaluate(base_senses(shed_rate=0.5, alive=1))
+            assert out == []
+        assert not p.last_quorum
+        # quorum returns: the streak restarts from zero (needs 3 fresh
+        # rounds, so rounds 1..2 after return fire nothing)
+        assert p.evaluate(base_senses(shed_rate=0.5)) == []
+        assert p.evaluate(base_senses(shed_rate=0.5)) == []
+        assert len(p.evaluate(base_senses(shed_rate=0.5))) == 1
+
+    def test_leaderless_counts_as_no_quorum(self):
+        p = pol()
+        for _ in range(10):
+            assert p.evaluate(base_senses(
+                shed_rate=0.5, leader=None,
+            )) == []
+
+
+class TestBudget:
+    def test_window_budget_never_exceeded(self):
+        """Every signal screaming every round: per-window actuation
+        spend must stay <= budget_per_window."""
+        p = pol(streak_need=1, cooldown_rounds=0, budget_per_window=2,
+                window_rounds=8)
+        per_window: Dict[int, int] = {}
+        for i in range(64):
+            out = p.evaluate(base_senses(
+                shed_rate=0.5,
+                health={0: 0.1, 1: 1.0, 2: 1.0},   # leader unhealthy
+                api_max_batch=2,
+            ))
+            per_window[i // 8] = per_window.get(i // 8, 0) + len(
+                [d for d in out if d.actuator != "recommend"]
+            )
+        assert per_window and all(n <= 2 for n in per_window.values())
+
+    def test_reshard_and_lead_move_share_group_budget(self):
+        """Satellite regression: a simultaneous heat spike + leader
+        health indictment actuates at most ONE change per group per
+        window — ResharderPolicy decisions flow through the same
+        budget via budget_gate."""
+        rp = ResharderPolicy(2, lambda k: 1, hot_frac=0.25,
+                             cold_frac=0.02, min_total=10)
+        p = pol(streak_need=1, cooldown_rounds=0, budget_per_window=8,
+                window_rounds=6, num_groups=2, resharder=rp)
+        assert rp.budget_gate is not None  # installed by the ctor
+        senses = base_senses(
+            health={0: 0.1, 1: 1.0, 2: 1.0},       # indicted leader
+            heat={"hot": 90, "cold": 10},           # splittable spike
+        )
+        # hot's hash-home is group 1 ⇒ split dst = (1+1)%2 = group 0,
+        # the same group lead_move targets
+        per_group_window: Dict[tuple, int] = {}
+        for i in range(18):
+            for d in p.evaluate(dict(senses)):
+                if d.actuator == "recommend":
+                    continue
+                k = (d.group, i // 6)
+                per_group_window[k] = per_group_window.get(k, 0) + 1
+        assert per_group_window
+        assert all(n <= 1 for n in per_group_window.values())
+
+    def test_budget_refused_reshard_keeps_candidate(self):
+        """A budget-refused split must leave ResharderPolicy._moved
+        untouched so the same decision stays available later."""
+        rp = ResharderPolicy(2, lambda k: 1, min_total=10,
+                             budget_gate=lambda g: False)
+        assert rp.decide({"hot": 90, "cold": 10}) is None
+        assert rp._moved == {}
+        rp.budget_gate = lambda g: True
+        ch = rp.decide({"hot": 90, "cold": 10})
+        assert ch is not None and ch.op == "split"
+
+
+class TestDeterminism:
+    def _feed(self, p: AutopilotPolicy) -> None:
+        seq = (
+            [base_senses()] * 2
+            + [base_senses(shed_rate=0.4)] * 6
+            + [base_senses(alive=1)] * 3
+            + [base_senses(health={0: 0.2, 1: 1.0, 2: 1.0})] * 8
+            + [base_senses()] * 4
+        )
+        for s in seq:
+            p.evaluate(dict(s))
+
+    def test_same_seed_same_senses_identical_timeline(self):
+        a, b = pol(seed=42), pol(seed=42)
+        self._feed(a)
+        self._feed(b)
+        assert a.timeline() == b.timeline()
+        assert a.digest() == b.digest()
+        assert a.decisions()  # the sequence actually fired something
+
+    def test_config_digest_tracks_knobs_only(self):
+        a, b = pol(seed=42), pol(seed=42)
+        self._feed(a)        # decisions fired
+        assert a.config_digest() == b.config_digest()
+        assert pol(seed=43).config_digest() != a.config_digest()
+
+
+class _FakeCtrl:
+    """Records every CtrlRequest the driver sends; replies like a
+    manager that applied everything."""
+
+    def __init__(self, info=None):
+        self.requests: list = []
+        self.info = info
+
+    def __call__(self, req):
+        self.requests.append(req)
+        if req.kind == "query_info":
+            return self.info
+        return {"ok": True}
+
+    def mutating(self) -> list:
+        return [r for r in self.requests if r.kind != "query_info"]
+
+
+class TestDriver:
+    def test_observe_mode_sends_zero_ctrl_mutations(self):
+        """The byte-identical-to-off contract: an observing driver may
+        scrape but never mutate, even while decisions fire."""
+        ctrl = _FakeCtrl()
+        p = pol(streak_need=1, cooldown_rounds=0)
+        drv = AutopilotDriver(
+            None, p, mode="observe", ctrl=ctrl,
+            sense_fn=lambda: base_senses(shed_rate=0.5),
+        )
+        for _ in range(10):
+            drv.step()
+        assert drv.decision_log          # decisions were made ...
+        assert drv.actuation_log == []   # ... but nothing was sent
+        assert ctrl.requests == []       # not even an announce
+
+    def test_act_mode_lowers_each_actuator(self):
+        """Each actuator's ctrl lowering against the fake endpoint."""
+        ctrl = _FakeCtrl()
+        conf_calls: List[List[int]] = []
+        # shed_alpha=1.0: the EWMA is the instantaneous shed rate, so a
+        # batch signal in one round cannot linger and starve a later
+        # round's actuator through the one-change-per-group window; the
+        # hash-home of 0 puts the split's dst on group 1, away from the
+        # group-0 lead/batch/conf actuations
+        p = pol(streak_need=1, cooldown_rounds=0, budget_per_window=99,
+                window_rounds=1, num_groups=2, shed_alpha=1.0,
+                resharder=ResharderPolicy(2, lambda k: 0, min_total=10))
+        drv = AutopilotDriver(
+            None, p, mode="act", ctrl=ctrl,
+            conf_ctl=conf_calls.append,
+            sense_fn=lambda: None,
+        )
+        rounds = [
+            # lead_move: unhealthy leader
+            base_senses(health={0: 0.1, 1: 1.0, 2: 1.0}),
+            # batch: shed with headroom
+            base_senses(shed_rate=0.6),
+            # pipeline: shed at batch_max, serial loop
+            base_senses(shed_rate=0.6, api_max_batch=16),
+            # conf_resize: concentrated heat on a lease protocol
+            base_senses(lease_protocol=True, responders=[0, 1, 2],
+                        heat={"hk": 95, "x": 5}),
+            # reshard: splittable heat spike
+            base_senses(heat={"hk2": 90, "y": 10}),
+        ]
+        it = iter(rounds)
+        drv._sense_fn = lambda: next(it, None)
+        for _ in rounds:
+            drv.step()
+        kinds = [(r.kind, (r.payload or {}).get("act"))
+                 for r in ctrl.mutating()]
+        assert ("autopilot_ctl", "demote") in kinds
+        assert ("autopilot_ctl", "retune") in kinds
+        assert ("range_change", None) in kinds
+        assert ("autopilot_ctl", "announce") in kinds
+        retunes = [r.payload for r in ctrl.mutating()
+                   if (r.payload or {}).get("act") == "retune"]
+        assert any("api_max_batch" in p_ for p_ in retunes)
+        assert any(p_.get("pipeline") is True for p_ in retunes)
+        demotes = [r for r in ctrl.mutating()
+                   if (r.payload or {}).get("act") == "demote"]
+        assert demotes[0].servers == [0]  # targeted at the leader
+        assert conf_calls == [[0]]        # shrink to {leader}∪{top}
+        reshards = [r for r in ctrl.mutating()
+                    if r.kind == "range_change"]
+        assert reshards and reshards[0].payload["op"] == "split"
+
+    def test_recommend_is_log_only(self):
+        ctrl = _FakeCtrl()
+        p = pol(streak_need=1, cooldown_rounds=0)
+        drv = AutopilotDriver(
+            None, p, mode="act", ctrl=ctrl,
+            sense_fn=lambda: base_senses(
+                shed_rate=0.6, api_max_batch=16, pipeline=True,
+            ),
+        )
+        for _ in range(6):
+            drv.step()
+        recs = [d for d in p.decisions() if d.actuator == "recommend"]
+        assert len(recs) == 1  # once-ever
+        assert all(r.kind == "autopilot_ctl"
+                   and (r.payload or {}).get("act") == "announce"
+                   for r in ctrl.mutating())
+
+
+class TestBuildSenses:
+    def _snap(self, sid, req=0, shed=0, heat=(), score=1.0, batch=4):
+        gauges = {"health_score": score, "api_queue_depth": 0.0}
+        for k, n in heat:
+            gauges[f"range_heat{{key={k}}}"] = n
+        return {
+            "protocol": "MultiPaxos", "pipeline": False,
+            "api_max_batch": batch,
+            "host": {
+                "counters": {"api_requests_total": req,
+                             "api_shed": shed},
+                "gauges": gauges,
+            },
+        }
+
+    def test_deltas_against_previous_cursor(self):
+        class _Info:
+            leader = 0
+            servers = {0: None, 1: None, 2: None}
+
+        snaps1 = {str(s): self._snap(s, req=100, shed=0,
+                                     heat=[("hk", 50)])
+                  for s in range(3)}
+        s1, cur = build_senses(snaps1, _Info(), None)
+        assert s1["alive"] == 3 and s1["leader"] == 0
+        assert s1["api_max_batch"] == 4
+        assert s1["lease_protocol"] is False
+        snaps2 = {str(s): self._snap(s, req=150, shed=25,
+                                     heat=[("hk", 80)])
+                  for s in range(3)}
+        s2, _ = build_senses(snaps2, _Info(), cur)
+        assert s2["ingress"] == {0: 50, 1: 50, 2: 50}
+        assert abs(s2["shed_rate"] - 75 / 150) < 1e-9
+        assert s2["heat"] == {"hk": 90}  # (80-50) summed over 3 sids

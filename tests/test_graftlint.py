@@ -349,6 +349,20 @@ def runner_pacing():
     return time.time()             # module-level: outside the scope
 """
 
+_AUTOPILOT_SEEDED_SCOPE = """
+import time, random
+
+class AutopilotPolicy:
+    def evaluate(self):
+        t0 = time.monotonic()      # any clock read inside the policy:
+        rng = random.Random()      # decision traces must replay from
+        return t0, rng.random()    # the seed alone
+
+class AutopilotDriver:
+    def play(self):
+        return time.time()         # scrape pacing: outside the scope
+"""
+
 # H105 both-direction fixtures: every egress shape the rule must
 # decide — dominated by a straight-line fence wait (clean), carrying
 # the fence down as a kwarg (clean), fence only inside a conditional
@@ -485,6 +499,30 @@ def test_hostlint_workload_scope_is_module_keyed(tmp_path):
     class names."""
     findings, _ = _scan(
         tmp_path, _WORKLOAD_SEEDED_SCOPE, "host/other.py"
+    )
+    assert findings == []
+
+
+def test_hostlint_autopilot_policy_joins_seeded_scope(tmp_path):
+    """The autopilot's decision tier is in the H103 seeded scope:
+    clock reads (monotonic included) and unseeded RNG draws inside
+    AutopilotPolicy fire, while the AutopilotDriver's wallclock scrape
+    pacing stays exempt (it is the I/O loop, like NemesisRunner)."""
+    findings, _ = _scan(
+        tmp_path, _AUTOPILOT_SEEDED_SCOPE, "host/autopilot.py"
+    )
+    assert sorted(f.scope for f in findings) == [
+        "AutopilotPolicy.evaluate:random.Random",
+        "AutopilotPolicy.evaluate:time.monotonic",
+    ]
+    assert all(f.code == "H103" for f in findings)
+
+
+def test_hostlint_autopilot_scope_is_module_keyed(tmp_path):
+    """The same source outside host/autopilot.py is untouched — the
+    seeded scope is keyed on the module path, not the class names."""
+    findings, _ = _scan(
+        tmp_path, _AUTOPILOT_SEEDED_SCOPE, "host/other.py"
     )
     assert findings == []
 

@@ -419,3 +419,118 @@ class TestRangeSealTwoPhase:
             assert man._range_seq == seq
 
         asyncio.run(run())
+
+
+class TestSealTtlExpiry:
+    """Seal-TTL escape hatch (PR 17): a sealed range whose destination
+    stays leaderless past seal_ttl_ticks is rolled back via a server's
+    range_expire request — but ONLY while no adopt intent was granted.
+    Grant and expiry both resolve on the manager's single event loop,
+    so adopt-vs-expire can never both win."""
+
+    CH = {"rc_id": 9, "op": "split", "start": "k", "end": "k\x00",
+          "dst_group": 1, "sealed_ok": True}
+
+    @staticmethod
+    def _msg(kind, **payload):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        return CtrlMsg(kind, payload)
+
+    def test_expire_before_grant_rolls_back_and_announces(self):
+        async def run():
+            man = make_manager(3)
+            conns = {sid: add_server(man, sid) for sid in range(3)}
+            man._ranges_pending[9] = dict(self.CH)
+            await man._handle_ctrl(
+                conns[0], self._msg("range_expire", rc_id=9)
+            )
+            assert 9 not in man._ranges_pending
+            assert 9 in man._ranges_expired
+            for sid in range(3):
+                anns = [m for m in _decode_frames(conns[sid].writer)
+                        if m.kind == "install_ranges"]
+                assert anns and anns[-1].payload["expired"] == [9]
+                assert anns[-1].payload["pending"] == []
+            # a duplicate expire report is a no-op
+            seq = man._range_seq
+            await man._handle_ctrl(
+                conns[1], self._msg("range_expire", rc_id=9)
+            )
+            assert man._range_seq == seq
+
+        asyncio.run(run())
+
+    def test_granted_intent_pins_change_against_expiry(self):
+        async def run():
+            man = make_manager(3)
+            conns = {sid: add_server(man, sid) for sid in range(3)}
+            man._ranges_pending[9] = dict(self.CH)
+            await man._handle_ctrl(
+                conns[1], self._msg("adopt_intent", rc_id=9)
+            )
+            dec = [m for m in _decode_frames(conns[1].writer)
+                   if m.kind == "adopt_decision"]
+            assert dec and dec[-1].payload == {"rc_id": 9, "ok": True}
+            # a straggling expire report is now refused
+            await man._handle_ctrl(
+                conns[0], self._msg("range_expire", rc_id=9)
+            )
+            assert 9 in man._ranges_pending
+            assert 9 not in man._ranges_expired
+            # a new destination leader re-asking is granted again
+            await man._handle_ctrl(
+                conns[2], self._msg("adopt_intent", rc_id=9)
+            )
+            dec2 = [m for m in _decode_frames(conns[2].writer)
+                    if m.kind == "adopt_decision"]
+            assert dec2 and dec2[-1].payload["ok"] is True
+
+        asyncio.run(run())
+
+    def test_intent_on_expired_or_unsealed_change_is_refused(self):
+        async def run():
+            man = make_manager(3)
+            conns = {sid: add_server(man, sid) for sid in range(3)}
+            # expired change: refuse (the server rolls its seal back)
+            man._ranges_expired[9] = dict(self.CH)
+            await man._handle_ctrl(
+                conns[0], self._msg("adopt_intent", rc_id=9)
+            )
+            dec = [m for m in _decode_frames(conns[0].writer)
+                   if m.kind == "adopt_decision"]
+            assert dec and dec[-1].payload == {"rc_id": 9, "ok": False}
+            # pending but NOT seal-confirmed: refuse (the two-phase
+            # barrier has not cleared cluster-wide)
+            man._ranges_pending[11] = dict(self.CH, rc_id=11,
+                                           sealed_ok=False)
+            await man._handle_ctrl(
+                conns[1], self._msg("adopt_intent", rc_id=11)
+            )
+            dec2 = [m for m in _decode_frames(conns[1].writer)
+                    if m.kind == "adopt_decision"]
+            assert dec2 and dec2[-1].payload["ok"] is False
+            assert 11 not in man._adopt_granted
+
+        asyncio.run(run())
+
+    def test_rejoiner_learns_expired_set(self):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        async def run():
+            man = make_manager(3)
+            man._ranges_expired[9] = dict(self.CH)
+            conn = add_server(man, 0)
+            conn.joined = False
+            await man._handle_ctrl(conn, CtrlMsg(
+                "new_server_join",
+                {"api_addr": ("127.0.0.1", 7000),
+                 "p2p_addr": ("127.0.0.1", 8000)},
+            ))
+            anns = [m for m in _decode_frames(conn.writer)
+                    if m.kind == "install_ranges"]
+            # a rejoiner whose WAL replays the seal must still unseal:
+            # the expired set alone forces the re-announce
+            assert anns and anns[-1].payload["expired"] == [9]
+
+        asyncio.run(run())

@@ -271,6 +271,11 @@ def _reshard_server(state_leaves=("win_bal",)):
     srv._range_seq_seen = 0
     srv._range_adopt_mark = {}
     srv._range_adopt_ready = []
+    srv.seal_ttl_ticks = 2400
+    srv._range_adopt_granted = set()
+    srv._range_expired = set()
+    srv._range_intent_sent = {}
+    srv._range_expire_sent = {}
     srv._is_leader = np.asarray([True, True])
     srv._wslot = {}
     srv._subs = {}
@@ -392,12 +397,26 @@ class TestSealRefusalAndTwoPhase:
         above the handoff floor and overwrite a newer destination-group
         value after cutover.  The proposal must wait for the manager's
         seal-complete grant (every server acked)."""
+        from summerset_tpu.host.messages import CtrlMsg
+
         srv = _reshard_server()
         srv._range_begin(dict(self.CH))
         assert 3 in srv._range_sealed
         srv._range_progress()
         assert srv._range_adopt_ready == []       # unconfirmed: held
+        assert not any(m.kind == "adopt_intent" for m in srv.ctrl.sent)
         srv._range_sealed[3]["sealed_ok"] = True  # manager re-announce
+        # barrier cleared: the leader first asks the manager for the
+        # adopt grant (pins the change against seal-TTL expiry) ...
+        srv._range_progress()
+        assert srv._range_adopt_ready == []
+        assert any(m.kind == "adopt_intent"
+                   and m.payload["rc_id"] == 3 for m in srv.ctrl.sent)
+        # ... and only proposes once the grant lands
+        srv.ctrl.inbox.append(
+            CtrlMsg("adopt_decision", {"rc_id": 3, "ok": True})
+        )
+        assert srv._handle_ctrl() is None
         srv._range_progress()
         assert len(srv._range_adopt_ready) == 1
         dst, areq = srv._range_adopt_ready[0]
@@ -658,4 +677,186 @@ class TestLiveCutover:
             raise AssertionError("read never recovered post-crash")
         _put_until_acked(drv, key, "l1", budget=60.0)
         drv.checked_get(key, expect="l1")
+        ep.leave()
+
+
+class TestSealTtlServerSide:
+    """The server half of the seal-TTL escape hatch (PR 17): TTL
+    tracking rides _range_progress, expiry requests are rate-limited,
+    a granted adopt intent pins the seal, and the manager's expired
+    re-announce (or an adopt refusal) unseals and blocks re-sealing."""
+
+    CH = {"rc_id": 5, "op": "split", "start": "mk", "end": "mk\x00",
+          "dst_group": 1}
+
+    def _sealed_server(self, ttl=100):
+        import numpy as np
+
+        srv = _reshard_server()
+        srv.seal_ttl_ticks = ttl
+        # not a destination leader: the pre-grant leaderless window
+        srv._is_leader = np.asarray([False, False])
+        srv._range_begin(dict(self.CH))
+        assert 5 in srv._range_sealed
+        return srv
+
+    def test_ttl_sends_range_expire_rate_limited(self):
+        srv = self._sealed_server(ttl=100)
+        srv.tick = 100
+        srv._range_progress()   # exactly at TTL: not yet past it
+        assert not any(m.kind == "range_expire" for m in srv.ctrl.sent)
+        srv.tick = 101
+        srv._range_progress()
+        expires = [m for m in srv.ctrl.sent if m.kind == "range_expire"]
+        assert len(expires) == 1 and expires[0].payload["rc_id"] == 5
+        assert 5 in srv._range_sealed  # still sealed until the manager rules
+        srv.tick = 150
+        srv._range_progress()   # within the 200-tick resend window
+        assert len([m for m in srv.ctrl.sent
+                    if m.kind == "range_expire"]) == 1
+        srv.tick = 301
+        srv._range_progress()   # resend after the window
+        assert len([m for m in srv.ctrl.sent
+                    if m.kind == "range_expire"]) == 2
+
+    def test_zero_ttl_disables_expiry(self):
+        srv = self._sealed_server(ttl=0)
+        srv.tick = 10_000
+        srv._range_progress()
+        assert not any(m.kind == "range_expire" for m in srv.ctrl.sent)
+
+    def test_granted_change_never_expires(self):
+        srv = self._sealed_server(ttl=100)
+        srv._range_adopt_granted.add(5)
+        srv.tick = 10_000
+        srv._range_progress()
+        assert not any(m.kind == "range_expire" for m in srv.ctrl.sent)
+
+    def test_expired_announce_unseals_and_blocks_reseal(self):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        srv = self._sealed_server()
+        srv.ctrl.inbox.append(CtrlMsg("install_ranges", {
+            "seq": 1, "installed": [], "pending": [], "expired": [5],
+        }))
+        assert srv._handle_ctrl() is None
+        assert 5 not in srv._range_sealed
+        assert 5 in srv._range_expired
+        assert srv.metrics.counters.get("reshard_seal_expired") == 1
+        assert any(k == "range_unseal" and kw["rc_id"] == 5
+                   for k, kw in srv.flight.events)
+        # a straggling seal fan-out for the rolled-back change must not
+        # re-seal (the rc_id is burned)
+        srv._range_begin(dict(self.CH))
+        assert 5 not in srv._range_sealed
+        # and a duplicate expired announce is a no-op
+        srv.ctrl.inbox.append(CtrlMsg("install_ranges", {
+            "seq": 2, "installed": [], "pending": [], "expired": [5],
+        }))
+        assert srv._handle_ctrl() is None
+        assert srv.metrics.counters.get("reshard_seal_expired") == 1
+
+    def test_adopt_refusal_unseals(self):
+        from summerset_tpu.host.messages import CtrlMsg
+
+        srv = self._sealed_server()
+        srv.ctrl.inbox.append(
+            CtrlMsg("adopt_decision", {"rc_id": 5, "ok": False})
+        )
+        assert srv._handle_ctrl() is None
+        assert 5 not in srv._range_sealed
+        assert 5 in srv._range_expired
+
+    def test_unseal_drops_pending_adopt_proposal(self):
+        import numpy as np
+
+        from summerset_tpu.host.messages import CtrlMsg
+
+        srv = self._sealed_server()
+        srv._is_leader = np.asarray([True, True])
+        srv._range_sealed[5]["sealed_ok"] = True
+        srv._range_progress()               # sends adopt_intent
+        srv.ctrl.inbox.append(
+            CtrlMsg("adopt_decision", {"rc_id": 5, "ok": True})
+        )
+        assert srv._handle_ctrl() is None
+        srv._range_progress()
+        assert len(srv._range_adopt_ready) == 1
+        srv._range_unseal(5, why="test")
+        assert srv._range_adopt_ready == []
+
+
+@pytest.fixture()
+def ttl_cluster(tmp_path_factory):
+    """A 3-replica cluster with a SHORT seal TTL (~0.75s of ticks) for
+    the live escape-hatch test."""
+    from test_cluster import Cluster
+
+    c = Cluster(
+        "MultiPaxos", 3, tmp_path_factory.mktemp("ttl_cluster"),
+        num_groups=GROUPS, config={"seal_ttl_ticks": 150},
+    )
+    yield c
+    c.stop()
+
+
+class TestLiveSealTtl:
+    def test_leaderless_destination_expires_and_source_resumes(
+        self, ttl_cluster,
+    ):
+        """Adopting-leaderless destination: with the leader (and one
+        follower) paused, every replica still seals — ctrl is handled
+        even while paused — but nobody can adopt, and the one live
+        follower cannot elect itself without quorum.  Its ticks carry
+        the seal past the TTL, the manager rolls the change back, and
+        after resume the range serves from the SOURCE group with zero
+        executed cutovers."""
+        from summerset_tpu.client.drivers import DriverClosedLoop
+        from summerset_tpu.client.endpoint import scrape_metrics
+
+        key = "rs_ttl"
+        ep = _ep(ttl_cluster)
+        drv = DriverClosedLoop(ep, timeout=10.0)
+        drv.checked_put(key, "t0")
+
+        info = ep.ctrl.request(CtrlRequest("query_info"))
+        leader = info.leader if info.leader is not None else 0
+        followers = [s for s in sorted(info.servers) if s != leader]
+        live = followers[-1]
+        paused = [s for s in sorted(info.servers) if s != live]
+        rep = ep.ctrl.request(
+            CtrlRequest("pause_servers", servers=paused), timeout=60.0,
+        )
+        assert sorted(rep.done or ()) == paused
+        try:
+            _issue(ttl_cluster, "split", key, away_of(key))
+            # the live follower's ticks must walk the seal past the TTL
+            # (150 ticks ~ 0.75s) and the manager must expire it
+            deadline = time.monotonic() + 30.0
+            expired = 0
+            while time.monotonic() < deadline and not expired:
+                full = scrape_metrics(ttl_cluster.manager_addr) or {}
+                expired = max((
+                    snap.get("host", {}).get("counters", {})
+                        .get("reshard_seal_expired", 0)
+                    for snap in full.values()
+                ), default=0)
+                time.sleep(0.3)
+            assert expired >= 1, "seal never expired"
+        finally:
+            ep.ctrl.request(
+                CtrlRequest("resume_servers", servers=paused),
+                timeout=60.0,
+            )
+        time.sleep(1.0)
+        # the rolled-back range serves again — from the source group
+        _put_until_acked(drv, key, "t1")
+        drv.checked_get(key, expect="t1")
+        full = scrape_metrics(ttl_cluster.manager_addr) or {}
+        for snap in full.values():
+            ctr = snap.get("host", {}).get("counters", {})
+            assert ctr.get("reshard_splits", 0) == 0
+        # ... and the manager no longer advertises the change
+        info = ep.ctrl.request(CtrlRequest("query_info"))
+        assert not (getattr(info, "ranges", None) or [])
         ep.leave()
