@@ -39,8 +39,9 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from autopilot_soak import (  # noqa: E402  (scripts/ sibling import)
-    MAX_TOTAL_FIRES, MIN_WIN_RATIO, SHIFTS, WINDOWS, build_schedule,
-    make_policy, schedule_digest,
+    MAX_TOTAL_FIRES, MIN_WIN_RATIO, QL_GROUPS, QL_MAX_TOTAL_FIRES,
+    SHIFTS, WINDOWS, build_ql_schedule, build_schedule, make_policy,
+    make_ql_policy, ql_schedule_digest, schedule_digest,
 )
 
 
@@ -124,6 +125,74 @@ def check_autopilot_ab(row) -> list:
     return errs
 
 
+def check_autopilot_ql(row) -> list:
+    """The QuorumLeases multi-group twin row: lease-plane actuator
+    coverage (conf_resize through a live ConfChange, reshard through a
+    live range_change) with the same safety bar as the MultiPaxos row."""
+    errs = []
+    if not row.get("ok"):
+        errs.append(f"ql row not ok: {row.get('error')}")
+
+    # ---- drift: schedule + policy knobs regenerate byte-for-byte
+    wplan = build_ql_schedule()
+    pol = make_ql_policy()
+    if row.get("wl_digest") != wplan.digest():
+        errs.append(f"ql workload digest drift: committed "
+                    f"{row.get('wl_digest')} vs {wplan.digest()}")
+    if row.get("schedule_digest") != ql_schedule_digest():
+        errs.append(f"ql schedule digest drift: committed "
+                    f"{row.get('schedule_digest')} vs "
+                    f"{ql_schedule_digest()}")
+    if row.get("policy_config_digest") != pol.config_digest():
+        errs.append(f"ql policy knob drift: committed "
+                    f"{row.get('policy_config_digest')} vs "
+                    f"{pol.config_digest()}")
+    if row.get("num_groups") != QL_GROUPS:
+        errs.append(f"ql group-count drift: {row.get('num_groups')}")
+
+    # ---- both twin cells: linearizable, no lost acks, recovered
+    for mode in ("off", "on"):
+        sub = row.get(mode) or {}
+        if not sub.get("linearizable"):
+            errs.append(f"ql {mode} cell history not linearizable")
+        if sub.get("ack_shed_overlap"):
+            errs.append(f"ql {mode} cell lost acks to sheds: "
+                        f"{sub['ack_shed_overlap']}")
+        if not sub.get("recovered"):
+            errs.append(f"ql {mode} cell never recovered")
+
+    on = row.get("on") or {}
+    off = row.get("off") or {}
+    # ---- lease-plane actuator coverage, executed not just fired
+    fires = on.get("fires") or {}
+    if fires.get("conf_resize", 0) < 1:
+        errs.append("no conf_resize actuation in the ql on cell")
+    if fires.get("reshard", 0) < 1:
+        errs.append("no reshard actuation in the ql on cell")
+    if not any(c.get("ok") for c in (on.get("conf_log") or [])):
+        errs.append("no responder conf re-installed live in the "
+                    "ql on cell")
+    if on.get("splits", 0) < 1:
+        errs.append("no live split executed in the ql on cell")
+    acts = on.get("actuations") or []
+    if not any(a.startswith("conf_ctl") for a in acts):
+        errs.append("ql actuation log carries no conf_ctl entry")
+    if not any("range_change" in a for a in acts):
+        errs.append("ql actuation log carries no range_change entry")
+
+    # ---- bounded actuation + observe-mode cleanliness
+    if sum(fires.values()) > QL_MAX_TOTAL_FIRES:
+        errs.append(f"unbounded ql actuation: {fires}")
+    if on.get("max_window_spend", 0) > on.get("budget_per_window", 0):
+        errs.append("ql per-window actuation budget exceeded")
+    if off.get("n_actuations") != 0:
+        errs.append(f"ql observe-mode driver sent "
+                    f"{off.get('n_actuations')} ctrl mutations")
+    if off.get("splits", 0) or off.get("merges", 0):
+        errs.append("ql off cell executed range changes")
+    return errs
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--json",
@@ -141,16 +210,25 @@ def main() -> int:
         print(f"FAIL: expected exactly one autopilot_ab row, "
               f"found {len(ab)}")
         return 1
-    errs = check_autopilot_ab(ab[0])
+    ql = [r for r in rows if r.get("kind") == "autopilot_ql"]
+    if len(ql) != 1:
+        print(f"FAIL: expected exactly one autopilot_ql row, "
+              f"found {len(ql)}")
+        return 1
+    errs = check_autopilot_ab(ab[0]) + check_autopilot_ql(ql[0])
     if errs:
         for e in errs:
             print(f"FAIL: {e}")
         return 1
     on = ab[0].get("on") or {}
+    ql_on = ql[0].get("on") or {}
     print(f"autopilot gate OK: schedule {ab[0]['schedule_digest']}, "
           f"window ratios {ab[0].get('window_ratios')}, "
           f"fires {on.get('fires')}, "
-          f"tail quiet, observe byte-identical")
+          f"tail quiet, observe byte-identical; "
+          f"ql schedule {ql[0]['schedule_digest']}, "
+          f"ql fires {ql_on.get('fires')}, "
+          f"splits {ql_on.get('splits')}")
     return 0
 
 

@@ -101,6 +101,27 @@ SLOW_PEER_BW = 48_000.0
 SLOW_PEER_STARVE = 0.75
 SLOW_DISK_X = 45.0
 
+# ---- QuorumLeases multi-group twin cell (the autopilot_ql row) ------
+# The lease-plane actuators (conf_resize via client ConfChange,
+# reshard via range_change) only exist on lease protocols over a
+# multi-group keyspace, which the MultiPaxos ab cell can never cover.
+# This cell runs the same off/on twin shape on QuorumLeases x
+# QL_GROUPS under zipfian-concentrated heat: the ON driver must LIVE-
+# shrink the responder set (heat-concentrated conf_resize through the
+# conf_ctl hook) and LIVE-split the hot range (embedded
+# ResharderPolicy through the ctrl plane), the OFF observer must stay
+# mutation-free, and both histories must stay linearizable with zero
+# acked-and-shed values across every actuation.
+QL_SEED = 5
+QL_GROUPS = 2
+QL_HORIZON = 80          # schedule ticks (x TICK_LEN wall seconds)
+QL_STEADY_X = 0.5        # offered rate, x calibrated capacity
+QL_HOT_SHARE = 0.2       # conf_resize heat-concentration threshold
+QL_HEAT_MIN = 10         # min sensed heat delta per round
+QL_RESHARD_HOT_FRAC = 0.15
+QL_RESHARD_COLD_FRAC = 0.05
+QL_MAX_TOTAL_FIRES = 8   # convergence bound for the QL cell
+
 
 def protocol_config() -> dict:
     return {
@@ -173,6 +194,66 @@ def schedule_digest() -> str:
         + f"shifts={SHIFTS} windows={WINDOWS} settle={SETTLE_TICKS}\n"
         + f"steady_x={STEADY_X:g} overload_x={OVERLOAD_X:g}"
         + f" scrape_s={AP_SCRAPE_S:g} tick_len={TICK_LEN:g}\n"
+        + pol.config_line()
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def ql_hash_group(key: str) -> int:
+    """Mirrors ServerReplica.group_of over QL_GROUPS — the hash-home
+    placement the embedded resharder splits away from."""
+    import zlib
+
+    return zlib.crc32(key.encode()) % QL_GROUPS
+
+
+def build_ql_schedule():
+    """The QL cell's workload: one steady phase of zipfian-hot
+    read-mostly traffic (heat stays concentrated, so the lease-plane
+    levers have a persistent signal to act on).  Regenerable by the
+    gate without a cluster."""
+    from summerset_tpu.host.workload import WorkloadPhase, WorkloadPlan
+
+    base = WorkloadPlan.generate(
+        QL_SEED, "read_mostly", clients=CLIENTS, num_keys=NUM_KEYS,
+        horizon=QL_HORIZON,
+    )
+    return dataclasses.replace(
+        base, phases=(WorkloadPhase(0, QL_HORIZON, QL_STEADY_X),)
+    )
+
+
+def make_ql_policy():
+    """The QL cell's policy: lease thresholds sized to the cell's
+    zipfian top-share (~0.25 over 24 keys) and its sensed heat volume,
+    with the embedded resharder budget-gated exactly as the reshard
+    soaks wire it.  Shared with the gate (config digest)."""
+    from summerset_tpu.host.autopilot import AutopilotPolicy
+    from summerset_tpu.host.resharding import ResharderPolicy
+
+    return AutopilotPolicy(
+        seed=QL_SEED, population=REPLICAS, num_groups=QL_GROUPS,
+        streak_need=2, cooldown_rounds=3, window_rounds=4,
+        budget_per_window=2, lease_hot_share=QL_HOT_SHARE,
+        heat_min=QL_HEAT_MIN,
+        resharder=ResharderPolicy(
+            QL_GROUPS, ql_hash_group,
+            hot_frac=QL_RESHARD_HOT_FRAC,
+            cold_frac=QL_RESHARD_COLD_FRAC, min_total=QL_HEAT_MIN,
+        ),
+    )
+
+
+def ql_schedule_digest() -> str:
+    """Drift anchor for the QL cell: workload timeline + policy knob
+    line + the cell's own axis constants."""
+    wplan = build_ql_schedule()
+    pol = make_ql_policy()
+    blob = (
+        wplan.timeline()
+        + f"groups={QL_GROUPS} horizon={QL_HORIZON}"
+        + f" steady_x={QL_STEADY_X:g} scrape_s={AP_SCRAPE_S:g}"
+        + f" tick_len={TICK_LEN:g}\n"
         + pol.config_line()
     )
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
@@ -496,6 +577,275 @@ def run_cell(mode: str, args, shared: dict) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_ql_cell(mode: str, args, shared: dict) -> dict:
+    """One QL twin cell: QuorumLeases over ``QL_GROUPS`` groups with
+    the lease plane live (wide responder conf installed up front) under
+    steady zipfian-hot traffic.  ``mode`` "off" attaches an observing
+    driver (zero mutations); "on" closes the loop with the conf_ctl
+    hook (live client ConfChange) and the ctrl plane (range_change)."""
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
+    from summerset_tpu.client.tester import start_workload_clients
+    from summerset_tpu.host.autopilot import AutopilotDriver
+    from summerset_tpu.utils.linearize import check_history
+
+    wplan = build_ql_schedule()
+    sub = {"mode": mode}
+    tmp = tempfile.mkdtemp(prefix=f"apql_{mode}_")
+    cluster = None
+    stop = threading.Event()
+    ops: list = []
+    stats: list = []
+    threads: list = []
+    driver = None
+    conf_state = {"responders": sorted(range(REPLICAS)), "log": []}
+    try:
+        cluster = Cluster(
+            "QuorumLeases", REPLICAS, tmp, config=protocol_config(),
+            tick=args.tick, num_groups=QL_GROUPS,
+        )
+        wep = GenericEndpoint(cluster.manager_addr)
+        wep.connect()
+        wdrv = DriverClosedLoop(wep, timeout=10.0)
+        wdrv.checked_put("warm", "1")
+        # the lease plane must be LIVE in both modes (the ON cell's
+        # lever is re-sizing it, not bootstrapping it): grant read
+        # leases everywhere before the schedule clock starts
+        wdrv.conf_change(
+            {"responders": list(range(REPLICAS))}
+        )
+        wep.leave()
+        time.sleep(2.0)  # lease grants settle
+        if shared.get("ql_cap") is None:
+            shared["ql_cap"] = calibrate_capacity(
+                cluster.manager_addr, timeout=args.op_timeout,
+            )
+            time.sleep(
+                min(2.0, API_MAX_PENDING / shared["ql_cap"] + 0.3)
+            )
+        cap = shared["ql_cap"]
+        print(f"--- autopilot_ql {mode}: {cap:.1f} ops/s calibrated, "
+              f"schedule {ql_schedule_digest()}")
+
+        pol = make_ql_policy()
+
+        def conf_ctl(target) -> None:
+            # live responder re-size through a real client endpoint —
+            # the same ConfChange transport an operator would drive
+            try:
+                cep = GenericEndpoint(cluster.manager_addr)
+                cep.connect()
+                r = DriverClosedLoop(cep, timeout=8.0).conf_change(
+                    {"responders": [int(t) for t in target]}
+                )
+                cep.leave()
+            except Exception as e:
+                conf_state["log"].append(
+                    {"target": list(target), "error": repr(e)}
+                )
+                return
+            okc = r.kind == "success"
+            conf_state["log"].append(
+                {"target": sorted(int(t) for t in target), "ok": okc}
+            )
+            if okc:
+                conf_state["responders"] = sorted(
+                    int(t) for t in target
+                )
+
+        def sense_fn():
+            # the live scrape carries no responder conf; overlay the
+            # soak's tracked conf (updated on every successful
+            # ConfChange) so _eval_conf_resize sees the installed set
+            senses = driver._scrape()
+            if senses is not None:
+                senses["responders"] = list(conf_state["responders"])
+            return senses
+
+        driver = AutopilotDriver(
+            cluster.manager_addr, pol,
+            mode="act" if mode == "on" else "observe",
+            scrape_s=AP_SCRAPE_S, timeout=8.0,
+            conf_ctl=conf_ctl, sense_fn=sense_fn,
+        )
+        t0 = time.monotonic()
+
+        def rate_total_of() -> float:
+            tick = (time.monotonic() - t0) / TICK_LEN
+            return wplan.rate_x_at(tick) * cap
+
+        threads = start_workload_clients(
+            cluster.manager_addr, wplan, rate_total_of, stop, ops,
+            stats, timeout=args.op_timeout,
+        )
+        dthread = threading.Thread(
+            target=driver.play, args=(stop,), daemon=True
+        )
+        dthread.start()
+        threads.append(dthread)
+
+        horizon_s = QL_HORIZON * TICK_LEN
+        time.sleep(max(0.0, t0 + horizon_s - time.monotonic()))
+        time.sleep(2.0)   # drain inflight past the horizon
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        t_heal = time.monotonic()
+        budget_s = args.budget_ticks * args.tick
+        rep_ep = GenericEndpoint(cluster.manager_addr)
+        rep_ep.connect()
+        drv = DriverClosedLoop(rep_ep, timeout=min(5.0, budget_s))
+        recovered = False
+        while time.monotonic() - t_heal < budget_s:
+            r = drv.put("ql_recovery", f"m-{mode}")
+            if r.kind == "success":
+                recovered = True
+                break
+            drv._retry_pause(r)
+        rep_ep.leave()
+        sub["recovered"] = recovered
+        sub["recovery_ticks"] = int((time.monotonic() - t_heal)
+                                    / args.tick)
+
+        sub["num_ops"] = len(ops)
+        sub["issued"] = sum(s["issued"] for s in stats)
+        sub["acked"] = sum(s["acked"] for s in stats)
+        sub["shed"] = sum(s["shed"] for s in stats)
+        sub["conf_log"] = conf_state["log"]
+        sub["responders_final"] = conf_state["responders"]
+
+        acked_vals = {o.value for o in ops
+                      if o.kind == "put" and o.acked and not o.shed}
+        shed_vals = {o.value for o in ops if o.shed}
+        sub["ack_shed_overlap"] = len(acked_vals & shed_vals)
+
+        sub["decisions"] = [d.render() for d in pol.decisions()]
+        sub["decision_digest"] = pol.digest()
+        sub["policy_config_digest"] = pol.config_digest()
+        sub["fires"] = pol.fires()
+        sub["max_window_spend"] = pol.max_window_spend
+        sub["budget_per_window"] = pol.budget_per_window
+        sub["actuations"] = list(driver.actuation_log)
+        sub["n_actuations"] = len(driver.actuation_log)
+
+        full = scrape_metrics(cluster.manager_addr)
+        splits, merges, api_shed = {}, {}, {}
+        for sid, snap in (full or {}).items():
+            ctr = snap.get("host", {}).get("counters", {})
+            splits[sid] = ctr.get("reshard_splits", 0)
+            merges[sid] = ctr.get("reshard_merges", 0)
+            api_shed[sid] = ctr.get("api_shed", 0)
+        sub["reshard_splits"] = splits
+        sub["reshard_merges"] = merges
+        sub["api_shed"] = api_shed
+        sub["splits"] = max(splits.values(), default=0)
+        sub["merges"] = max(merges.values(), default=0)
+
+        ok, diag = check_history(ops)
+        sub["linearizable"] = bool(ok)
+        if not ok:
+            sub["error"] = diag
+        return sub
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if driver is not None:
+            driver.close()
+        if cluster is not None:
+            cluster.stop()
+        if not sub.get("linearizable"):
+            dump = os.path.splitext(args.out)[0] + (
+                f"_ql_{mode}_fail.json"
+            )
+            with open(dump, "w") as f:
+                json.dump({
+                    **{k: v for k, v in sub.items()},
+                    "workload_timeline": wplan.timeline(),
+                    "history": [
+                        {
+                            "client": o.client, "kind": o.kind,
+                            "key": o.key, "value": o.value,
+                            "t_inv": o.t_inv,
+                            "t_resp": (None if o.t_resp == float("inf")
+                                       else o.t_resp),
+                            "acked": o.acked, "shed": o.shed,
+                        }
+                        for o in sorted(ops, key=lambda o: o.t_inv)
+                    ],
+                }, f, indent=1)
+            print(f"FAIL bundle -> {dump}")
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_ql_ab(args) -> dict:
+    """The QL twin row: off/on over the same QL schedule.  Acceptance:
+    both histories linearizable with zero acked-and-shed values and a
+    bounded recovery; the ON cell fired AND lowered >= 1 conf_resize
+    (responder set actually re-installed through ConfChange) and >= 1
+    reshard (split actually adopted server-side) with actuation still
+    budget-bounded; the OFF observer sent zero mutations."""
+    wplan = build_ql_schedule()
+    pol = make_ql_policy()
+    row = {
+        "kind": "autopilot_ql", "protocol": "QuorumLeases",
+        "seed": QL_SEED, "replicas": REPLICAS,
+        "num_groups": QL_GROUPS,
+        "wl_digest": wplan.digest(),
+        "schedule_digest": ql_schedule_digest(),
+        "policy_config": pol.config_line(),
+        "policy_config_digest": pol.config_digest(),
+        "ok": False,
+    }
+    shared: dict = {"ql_cap": None}
+    row["off"] = run_ql_cell("off", args, shared)
+    row["on"] = run_ql_cell("on", args, shared)
+    row["capacity_ops_s"] = round(shared["ql_cap"] or 0.0, 1)
+
+    on, off = row["on"], row["off"]
+    errs = []
+    for mode in ("off", "on"):
+        sub = row[mode]
+        if not sub.get("linearizable"):
+            errs.append(f"{mode} history not linearizable "
+                        f"({sub.get('error')})")
+        if sub.get("ack_shed_overlap"):
+            errs.append(f"{mode}: {sub['ack_shed_overlap']} values "
+                        "both acked and shed")
+        if sub.get("num_ops", 0) < args.min_ops:
+            errs.append(f"{mode} history too small: "
+                        f"{sub.get('num_ops')}")
+        if not sub.get("recovered"):
+            errs.append(f"{mode} no recovery within budget")
+    fires = on.get("fires") or {}
+    if fires.get("conf_resize", 0) < 1:
+        errs.append("no conf_resize actuation fired in the on cell")
+    if fires.get("reshard", 0) < 1:
+        errs.append("no reshard actuation fired in the on cell")
+    if not any(c.get("ok") for c in (on.get("conf_log") or [])):
+        errs.append("no responder conf actually re-installed live")
+    if on.get("splits", 0) < 1:
+        errs.append("no live split executed in the on cell")
+    if sum(fires.values()) > QL_MAX_TOTAL_FIRES:
+        errs.append(f"unbounded actuation: {fires}")
+    if on.get("max_window_spend", 0) > on.get("budget_per_window", 0):
+        errs.append("per-window actuation budget exceeded")
+    if off.get("n_actuations") != 0:
+        errs.append(f"observe-mode driver sent "
+                    f"{off.get('n_actuations')} ctrl mutations")
+    if off.get("splits", 0) or off.get("merges", 0):
+        errs.append("off cell executed range changes")
+    row["ok"] = not errs
+    if errs:
+        row["error"] = "; ".join(errs)
+    return row
+
+
 def run_ab(args) -> dict:
     wplan_a, wplan_b, fplan = build_schedule()
     pol = make_policy()
@@ -588,14 +938,24 @@ def main():
           f"(ratios={row.get('window_ratios')}, "
           f"fires={on.get('fires')}, "
           f"batch_final={on.get('api_max_batch_final')})")
+
+    ql_row = run_ql_ab(args)
+    ql_status = ("PASS" if ql_row["ok"]
+                 else f"FAIL ({ql_row.get('error')})")
+    ql_on = ql_row.get("on") or {}
+    print(f"=== autopilot_ql: {ql_status} "
+          f"(fires={ql_on.get('fires')}, "
+          f"splits={ql_on.get('splits')}, "
+          f"responders={ql_on.get('responders_final')})")
+
     with open(args.out, "w") as f:
-        json.dump([row], f, indent=1)
+        json.dump([row, ql_row], f, indent=1)
     print(f"wrote {args.out}")
     sys.stdout.flush()
     sys.stderr.flush()
     # hard exit: same rationale as workload_soak (daemon replica
     # threads frozen mid-XLA can std::terminate after results land)
-    os._exit(0 if row["ok"] else 1)
+    os._exit(0 if (row["ok"] and ql_row["ok"]) else 1)
 
 
 if __name__ == "__main__":

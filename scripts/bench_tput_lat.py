@@ -258,6 +258,11 @@ def main():
                          "bench mix, so default trajectories stay "
                          "comparable")
     ap.add_argument("--workload-seed", type=int, default=1)
+    ap.add_argument("--trace", default="",
+                    help="YCSB trace file replayed byte-reproducibly "
+                         "via WorkloadPlan.from_trace (the plan's "
+                         "digest + the raw file's sha are stamped "
+                         "into the artifact); overrides --workload")
     ap.add_argument("--tally", default="pairwise",
                     choices=("pairwise", "collective"),
                     help="quorum-tally transport for every replica's "
@@ -298,7 +303,15 @@ def main():
     from summerset_tpu.host.workload import WorkloadPlan
 
     plan = None
-    if args.workload != "uniform":
+    if args.trace:
+        # trace replay: the plan normalizes the YCSB rows once and
+        # stamps both the raw file's sha and the plan digest, so two
+        # curves over the same trace are byte-comparable
+        plan = WorkloadPlan.from_trace(
+            args.trace, seed=args.workload_seed, clients=args.clients,
+        )
+        args.workload = "trace"
+    elif args.workload != "uniform":
         plan = WorkloadPlan.generate(
             args.workload_seed, args.workload, clients=args.clients,
             num_keys=args.num_keys,
@@ -371,6 +384,10 @@ def main():
         "workload": args.workload,
         "workload_seed": args.workload_seed,
         "workload_digest": plan.digest() if plan is not None else None,
+        # trace replay stamp: which raw YCSB file fed the plan (sha of
+        # the parsed rows — same trace must reproduce the same digest)
+        "trace_file": args.trace or None,
+        "trace_sha": plan.trace_sha() if args.trace else None,
         # quorum-tally transport stamp (core/quorum.py), next to the
         # mesh block like bench.py
         "tally": args.tally,

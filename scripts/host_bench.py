@@ -44,7 +44,15 @@ def fleet_worker(spec_json: str) -> None:
     from summerset_tpu.host.workload import WorkloadPlan
 
     plan = None
-    if spec.get("workload") and spec["workload"] != "uniform":
+    if spec.get("trace"):
+        # trace replay: every worker normalizes the same YCSB file with
+        # the same seed/clients clamp, so the fleet-wide op streams are
+        # exactly the plan the parent's digest attests
+        plan = WorkloadPlan.from_trace(
+            spec["trace"], seed=spec["workload_seed"],
+            clients=spec["plan_clients"],
+        )
+    elif spec.get("workload") and spec["workload"] != "uniform":
         # plan_clients is the FLEET-WIDE clamp the parent stamped the
         # digest with — a per-worker share here would generate (and
         # run) a different plan than the artifact attests
@@ -637,6 +645,11 @@ def main() -> None:
                          "the legacy bench mix so default trajectories "
                          "stay comparable")
     ap.add_argument("--workload-seed", type=int, default=1)
+    ap.add_argument("--trace", default="",
+                    help="YCSB trace file replayed byte-reproducibly "
+                         "via WorkloadPlan.from_trace (plan digest + "
+                         "parsed-row sha stamped into the artifact); "
+                         "overrides --workload")
     ap.add_argument("--proxies", type=int, default=0,
                     help="ingress proxies in front of the shards "
                          "(0 = fused single-process serving, the "
@@ -702,7 +715,15 @@ def main() -> None:
 
     plan_clients = max(4, min(64, args.clients))
     plan_digest = None
-    if args.workload != "uniform":
+    trace_sha = None
+    if args.trace:
+        _tp = WorkloadPlan.from_trace(
+            args.trace, seed=args.workload_seed, clients=plan_clients,
+        )
+        plan_digest = _tp.digest()
+        trace_sha = _tp.trace_sha()
+        args.workload = "trace"
+    elif args.workload != "uniform":
         plan_digest = WorkloadPlan.generate(
             args.workload_seed, args.workload,
             clients=plan_clients, num_keys=args.num_keys,
@@ -779,6 +800,7 @@ def main() -> None:
             "think": args.think,
             "workload": args.workload,
             "workload_seed": args.workload_seed,
+            "trace": args.trace or None,
         }
         workers.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__),
@@ -899,6 +921,10 @@ def main() -> None:
         "workload": args.workload,
         "workload_seed": args.workload_seed,
         "workload_digest": plan_digest,
+        # trace replay stamp: raw YCSB file + parsed-row sha — the same
+        # trace must reproduce the same plan digest on any box
+        "trace_file": args.trace or None,
+        "trace_sha": trace_sha,
         "tput": round(tput, 2),
         "lat_p50_ms": round(p50, 3),
         "lat_p99_ms": round(p99, 3),

@@ -45,7 +45,8 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 from workload_soak import (  # noqa: E402  (scripts/ sibling import)
     DEFAULT_BUDGET_TICKS, FAULT_CLASSES, P99_BUDGET_S,
     PROXY_AB_MIN_RATIO, PROXY_CELL, PROXY_COUNT, RECOVER_FRAC,
-    RESHARD_GROUPS, WL_MATRIX, build_plans, build_proxy_plan,
+    RESHARD_GROUPS, SCAN_CELL_KINDS, SCAN_RESHARD_SEED, TRACE_FILE,
+    WL_MATRIX, build_plans, build_proxy_plan, build_scan_plan,
 )
 
 DEFAULT_REPLICAS = 3
@@ -175,6 +176,94 @@ def check_reshard_ab(row) -> list:
     return fails
 
 
+def check_scan_row(row) -> list:
+    """Gate one range-read cell row.  Shared obligations: the row
+    passed, its plan digest regenerates byte-identically (for the trace
+    cell that means RE-PARSING the committed fixture file — same bytes,
+    same normalized rows, same digest AND trace sha), the multi-key
+    history was linearizable with zero values both acked and shed,
+    scans were actually acked, p99 + bounded recovery held.  Cell-
+    specific: the QuorumLeases cells must show scans VISIBLY served
+    from the learner read tier (``read_tier_scans`` > 0); the
+    scan_reshard cell must have EXECUTED >= 1 live split under scan
+    load (server-side adoption counters) over ``RESHARD_GROUPS``
+    groups."""
+    from summerset_tpu.host.workload import WorkloadPlan
+    from workload_soak import DEFAULT_CLIENTS, DEFAULT_HORIZON, \
+        DEFAULT_KEYS
+
+    kind = row.get("kind")
+    tag = kind
+    fails = []
+    if not row.get("ok"):
+        fails.append(f"{tag}: failed ({row.get('error')})")
+    if kind == "scan_reshard":
+        wplan = WorkloadPlan.generate(
+            SCAN_RESHARD_SEED, "ycsb_e", clients=DEFAULT_CLIENTS,
+            num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+        )
+    else:
+        try:
+            wplan = build_scan_plan(kind)
+        except (OSError, ValueError) as e:
+            return fails + [f"{tag}: plan regeneration failed ({e!r})"]
+    if row.get("wl_digest") != wplan.digest():
+        fails.append(
+            f"{tag}: workload digest drift — committed "
+            f"{row.get('wl_digest')} vs regenerated {wplan.digest()}; "
+            "rerun scripts/workload_soak.py --scan-cells and commit "
+            "the diff"
+        )
+    if kind == "trace":
+        # byte-reproducibility is the trace cell's contract: the
+        # committed fixture must still normalize to the committed rows
+        if row.get("trace_file") != TRACE_FILE:
+            fails.append(f"{tag}: unexpected trace file "
+                         f"{row.get('trace_file')}")
+        if row.get("trace_sha") != wplan.trace_sha():
+            fails.append(
+                f"{tag}: trace sha drift — committed "
+                f"{row.get('trace_sha')} vs re-parsed "
+                f"{wplan.trace_sha()}"
+            )
+        if row.get("trace_rows") != len(wplan.trace):
+            fails.append(
+                f"{tag}: trace row count drift — committed "
+                f"{row.get('trace_rows')} vs re-parsed "
+                f"{len(wplan.trace)}"
+            )
+    if not row.get("linearizable"):
+        fails.append(f"{tag}: history not linearizable")
+    if row.get("ack_shed_overlap", 0) != 0:
+        fails.append(f"{tag}: {row['ack_shed_overlap']} values both "
+                     "acked and shed")
+    if row.get("scans_acked", 0) <= 0:
+        fails.append(f"{tag}: no scan ever acked")
+    if (row.get("p99_s") or 1e9) > P99_BUDGET_S:
+        fails.append(f"{tag}: accepted-op p99 {row.get('p99_s')}s "
+                     f"over the {P99_BUDGET_S}s budget")
+    rt = row.get("recovery_ticks")
+    if not row.get("recovered") or rt is None \
+            or rt > DEFAULT_BUDGET_TICKS:
+        fails.append(f"{tag}: recovery unbounded ({rt} ticks)")
+    if kind in ("ycsb_e", "trace"):
+        if row.get("read_tier_scans", 0) <= 0:
+            fails.append(
+                f"{tag}: no scan served from the learner read tier "
+                "(read_tier_scans == 0)"
+            )
+    else:
+        if row.get("num_groups") != RESHARD_GROUPS:
+            fails.append(f"{tag}: ran over {row.get('num_groups')} "
+                         f"groups (need {RESHARD_GROUPS})")
+        if row.get("splits", 0) < 1:
+            fails.append(f"{tag}: no live split executed under scan "
+                         f"load (adopted {row.get('splits')})")
+        if sum((row.get("scan_served") or {}).values()) <= 0:
+            fails.append(f"{tag}: servers served no scans")
+    return fails
+
+
 def check_hostbench_wire(path: str) -> list:
     """The committed wire-codec proof rows in HOSTBENCH.json: the
     10k-client A/B block and the microbench block must both be present
@@ -269,8 +358,16 @@ def main() -> int:
                         "scripts/workload_soak.py --reshard-ab)")
     for rab in rab_rows:
         failures.extend(check_reshard_ab(rab))
+    for kind in SCAN_CELL_KINDS:
+        srows = [r for r in rows if r.get("kind") == kind]
+        if not srows:
+            failures.append(f"{kind} row missing (run "
+                            "scripts/workload_soak.py --scan-cells)")
+        for sr in srows:
+            failures.extend(check_scan_row(sr))
     for row in rows:
-        if row.get("kind") in ("proxy_ab", "reshard_ab"):
+        if row.get("kind") in ("proxy_ab", "reshard_ab") \
+                or row.get("kind") in SCAN_CELL_KINDS:
             continue
         cell = (row.get("protocol"), row.get("wl_class"),
                 row.get("seed"))

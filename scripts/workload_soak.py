@@ -137,6 +137,21 @@ RESHARD_GROUPS = 4
 RESHARD_HOT_FRAC = 0.15    # split when a key draws this much heat
 RESHARD_COLD_FRAC = 0.05   # merge a moved key back below this
 RESHARD_SCRAPE_S = 1.2     # policy scrape/decide interval
+# ---- ordered range reads (ycsb_e / trace / scan_reshard cells) ------
+# The scan cells exercise the range-read plane end to end: ycsb_e and
+# trace run QuorumLeases behind a learner-read-tier proxy (scans must
+# be VISIBLY served lease-local: read_tier_scans > 0), scan_reshard
+# runs YCSB-E traffic over a 4-group keyspace and splits a hot range
+# mid-scan-storm over the ctrl plane (>= 1 executed split, zero values
+# both acked and shed, both histories linearizable-with-sheds).  The
+# trace cell replays the committed fixture below; same bytes => same
+# normalized rows => same plan digest, enforced live AND by the gate.
+SCAN_SEED = 2              # ycsb_e cell's workload seed
+TRACE_SEED = 1             # trace cell's client-stride salt
+SCAN_RESHARD_SEED = 3      # scan_reshard cell's workload seed
+SCAN_PROXIES = 1           # learner read tier size for the QL cells
+TRACE_FILE = os.path.join("scripts", "data", "ycsb_e_sample.trace")
+SCAN_CELL_KINDS = ("ycsb_e", "trace", "scan_reshard")
 # shared with scripts/workload_gate.py (digest regeneration)
 DEFAULT_CLIENTS = 3
 DEFAULT_KEYS = 24
@@ -1236,6 +1251,474 @@ def run_reshard_ab(args) -> dict:
     return row
 
 
+def build_scan_plan(kind: str):
+    """The scan cells' plans — regenerable by the gate without a
+    cluster (ycsb_e from its seed, trace by re-parsing the committed
+    fixture file; same bytes => same digest)."""
+    from summerset_tpu.host.workload import WorkloadPlan
+
+    if kind == "trace":
+        return WorkloadPlan.from_trace(
+            os.path.join(REPO, TRACE_FILE), seed=TRACE_SEED,
+            clients=DEFAULT_CLIENTS, horizon=DEFAULT_HORIZON,
+        )
+    return WorkloadPlan.generate(
+        SCAN_SEED, "ycsb_e", clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+
+
+def run_scan_cell(kind: str, args) -> dict:
+    """One learner-tier scan cell (``kind`` in {"ycsb_e", "trace"}):
+    QuorumLeases behind a learner-read-tier proxy with read leases
+    granted everywhere, driven by YCSB-E traffic (generated or trace
+    replay).  Asserts the range-read plane end to end: scans VISIBLY
+    served lease-local (``read_tier_scans`` > 0), the whole history —
+    multi-key cuts included — linearizable-with-sheds, zero values both
+    acked and shed, accepted-op p99 and the post-run recovery write
+    inside the fused budgets.  Committed as the ``kind`` WORKLOADS.json
+    row, gated by scripts/workload_gate.py (digest regeneration included
+    — the trace row's digest must match a re-parse of the committed
+    fixture)."""
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
+    from summerset_tpu.client.tester import start_workload_clients
+    from summerset_tpu.host.ingress import ServingPlane
+    from summerset_tpu.utils.linearize import check_history
+
+    wplan = build_scan_plan(kind)
+    w2 = build_scan_plan(kind)
+    # the repro contract — for the trace cell this IS the
+    # same-trace-same-digest guarantee, enforced live
+    assert wplan.timeline() == w2.timeline(), "non-deterministic wplan!"
+    row = {
+        "kind": kind, "protocol": "QuorumLeases",
+        "seed": wplan.seed, "wl_digest": wplan.digest(),
+        "proxies": SCAN_PROXIES, "ok": False,
+    }
+    if kind == "trace":
+        row["trace_file"] = TRACE_FILE
+        row["trace_sha"] = wplan.trace_sha()
+        row["trace_rows"] = len(wplan.trace)
+    tmp = tempfile.mkdtemp(prefix=f"wlscan_{kind}_")
+    cluster = None
+    plane = None
+    stop = threading.Event()
+    ops: list = []
+    stats: list = []
+    threads: list = []
+    try:
+        cluster = Cluster(
+            "QuorumLeases", args.replicas, tmp,
+            config=protocol_config("QuorumLeases"), tick=args.tick,
+        )
+        plane = ServingPlane(
+            cluster.manager_addr, proxies=SCAN_PROXIES,
+        ).start()
+        wep = GenericEndpoint(cluster.manager_addr)
+        wep.connect()
+        drv = DriverClosedLoop(wep, timeout=10.0)
+        drv.checked_put("warm", "1")
+        # grant read leases everywhere: lease-local scans need the
+        # installed responders conf (probes refuse until the grant
+        # lands, harmlessly — the learner falls back to forwarding)
+        drv.conf_change(
+            {"responders": list(range(args.replicas))}
+        )
+        wep.leave()
+        time.sleep(2.0)  # learner subscribe + lease grants settle
+        cap = calibrate_capacity(
+            cluster.manager_addr, wplan.clients,
+            timeout=args.op_timeout,
+        )
+        row["capacity_ops_s"] = round(cap, 1)
+        time.sleep(min(2.0, API_MAX_PENDING / cap + 0.3))
+        print(f"--- {kind} scan cell: QuorumLeases + {SCAN_PROXIES} "
+              f"proxies, wdigest={wplan.digest()}, "
+              f"capacity {cap:.1f} ops/s")
+        print(wplan.timeline(), end="")
+        t0 = time.monotonic()
+
+        def rate_total_of() -> float:
+            tick = (time.monotonic() - t0) / args.tick_len
+            return wplan.rate_x_at(tick) * cap
+
+        threads = start_workload_clients(
+            cluster.manager_addr, wplan, rate_total_of, stop, ops,
+            stats, timeout=args.op_timeout,
+        )
+        horizon_s = wplan.horizon() * args.tick_len
+        time.sleep(max(0.0, t0 + horizon_s - time.monotonic()))
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        # bounded recovery: a checked write within the tick budget
+        t_heal = time.monotonic()
+        budget_s = args.budget_ticks * args.tick
+        rep = GenericEndpoint(cluster.manager_addr)
+        rep.connect()
+        drv = DriverClosedLoop(rep, timeout=min(5.0, budget_s))
+        recovered = False
+        while time.monotonic() - t_heal < budget_s:
+            r = drv.put("wl_recovery", f"scan-{kind}")
+            if r.kind == "success":
+                recovered = True
+                break
+            drv._retry_pause(r)
+        rep.leave()
+        row["recovered"] = recovered
+        row["recovery_ticks"] = int(
+            (time.monotonic() - t_heal) / args.tick)
+
+        row["num_ops"] = len(ops)
+        row["issued"] = sum(s["issued"] for s in stats)
+        row["acked"] = sum(s["acked"] for s in stats)
+        row["shed"] = sum(s["shed"] for s in stats)
+        row["scans_acked"] = sum(
+            1 for o in ops if o.kind == "scan")
+        row["scan_keys_observed"] = sum(
+            len(o.items or ()) for o in ops if o.kind == "scan")
+
+        # serving attribution: the learner tier's scan counters are the
+        # cell's POINT — scans served lease-local, off the quorum path
+        full = scrape_metrics(cluster.manager_addr)
+        srv = {"scan_served": {}, "scan_shed": {}, "api_shed": {}}
+        for sid, snap in (full or {}).items():
+            ctr = snap.get("host", {}).get("counters", {})
+            for name in srv:
+                srv[name][sid] = ctr.get(name, 0)
+        row.update(srv)
+        tier = {"read_tier_scans": 0, "read_tier_served": 0,
+                "proxy_shed": 0}
+        for pid, snap in plane.scrape().items():
+            ctr = snap.get("host", {}).get("counters", {})
+            for name in tier:
+                tier[name] += ctr.get(name, 0)
+        row.update(tier)
+
+        acked_vals = {o.value for o in ops
+                      if o.kind == "put" and o.acked and not o.shed}
+        shed_vals = {o.value for o in ops if o.shed}
+        row["ack_shed_overlap"] = len(acked_vals & shed_vals)
+        lat = [o.t_resp - o.t_inv
+               for o in ops if o.acked and not o.shed]
+        row["p99_s"] = round(p99(lat), 3)
+
+        ok, diag = check_history(ops)
+        row["linearizable"] = bool(ok)
+        errs = []
+        if not ok:
+            errs.append(f"history not linearizable: {diag}")
+        if row["num_ops"] < args.min_ops:
+            errs.append(f"history too small: {row['num_ops']}")
+        if row["scans_acked"] <= 0:
+            errs.append("no scan ever acked")
+        if row["read_tier_scans"] <= 0:
+            errs.append("no scan served from the learner read tier")
+        if row["ack_shed_overlap"]:
+            errs.append(f"{row['ack_shed_overlap']} values both "
+                        "acked and shed")
+        if row["p99_s"] > args.p99_budget:
+            errs.append(f"accepted-op p99 {row['p99_s']}s over "
+                        f"budget {args.p99_budget}s")
+        if not recovered:
+            errs.append("no recovery within budget")
+        row["ok"] = not errs
+        if errs:
+            row["error"] = "; ".join(errs)
+        return row
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if plane is not None:
+            plane.stop()
+        if cluster is not None:
+            cluster.stop()
+        if not row["ok"]:
+            dump = os.path.splitext(args.out)[0] + (
+                f"_scan_{kind}_fail.json"
+            )
+            with open(dump, "w") as f:
+                json.dump(
+                    fail_bundle_doc(row, wplan, None, None, ops),
+                    f, indent=1,
+                )
+            print(f"FAIL bundle -> {dump}")
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_scan_reshard(args) -> dict:
+    """The adversarial scan cell: YCSB-E traffic (a scan storm — ~95%
+    ordered range reads) over a ``RESHARD_GROUPS``-group keyspace while
+    ``range_change`` splits the plan's hot range live over the ctrl
+    plane, then merges it back.  Scans straddling the cutover must shed
+    OR serve a consistent cut — never an inconsistent one and never
+    acked-then-shed — so the asserts are: >= 1 split EXECUTED server-
+    side (``reshard_splits``), zero values both acked and shed, the
+    whole multi-key history linearizable, scans still acked (the storm
+    survives the migration point), p99 + recovery inside the fused
+    budgets.  Committed as ``kind == "scan_reshard"``."""
+    import random
+    import zlib
+
+    from test_cluster import Cluster
+
+    from summerset_tpu.client.drivers import DriverClosedLoop
+    from summerset_tpu.client.endpoint import (
+        GenericEndpoint, scrape_metrics,
+    )
+    from summerset_tpu.client.tester import start_workload_clients
+    from summerset_tpu.host.messages import CtrlRequest
+    from summerset_tpu.host.resharding import (
+        RangeChange, single_key_range,
+    )
+    from summerset_tpu.host.workload import WorkloadPlan
+    from summerset_tpu.utils.linearize import check_history
+
+    wplan = WorkloadPlan.generate(
+        SCAN_RESHARD_SEED, "ycsb_e", clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    w2 = WorkloadPlan.generate(
+        SCAN_RESHARD_SEED, "ycsb_e", clients=DEFAULT_CLIENTS,
+        num_keys=DEFAULT_KEYS, horizon=DEFAULT_HORIZON,
+    )
+    assert wplan.timeline() == w2.timeline(), "non-deterministic wplan!"
+    # the plan's hot-key order (OpStream's shared shuffle): the split
+    # victim when the heat scrape has nothing yet — zipfian scan STARTS
+    # concentrate here, so splitting it lands mid-scan-storm by
+    # construction
+    order = list(range(wplan.num_keys))
+    random.Random((wplan.seed << 8) | 0xA5).shuffle(order)
+    hot_keys = [f"w{i}" for i in order]
+
+    def hash_group(key: str) -> int:
+        return zlib.crc32(key.encode()) % RESHARD_GROUPS
+
+    row = {
+        "kind": "scan_reshard", "protocol": "MultiPaxos",
+        "seed": SCAN_RESHARD_SEED, "wl_digest": wplan.digest(),
+        "num_groups": RESHARD_GROUPS, "ok": False,
+    }
+    tmp = tempfile.mkdtemp(prefix="wlscan_reshard_")
+    cluster = None
+    stop = threading.Event()
+    ops: list = []
+    stats: list = []
+    threads: list = []
+    changes: list = []
+    try:
+        cluster = Cluster(
+            "MultiPaxos", args.replicas, tmp,
+            config=protocol_config("MultiPaxos"), tick=args.tick,
+            num_groups=RESHARD_GROUPS,
+        )
+        wep = GenericEndpoint(cluster.manager_addr)
+        wep.connect()
+        DriverClosedLoop(wep, timeout=10.0).checked_put("warm", "1")
+        wep.leave()
+        cap = calibrate_capacity(
+            cluster.manager_addr, wplan.clients,
+            timeout=args.op_timeout,
+        )
+        row["capacity_ops_s"] = round(cap, 1)
+        time.sleep(min(2.0, API_MAX_PENDING / cap + 0.3))
+        print(f"--- scan_reshard: ycsb_e over {RESHARD_GROUPS} groups "
+              f"at {cap:.1f} ops/s, wdigest={wplan.digest()}, "
+              f"hot={hot_keys[0]}")
+        t0 = time.monotonic()
+        horizon_s = wplan.horizon() * args.tick_len
+
+        def rate_total_of() -> float:
+            tick = (time.monotonic() - t0) / args.tick_len
+            return wplan.rate_x_at(tick) * cap
+
+        threads = start_workload_clients(
+            cluster.manager_addr, wplan, rate_total_of, stop, ops,
+            stats, timeout=args.op_timeout,
+        )
+
+        def drive_changes() -> None:
+            """Mid-storm split of the hottest range (heat-scraped, plan
+            fallback), a second split, then a merge back — all live
+            over the ctrl plane while scans are in flight."""
+            ep = GenericEndpoint(cluster.manager_addr)
+            moved: list = []
+
+            def hottest(exclude) -> str:
+                try:
+                    full = scrape_metrics(
+                        cluster.manager_addr, timeout=10.0)
+                except Exception:
+                    full = None
+                cum: dict = {}
+                for sid, snap in (full or {}).items():
+                    gauges = (snap.get("host", {})
+                                  .get("gauges", {}) or {})
+                    for name, v in gauges.items():
+                        if name.startswith("range_heat{key="):
+                            k = name[len("range_heat{key="):-1]
+                            cum[k] = cum.get(k, 0) + int(v)
+                for k, _ in sorted(cum.items(),
+                                   key=lambda t: -t[1]):
+                    if k not in exclude and k.startswith("w"):
+                        return k
+                return next(k for k in hot_keys if k not in exclude)
+
+            def request(op: str, key: str, dst: int) -> None:
+                s, e = single_key_range(key)
+                try:
+                    rep = ep.ctrl.request(
+                        CtrlRequest("range_change",
+                                    payload=RangeChange(
+                                        op, s, e, dst).as_dict()),
+                        timeout=60.0,
+                    )
+                except Exception as exc:
+                    changes.append({"op": op, "key": key,
+                                    "error": repr(exc)})
+                    return
+                ok = rep is not None and rep.kind != "error"
+                changes.append({
+                    "op": op, "key": key, "dst": dst, "ok": ok,
+                    "at_tick": round(
+                        (time.monotonic() - t0) / args.tick_len, 1),
+                })
+                if ok and op == "split":
+                    moved.append(key)
+                elif ok and key in moved:
+                    moved.remove(key)
+
+            for frac, act in ((0.35, "split"), (0.55, "split"),
+                              (0.80, "merge")):
+                lag = t0 + frac * horizon_s - time.monotonic()
+                if lag > 0:
+                    stop.wait(lag)
+                if stop.is_set():
+                    break
+                if act == "split":
+                    key = hottest(moved)
+                    request("split", key,
+                            (hash_group(key) + 1) % RESHARD_GROUPS)
+                elif moved:
+                    key = moved[0]
+                    request("merge", key, hash_group(key))
+            try:
+                ep.ctrl.close()
+            except Exception:
+                pass
+
+        ct = threading.Thread(target=drive_changes, daemon=True)
+        ct.start()
+        threads.append(ct)
+
+        time.sleep(max(0.0, t0 + horizon_s - time.monotonic()))
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        # bounded recovery: a checked write within the tick budget
+        t_heal = time.monotonic()
+        budget_s = args.budget_ticks * args.tick
+        rep_ep = GenericEndpoint(cluster.manager_addr)
+        rep_ep.connect()
+        drv = DriverClosedLoop(rep_ep, timeout=min(5.0, budget_s))
+        recovered = False
+        while time.monotonic() - t_heal < budget_s:
+            r = drv.put("wl_recovery", "scan-reshard")
+            if r.kind == "success":
+                recovered = True
+                break
+            drv._retry_pause(r)
+        rep_ep.leave()
+        row["recovered"] = recovered
+        row["recovery_ticks"] = int(
+            (time.monotonic() - t_heal) / args.tick)
+
+        row["num_ops"] = len(ops)
+        row["issued"] = sum(s["issued"] for s in stats)
+        row["acked"] = sum(s["acked"] for s in stats)
+        row["shed"] = sum(s["shed"] for s in stats)
+        row["scans_acked"] = sum(1 for o in ops if o.kind == "scan")
+        row["changes"] = changes
+        row["splits_issued"] = sum(
+            1 for c in changes if c.get("op") == "split"
+            and c.get("ok"))
+        row["merges_issued"] = sum(
+            1 for c in changes if c.get("op") == "merge"
+            and c.get("ok"))
+
+        # server-side evidence: cutovers EXECUTED and scans were served
+        # (and shed) at the shards across the migration point
+        full = scrape_metrics(cluster.manager_addr)
+        srv = {"reshard_splits": {}, "reshard_merges": {},
+               "scan_served": {}, "scan_shed": {}, "api_shed": {}}
+        for sid, snap in (full or {}).items():
+            ctr = snap.get("host", {}).get("counters", {})
+            for name in srv:
+                srv[name][sid] = ctr.get(name, 0)
+        row.update(srv)
+        row["splits"] = max(srv["reshard_splits"].values(), default=0)
+        row["merges"] = max(srv["reshard_merges"].values(), default=0)
+
+        acked_vals = {o.value for o in ops
+                      if o.kind == "put" and o.acked and not o.shed}
+        shed_vals = {o.value for o in ops if o.shed}
+        row["ack_shed_overlap"] = len(acked_vals & shed_vals)
+        lat = [o.t_resp - o.t_inv
+               for o in ops if o.acked and not o.shed]
+        row["p99_s"] = round(p99(lat), 3)
+
+        ok, diag = check_history(ops)
+        row["linearizable"] = bool(ok)
+        errs = []
+        if not ok:
+            errs.append(f"history not linearizable: {diag}")
+        if row["num_ops"] < args.min_ops:
+            errs.append(f"history too small: {row['num_ops']}")
+        if row["scans_acked"] <= 0:
+            errs.append("no scan ever acked")
+        if row["splits"] < 1:
+            errs.append("no live split executed under scan load")
+        if row["ack_shed_overlap"]:
+            errs.append(f"{row['ack_shed_overlap']} values both "
+                        "acked and shed across the cutover")
+        if row["p99_s"] > args.p99_budget:
+            errs.append(f"accepted-op p99 {row['p99_s']}s over "
+                        f"budget {args.p99_budget}s")
+        if not recovered:
+            errs.append("no recovery within budget")
+        row["ok"] = not errs
+        if errs:
+            row["error"] = "; ".join(errs)
+        return row
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if cluster is not None:
+            cluster.stop()
+        if not row["ok"]:
+            dump = os.path.splitext(args.out)[0] + (
+                "_scan_reshard_fail.json"
+            )
+            with open(dump, "w") as f:
+                json.dump(
+                    fail_bundle_doc(row, wplan, None, None, ops),
+                    f, indent=1,
+                )
+            print(f"FAIL bundle -> {dump}")
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--protocol", default="MultiPaxos")
@@ -1250,6 +1733,10 @@ def main():
     ap.add_argument("--reshard-ab", action="store_true",
                     help="run ONLY the live-resharding on/off A/B "
                          "(appends/replaces the reshard_ab row)")
+    ap.add_argument("--scan-cells", action="store_true",
+                    help="run ONLY the range-read cells (ycsb_e + "
+                         "trace replay + scan_reshard; appends/"
+                         "replaces those rows)")
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--tick", type=float, default=0.005,
                     help="server tick interval (with api_max_batch="
@@ -1266,7 +1753,7 @@ def main():
                     default=os.path.join(REPO, "WORKLOADS.json"))
     args = ap.parse_args()
 
-    if args.proxy_ab or args.reshard_ab:
+    if args.proxy_ab or args.reshard_ab or args.scan_cells:
         runs = []
     elif args.matrix:
         runs = list(WL_MATRIX)
@@ -1322,6 +1809,28 @@ def main():
                     if r.get("kind") != "reshard_ab"
                 ]
         results.append(rab)
+    if args.matrix or args.scan_cells:
+        scan_rows = [
+            run_scan_cell("ycsb_e", args),
+            run_scan_cell("trace", args),
+            run_scan_reshard(args),
+        ]
+        for sr in scan_rows:
+            status = "PASS" if sr["ok"] else f"FAIL ({sr.get('error')})"
+            print(f"=== {sr['kind']}: {status} "
+                  f"(scans={sr.get('scans_acked')}, "
+                  f"tier_scans={sr.get('read_tier_scans')}, "
+                  f"splits={sr.get('splits')}, "
+                  f"shed={sr.get('shed')}, p99={sr.get('p99_s')}s)")
+        if args.scan_cells and os.path.exists(args.out):
+            # surgical update: keep every committed row, swap the
+            # range-read cells
+            with open(args.out) as f:
+                results = [
+                    r for r in json.load(f)
+                    if r.get("kind") not in SCAN_CELL_KINDS
+                ]
+        results.extend(scan_rows)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {args.out}")

@@ -29,8 +29,9 @@ def load_ycsb_trace(path: str) -> List[Tuple[str, str, Optional[str]]]:
     Parity: the reference bench replays YCSB trace files
     (``clients/bench.rs`` ycsb trace support; lines shaped
     ``READ usertable <key> ...`` / ``UPDATE usertable <key> [field=...]``
-    / ``INSERT ...``).  SCANs degrade to point reads (the KV surface has
-    no range scan, matching the reference's mapping)."""
+    / ``INSERT ...``).  SCANs replay as ordered range reads (the third
+    tuple slot carries the YCSB scan count as a string); for plan-level
+    replay with digest stamping use ``WorkloadPlan.from_trace``."""
     trace: List[Tuple[str, str, Optional[str]]] = []
     with open(path) as f:
         for line in f:
@@ -38,8 +39,12 @@ def load_ycsb_trace(path: str) -> List[Tuple[str, str, Optional[str]]]:
             if len(toks) < 3:
                 continue
             op = toks[0].upper()
-            if op in ("READ", "SCAN"):
+            if op == "READ":
                 trace.append(("get", toks[2], None))
+            elif op == "SCAN":
+                count = toks[3] if len(toks) > 3 and toks[3].isdigit() \
+                    else "1"
+                trace.append(("scan", toks[2], count))
             elif op in ("UPDATE", "INSERT"):
                 val: Optional[str] = None
                 if "[" in line:
@@ -117,11 +122,18 @@ class ClientBench:
             kind, key, size = self.opgen.next()
             if kind == "put":
                 return Command("put", key, self._sized_value(size))
+            if kind == "scan":
+                # ordered range read: start at the picked key, length
+                # capped by the plan's scan_max (YCSB-E start+count)
+                return Command("scan", key, limit=max(1, int(size)))
             return Command("get", key)
         if self.trace:
             op, key, val = self.trace[i % len(self.trace)]
             if op == "put":
                 return Command("put", key, val or self._value(now))
+            if op == "scan":
+                return Command("scan", key,
+                               limit=max(1, int(val or 1)))
             return Command("get", key)
         key = self.rng.choice(self.keys)
         if self.rng.random() < self.put_ratio:

@@ -147,6 +147,13 @@ class DriverClosedLoop:
     def put(self, key: str, value: str) -> DriverReply:
         return self._issue(Command("put", key, value))
 
+    def scan(self, start: str, end: Optional[str] = None,
+             limit: int = 0) -> DriverReply:
+        """Ordered range read over ``[start, end)``: the reply's
+        ``result.items`` is the sorted (key, value) cut."""
+        return self._issue(Command("scan", start, end=end,
+                                   limit=int(limit)))
+
     def conf_change(self, conf_delta: dict, retries: int = 20
                     ) -> DriverReply:
         """Drive a ConfChange to completion through redirects/timeouts
@@ -340,7 +347,8 @@ class DriverOpenLoopPaced:
         return now < self.hold_until
 
     def issue(self, kind: str, key: str,
-              value: Optional[str] = None) -> Optional[int]:
+              value: Optional[str] = None,
+              end: Optional[str] = None) -> Optional[int]:
         """Send one op; returns its rid, or None when the connection
         died at send (the op never left — nothing to record; the driver
         rotates so the next arrival has a live socket)."""
@@ -349,8 +357,19 @@ class DriverOpenLoopPaced:
             return None
         rid = self.next_req
         self.next_req += 1
-        cmd = (Command("put", key, value) if kind == "put"
-               else Command("get", key))
+        if kind == "put":
+            cmd = Command("put", key, value)
+        elif kind == "scan":
+            # open-loop scans carry the length in ``value`` (workload
+            # OpStream emits ("scan", start_key, length)): a limit cap
+            # with an optional end bound — the YCSB-E shape.  Recorder
+            # callers pass the plan keyspace's upper bound as ``end`` so
+            # the observed cut never strays into harness keys whose
+            # writes the checked history does not carry
+            cmd = Command("scan", key, end=end,
+                          limit=max(1, int(value or 1)))
+        else:
+            cmd = Command("get", key)
         try:
             self.ep.send_req(rid, cmd)
         except Exception:
@@ -359,7 +378,8 @@ class DriverOpenLoopPaced:
         now = time.monotonic()
         self.inflight[rid] = {
             "kind": kind, "key": key, "value": value,
-            "t0": now, "deadline": now + self.timeout,
+            "limit": cmd.limit, "end": cmd.end, "t0": now,
+            "deadline": now + self.timeout,
         }
         self.counts["issued"] += 1
         return rid

@@ -375,6 +375,16 @@ class GenericEndpoint:
         assert self.api is not None, "connect() first"
         self.api.send_req(ApiRequest("req", req_id=req_id, cmd=cmd))
 
+    def send_scan(self, req_id: int, start: str, end: Optional[str],
+                  limit: int = 0) -> None:
+        """Issue an ordered range read over ``[start, end)`` (``end``
+        None = unbounded, ``limit`` 0 = no cap).  Rides the "req" kind —
+        servers and proxies also accept a bare "scan" ApiRequest kind,
+        but the Command form keeps one wire shape for every data op."""
+        self.send_req(req_id, Command(
+            "scan", start, end=end, limit=int(limit),
+        ))
+
     def send_conf(self, req_id: int, conf_delta: dict) -> None:
         """Issue a ConfChange (parity: ApiRequest::Conf,
         external.rs:106-121)."""

@@ -169,6 +169,10 @@ class MuxWorker:
                 return Command("put", key, "".join(
                     c.rng.choices(string.ascii_lowercase, k=max(1, size))
                 ))
+            if kind == "scan":
+                # ordered range read starting at the picked key; the
+                # stream's size slot carries the YCSB-E scan length
+                return Command("scan", key, limit=max(1, int(size)))
             return Command("get", key)
         key = f"mk{c.rng.randrange(self.num_keys)}"
         if c.rng.random() < self.put_ratio:

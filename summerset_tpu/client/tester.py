@@ -17,7 +17,9 @@ import time
 from typing import List, Optional, Tuple
 
 from ..host.messages import CtrlRequest
-from ..utils.linearize import record_get, record_put, record_shed_put
+from ..utils.linearize import (
+    record_get, record_put, record_scan, record_shed_put,
+)
 from ..utils.logging import pf_info, pf_logger
 from .drivers import DriverClosedLoop, DriverOpenLoopPaced
 from .endpoint import GenericEndpoint
@@ -160,6 +162,17 @@ def recorded_open_loop(
     except Exception:
         return  # cluster unreachable at spawn: nothing observed
     drv = DriverOpenLoopPaced(ep, timeout=timeout, seed=seed * 31 + ci)
+    # scans are bounded just past the plan's own keyspace: the harness
+    # writes bookkeeping keys (warm/calibration/recovery) whose puts the
+    # recorded history does not carry, and an unbounded scan straying
+    # into them would observe values the checker must call phantom
+    plan = getattr(stream, "plan", None)
+    if plan is not None and getattr(plan, "trace", None):
+        scan_hi = max(k for _, k, _ in plan.trace) + "\x00"
+    elif getattr(stream, "keys", None):
+        scan_hi = max(stream.keys) + "\x00"
+    else:
+        scan_hi = None
 
     def record(info: dict, rep) -> None:
         t1 = time.monotonic()
@@ -168,6 +181,18 @@ def recorded_open_loop(
                 ops.append(record_put(
                     ci, info["key"], info["value"], info["t0"],
                     info["t0"] + rep.latency, True,
+                ))
+            elif info["kind"] == "scan":
+                # acked range read: the observed (key, value) cut joins
+                # the history as a multi-key read; a limit-capped result
+                # proves absence only up to its last returned key.
+                # Shed/timed-out scans observe nothing — not recorded.
+                items = (rep.result.items or ()) if rep.result else ()
+                limit = int(info.get("limit") or 0)
+                ops.append(record_scan(
+                    ci, info["key"], info.get("end"), items, info["t0"],
+                    info["t0"] + rep.latency,
+                    truncated=bool(limit and len(items) >= limit),
                 ))
             else:
                 val = rep.result.value if rep.result else None
@@ -209,7 +234,10 @@ def recorded_open_loop(
                 if kind == "put":
                     body = f"c{ci}-{drv.next_req}"
                     val = body + "x" * max(0, size - len(body))
-                drv.issue(kind, key, val)
+                elif kind == "scan":
+                    val = size  # scan length rides value -> limit cap
+                drv.issue(kind, key, val,
+                          end=scan_hi if kind == "scan" else None)
             t_next = now + rng.expovariate(rate)
         budget = (
             min(max(t_next - now, 0.0005), 0.02) if rate > 0 else 0.02

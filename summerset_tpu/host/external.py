@@ -60,8 +60,9 @@ class ExternalApi:
     overload — a subscription is one request per learner lifetime).
     """
 
-    #: request kinds subject to the bounded-queue shed rule
-    BOUNDED_KINDS = ("req", "batch", "probe")
+    #: request kinds subject to the bounded-queue shed rule ("scan" is
+    #: the ordered range read — data plane, so it pays the bound too)
+    BOUNDED_KINDS = ("req", "batch", "probe", "scan")
 
     def __init__(
         self,

@@ -60,6 +60,7 @@ are recorded unacked — the same contract as a fused-server crash.
 
 from __future__ import annotations
 
+import bisect
 import collections
 import os
 import socket
@@ -267,6 +268,10 @@ class LearnerReadTier:
     def __init__(self, proxy: "IngressProxy"):
         self.proxy = proxy
         self.kv: Dict[str, Any] = {}
+        # ordered index over the learned keys (bisect-maintained,
+        # learner thread only): the sorted view scans slice — one
+        # insort per NEW key amortizes far below re-sorting per scan
+        self._keys: List[str] = []
         self.seq = 0
         self.ready = False
         self.upstream: Optional[int] = None
@@ -359,15 +364,33 @@ class LearnerReadTier:
             pend = self.proxy._pop_pend(rep.req_id)
             if pend is None:
                 return
-            value = self.kv.get(pend["cmd"].key)
+            cmd = pend["cmd"]
+            if cmd.kind == "scan":
+                # ordered range read off the learned state: the probe
+                # verdict covered the WHOLE span (sealed-cutover overlap
+                # + all-groups lease freshness), so the sorted-index
+                # slice at learned_seq >= probe_seq is a linearizable
+                # cut that never touched the proposer
+                res = CommandResult(
+                    "scan", items=self.scan_learned(
+                        cmd.key, cmd.end, cmd.limit,
+                    ),
+                )
+                self.proxy.metrics.counter_add("read_tier_scans")
+                self.proxy.flight.record(
+                    "scan_serve", client=pend["client"],
+                    req_id=pend["req_id"], seq=self.seq,
+                )
+            else:
+                res = CommandResult("get", value=self.kv.get(cmd.key))
+                self.proxy.flight.record(
+                    "read_serve", client=pend["client"],
+                    req_id=pend["req_id"], seq=self.seq,
+                )
             self.proxy.metrics.counter_add("read_tier_served")
-            self.proxy.flight.record(
-                "read_serve", client=pend["client"],
-                req_id=pend["req_id"], seq=self.seq,
-            )
             self.proxy._reply_client(pend, ApiReply(
                 "reply", req_id=pend["req_id"],
-                result=CommandResult("get", value=value), local=True,
+                result=res, local=True,
             ))
         else:
             # no lease / not quiescent / shed: the owner-forward path
@@ -378,6 +401,19 @@ class LearnerReadTier:
                 time.monotonic() + self.refusal_backoff_s
             )
             self.proxy._requeue.append(rep.req_id)
+
+    def scan_learned(self, start: str, end: Optional[str],
+                     limit: int) -> tuple:
+        """Slice the ordered learned index over ``[start, end)`` —
+        learner thread only (the index and kv mutate on this thread
+        between receives, never under a scan)."""
+        lo = bisect.bisect_left(self._keys, start)
+        hi = (len(self._keys) if end is None
+              else bisect.bisect_left(self._keys, end))
+        keys = self._keys[lo:hi]
+        if limit and limit > 0:
+            keys = keys[:limit]
+        return tuple((k, self.kv[k]) for k in keys)
 
     def _run(self) -> None:
         stop = self.proxy._stop
@@ -416,6 +452,7 @@ class LearnerReadTier:
                         # published for probes: a probe can never race a
                         # half-installed learner state
                         self.kv = dict(rep.notes or {})
+                        self._keys = sorted(self.kv)
                         self.seq = int(rep.seq)
                         self._sock = sock
                         self.ready = True
@@ -426,6 +463,8 @@ class LearnerReadTier:
                         )
                     elif rep.kind == "note":
                         for s, k, v in rep.notes or ():
+                            if k not in self.kv:
+                                bisect.insort(self._keys, k)
                             self.kv[k] = v
                         self.seq = max(self.seq, int(rep.seq))
                     else:  # probe verdicts (incl. shed/error fallbacks)
@@ -534,7 +573,8 @@ class IngressProxy:
         # api contributes its namespace family below
         for name in ("proxy_requests_total", "proxy_replies_total",
                      "proxy_routed", "proxy_dedupe_hits",
-                     "proxy_upstream_shed", "read_tier_served"):
+                     "proxy_upstream_shed", "read_tier_served",
+                     "read_tier_scans"):
             self.metrics.counter_add(name, 0)
         for name in ("proxy_backlog", "read_tier_backlog"):
             self.metrics.gauge_set(name, 0)
@@ -618,16 +658,22 @@ class IngressProxy:
             responders=responders,
         )
         # live resharding: installed ranges arrive on the SAME refresh
-        # round (manager re-announce path).  Every replica process holds
-        # every group, so the forward target for an installed range is
-        # the leader sid — installing it as an explicit range keeps the
-        # table's version tracking cutovers (and generalizes unchanged
-        # once per-group leaders diverge into distinct processes).
-        if info.leader is not None:
-            self.routing.set_ranges([
-                (e["start"], e.get("end"), int(info.leader))
-                for e in (getattr(info, "ranges", None) or ())
-            ])
+        # round (manager re-announce path).  Each installed range routes
+        # to its per-group OWNER sid — the destination-group leader that
+        # announced the install (the manager stamps it) — so steering
+        # tracks where the range actually adopted instead of pinning
+        # every range to the cluster-wide announced leader; entries
+        # without an owner stamp (pre-stamp manager state) fall back to
+        # the leader sid as before.
+        triples = []
+        for e in (getattr(info, "ranges", None) or ()):
+            own = e.get("owner")
+            sid = int(own) if own is not None else info.leader
+            if sid is None:
+                continue
+            triples.append((e["start"], e.get("end"), int(sid)))
+        if triples or info.leader is not None:
+            self.routing.set_ranges(triples)
 
     def _refresh_loop(self) -> None:
         while not self._stop.wait(self.refresh_s):
@@ -719,6 +765,10 @@ class IngressProxy:
         if req.kind == "conf":
             self._backlog.append(self._mint(client, req, "conf"))
             return
+        if req.kind == "scan" and req.cmd is not None:
+            # "scan" as an ApiRequest kind normalizes to a Command
+            # riding "req" — one pend shape for the whole forward path
+            req = ApiRequest("req", req_id=req.req_id, cmd=req.cmd)
         if req.kind != "req" or req.cmd is None:
             self.external.send_reply(ApiReply(
                 "error", req_id=req.req_id, success=False,
@@ -728,7 +778,7 @@ class IngressProxy:
         self._range_heat.note(req.cmd.key)
         prid = self._mint(client, req, "req")
         if (
-            req.cmd.kind == "get"
+            req.cmd.kind in ("get", "scan")
             and self.read_tier is not None
             and self.read_tier.try_probe(prid, req.cmd)
         ):

@@ -347,6 +347,11 @@ class ServerReplica:
         self.metrics.gauge_set("range_heat", 0.0)
         self.metrics.observe("reshard_cutover_us", 0)
         self.metrics.counter_add("reshard_seal_expired", 0)
+        # ordered range reads (scan plane): pre-registered at zero so
+        # "no scans yet" reads as 0, not a missing series
+        self.metrics.counter_add("scan_served", 0)
+        self.metrics.counter_add("scan_shed", 0)
+        self.metrics.counter_add("scan_keys", 0)
         # autopilot series (host/autopilot.py): zero until a driver in
         # act mode announces / actuates here
         self.metrics.counter_add("autopilot_actions", 0)
@@ -466,6 +471,11 @@ class ServerReplica:
         self._subs: Dict[int, bool] = {}
         self._sub_seq = 0
         self._sub_notes: List[Tuple[int, str, Any]] = []
+        # ordered range reads (scan plane): commit-bar barrier scans in
+        # flight on the fused fallback path — sbid -> {client, req_id,
+        # cmd, need (groups whose marker hasn't applied), tick (for GC)}
+        self._scan_pend: Dict[int, dict] = {}
+        self._scan_next = 1
         # client ConfChange plane (external.rs:106-121): one in flight
         self._conf_kind = (
             "ql" if "ql_out" in self.state
@@ -723,6 +733,19 @@ class ServerReplica:
             if key >= ch["start"] and (end is None or key < end):
                 return ch
         return None
+
+    def _range_seal_overlaps(self, start: str, end: Optional[str]) -> bool:
+        """Does any sealed (mid-cutover) range intersect the half-open
+        span ``[start, end)``?  A scan touching a sealed range cannot be
+        proven consistent against the adopting group, so it is shed
+        BEFORE any proposal — the same never-acked-then-shed guarantee
+        point gets have."""
+        for ch in self._range_sealed.values():
+            ce = ch.get("end")
+            if (end is None or ch["start"] < end) and (
+                    ce is None or start < ce):
+                return True
+        return False
 
     # ----------------------------------------------------- host state views
     def _np_state(self, k: str) -> np.ndarray:
@@ -1351,6 +1374,22 @@ class ServerReplica:
             ex["leader_read_ok"][g, self.me]
         )
 
+    def _scan_read_ok(self, start: str, end: Optional[str]) -> bool:
+        """May a linearizable range read over ``[start, end)`` be served
+        from applied state RIGHT NOW?  Range keys hash-scatter across
+        ALL groups, so the per-group read predicate must hold everywhere
+        — leader-lease freshness where this replica leads, lease-local
+        rights elsewhere — and no voted-unexecuted write anywhere may
+        target the span (the range form of the highest-slot freshness
+        check a leased get plays per key)."""
+        for g in range(self.G):
+            if self._is_leader[g]:
+                if not self._leader_read_ok(g):
+                    return False
+            elif not self._can_local_read(g):
+                return False
+        return not self._tail_writes_range({"start": start, "end": end})
+
     def _handle_conf_req(self, client: int, req: ApiRequest) -> None:
         """Queue a client ConfChange (never silently dropped — reply with
         failure if this kernel has no conf plane; parity:
@@ -1407,6 +1446,17 @@ class ServerReplica:
                 )
             else:
                 ok = self._can_local_read(g)
+        elif req.cmd is not None and req.cmd.kind == "scan":
+            # range form of the verdict: the span must dodge every
+            # sealed cutover AND be read-ready across ALL groups — the
+            # learner's scan over its learned state at seq >= this
+            # probe's seq then inherits the same lease-safety argument
+            # the per-key path has (notes and probe replies FIFO on one
+            # writer, verdict sampled where the fused path samples it)
+            ok = (
+                not self._range_seal_overlaps(req.cmd.key, req.cmd.end)
+                and self._scan_read_ok(req.cmd.key, req.cmd.end)
+            )
         self._reply(client, ApiReply(
             "probe", req_id=req.req_id, success=bool(ok),
             seq=self._sub_seq,
@@ -1446,6 +1496,8 @@ class ServerReplica:
         vbase = np.zeros((self.G,), np.int32)
         piggy: Dict[Tuple[int, int], Any] = {}
         batch = self.external.get_req_batch(timeout=0)
+        if self._scan_pend:
+            self._scan_gc()
         if not batch and not self._range_adopt_ready:
             if self._epaxos and any(self._ep_defer.values()):
                 # deferred buckets must drain even on idle intake ticks
@@ -1472,6 +1524,14 @@ class ServerReplica:
                 for prid, cmd in (req.batch or ()):
                     if cmd is None:
                         continue
+                    if cmd.kind == "scan":
+                        # proxy-forwarded range read (read-tier probe
+                        # refused): same serve/shed/barrier decision as
+                        # a direct scan, replied per prid
+                        self._intake_scan(client, ApiRequest(
+                            "req", req_id=int(prid), cmd=cmd,
+                        ), by_group)
+                        continue
                     if self._range_sealed_for(cmd.key) is not None:
                         # mid-cutover seal: refuse BEFORE proposal, so a
                         # shed op can never have been acked (the same
@@ -1493,6 +1553,12 @@ class ServerReplica:
                 self._handle_subscribe(client, req)
             elif req.kind == "probe":
                 self._handle_probe(client, req)
+            elif req.kind == "scan" or (
+                    req.kind == "req" and req.cmd is not None
+                    and req.cmd.kind == "scan"):
+                # "scan" is accepted both as an ApiRequest kind (the
+                # documented surface) and as a Command riding "req"
+                self._intake_scan(client, req, by_group)
             elif req.kind != "req" or req.cmd is None:
                 self._reply(client, ApiReply(
                     "error", req_id=req.req_id, success=False,
@@ -1586,6 +1652,106 @@ class ServerReplica:
                 nb = float(len(pickle.dumps(reqs)))
                 self._batch_bytes = 0.9 * self._batch_bytes + 0.1 * nb
         return n_prop, vbase, piggy
+
+    # ------------------------------------------------ ordered range reads
+    def _intake_scan(self, client: int, req: ApiRequest,
+                     by_group: Dict[int, list]) -> None:
+        """Fused-path scan serving (the learner tier's fallback).  In
+        order: (1) a span crossing a sealed cutover is shed before any
+        proposal (never acked-then-shed); (2) when every group is
+        read-ready for the span, serve straight from applied state —
+        the replica thread applies serially, so the KV between applies
+        IS a consistent cut; (3) otherwise, leading every group, fall
+        back to a commit-bar barrier: propose one no-effect scan marker
+        into EVERY group's log and read the final cut when the last
+        marker applies — any write acked before that instant was acked
+        by THIS server (it leads all groups, acks ride execution) and
+        so sits ahead of some marker in its group's log, hence applied;
+        (4) split leadership redirects, as a get would."""
+        cmd = req.cmd
+        if cmd is None:
+            self._reply(client, ApiReply(
+                "error", req_id=req.req_id, success=False,
+            ))
+            return
+        if self._range_seal_overlaps(cmd.key, cmd.end):
+            self._reply(client, ApiReply(
+                "shed", req_id=req.req_id, success=False,
+                retry_after_ms=50,
+            ))
+            self.metrics.counter_add("api_shed", 1)
+            self.metrics.counter_add("scan_shed", 1)
+            return
+        if self._epaxos:
+            # leaderless rows have no single commit bar to barrier on
+            # and no lease plane — scans are a lease/learner-tier
+            # feature (documented punt; callers get a clean error)
+            self._reply(client, ApiReply(
+                "error", req_id=req.req_id, success=False,
+            ))
+            return
+        if self._scan_read_ok(cmd.key, cmd.end):
+            res = apply_command(self.statemach._kv, cmd)
+            self._reply(client, ApiReply(
+                "reply", req_id=req.req_id, result=res, local=True,
+            ))
+            self._scan_served(res)
+            return
+        if bool(self._is_leader.all()):
+            sbid = self._scan_next
+            self._scan_next += 1
+            self._scan_pend[sbid] = {
+                "client": client, "req_id": req.req_id, "cmd": cmd,
+                "need": set(range(self.G)), "tick": self.tick,
+            }
+            marker = ApiRequest("req", req_id=sbid, cmd=cmd)
+            for g in range(self.G):
+                by_group.setdefault(g, []).append((None, marker))
+            return
+        hint = int(self._leader_hint[self.route_group(cmd.key)])
+        self._reply(client, ApiReply(
+            "redirect", req_id=req.req_id, redirect=hint, success=False,
+        ))
+
+    def _scan_served(self, res: CommandResult) -> None:
+        keys = len(res.items or ())
+        self.metrics.counter_add("scan_served", 1)
+        self.metrics.counter_add("scan_keys", keys)
+        self.flight.record("scan_serve", keys=keys, tick=self.tick)
+
+    def _scan_barrier_hit(self, g: int, sbid: int) -> None:
+        """One group's scan barrier marker reached the apply bar on its
+        proposer.  When the LAST group lands, the applied KV is a
+        linearizable cut for the span (see ``_intake_scan``): read it at
+        the bar and release the reply behind the durability fence."""
+        p = self._scan_pend.get(sbid)
+        if p is None:
+            return  # expired (GC replied shed) or a stray duplicate
+        p["need"].discard(g)
+        if p["need"]:
+            return
+        del self._scan_pend[sbid]
+        res = apply_command(self.statemach._kv, p["cmd"])
+        self._reply_queue.append((p["client"], ApiReply(
+            "reply", req_id=p["req_id"], result=res,
+        )))
+        self._scan_served(res)
+
+    def _scan_gc(self, ttl_ticks: int = 500) -> None:
+        """Expire barrier scans whose markers never committed (e.g.
+        leadership moved mid-barrier and the non-leader path dropped the
+        internal proposal): reply shed — a scan is a read, so refusing
+        it late is always safe, and a marker that still reaches the bar
+        afterwards just misses the pend and no-ops."""
+        dead = [sbid for sbid, p in self._scan_pend.items()
+                if self.tick - p["tick"] > ttl_ticks]
+        for sbid in dead:
+            p = self._scan_pend.pop(sbid)
+            self._reply(p["client"], ApiReply(
+                "shed", req_id=p["req_id"], success=False,
+                retry_after_ms=50,
+            ))
+            self.metrics.counter_add("scan_shed", 1)
 
     # ---------------------------------------------- codeword payload plane
     def _craft_fallback_groups(self) -> Optional[np.ndarray]:
@@ -2987,6 +3153,13 @@ class ServerReplica:
                         # destination-group slot on every replica; only
                         # the proposer announces to the manager
                         self._apply_adopt(req.cmd.value, announce=mine)
+                        continue
+                    if req.cmd.kind == "scan" and client is None:
+                        # commit-bar scan barrier marker: no KV effect
+                        # anywhere; the proposer reads the final cut
+                        # when its LAST group's marker lands
+                        if mine:
+                            self._scan_barrier_hit(g, req.req_id)
                         continue
                     if req.cmd.kind == "put":
                         ent = self.rangetab.lookup(req.cmd.key)

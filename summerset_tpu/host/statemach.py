@@ -17,20 +17,29 @@ from typing import Any, Dict, Optional, Tuple
 
 @dataclasses.dataclass(frozen=True)
 class Command:
-    """Get(key) or Put(key, value) (parity: ``Command``)."""
+    """Get(key) / Put(key, value) / Scan(key, end, limit) (parity:
+    ``Command``; scan is this repo's ordered range-read extension —
+    half-open ``[key, end)``, ``end=None`` unbounded, ``limit=0``
+    unlimited).  The scan fields default so decoders that only fill the
+    get/put triple (utils/wirecodec fast paths) resolve them through the
+    class attributes."""
 
-    kind: str  # "get" | "put"
+    kind: str  # "get" | "put" | "scan"
     key: str
     value: Optional[str] = None
+    end: Optional[str] = None   # scan: exclusive upper bound
+    limit: int = 0              # scan: max keys returned (0 = no cap)
 
 
 @dataclasses.dataclass(frozen=True)
 class CommandResult:
-    """Get -> value, Put -> old_value (parity: ``CommandResult``)."""
+    """Get -> value, Put -> old_value, Scan -> items (sorted
+    ``(key, value)`` pairs) (parity: ``CommandResult``)."""
 
     kind: str
     value: Optional[str] = None
     old_value: Optional[str] = None
+    items: Optional[tuple] = None  # scan: ((key, value), ...) sorted
 
 
 def apply_command(kv: Dict[str, str], cmd: Command) -> CommandResult:
@@ -41,7 +50,27 @@ def apply_command(kv: Dict[str, str], cmd: Command) -> CommandResult:
         old = kv.get(cmd.key)
         kv[cmd.key] = cmd.value if cmd.value is not None else ""
         return CommandResult("put", old_value=old)
+    if cmd.kind == "scan":
+        return CommandResult("scan", items=scan_items(
+            kv, cmd.key, cmd.end, cmd.limit,
+        ))
     raise ValueError(f"unknown command kind {cmd.kind}")
+
+
+def scan_items(kv: Dict[str, str], start: str, end: Optional[str],
+               limit: int) -> tuple:
+    """Ordered range read over a KV dict: sorted ``(key, value)`` pairs
+    with ``start <= key`` (``< end`` when bounded), truncated to
+    ``limit`` when positive.  One seam shared by the fused applier and
+    the learner read tier so both serving paths return byte-identical
+    shapes."""
+    keys = sorted(
+        k for k in kv
+        if k >= start and (end is None or k < end)
+    )
+    if limit and limit > 0:
+        keys = keys[:limit]
+    return tuple((k, kv[k]) for k in keys)
 
 
 class StateMachine:

@@ -339,6 +339,13 @@ DECLARED = (
     # ranges whose destination stayed leaderless past seal_ttl_ticks
     # and rolled back to serving from the source
     "reshard_seal_expired",
+    # ordered range reads (scan plane): scans served from applied state
+    # (fused lease path or commit-bar barrier), scans refused (sealed
+    # span / expired barrier), and total keys returned — pre-registered
+    # so scan-free runs read as zero series
+    "scan_served",
+    "scan_shed",
+    "scan_keys",
     # autopilot policy tier (host/autopilot.py): actuations applied on
     # THIS server labeled by actuator, the announced driver mode
     # (0 = none/observe, 1 = act), and per-actuator remaining-cooldown
@@ -370,7 +377,9 @@ PROXY_DECLARED = (
     "proxy_dedupe_hits",     # (client, req_id) duplicates absorbed
     "proxy_upstream_shed",   # shard-tier sheds relayed through
     "proxy_backlog",         # internal forward backlog depth gauge
-    "read_tier_served",      # gets served from the learner's state
+    "read_tier_served",      # reads served from the learner's state
+    #                          (gets AND scans — total serve volume)
+    "read_tier_scans",       # the scan share of read_tier_served
     "read_tier_backlog",     # in-flight freshness probes gauge
     "range_heat",            # per-key-range heat at the proxy seam
 )

@@ -88,6 +88,9 @@ EVENT_TYPES = (
     "read_serve",    # read tier served a get from learner state
                      # (client, req_id, seq) — the probe-gated
                      # lease-local read that never touched the proposer
+    "scan_serve",    # ordered range read served (keys, tick on the
+                     # fused path; client, req_id, seq on the learner
+                     # tier) — one event per scan wherever it was cut
     "fault_ctl",     # nemesis fault_ctl received (planes touched)
     "demote",        # health plane indicted THIS replica's leadership and
                      # the server voluntarily stepped down (signals, the

@@ -27,7 +27,15 @@ classes are the traffic that tier must absorb):
 - ``hot_burst``    — strong zipfian skew plus an open-loop arrival
                      schedule whose burst phase offers ~2x the ingress
                      capacity: the overload-survival scenario (bounded
-                     queues must shed visibly, not buffer unboundedly).
+                     queues must shed visibly, not buffer unboundedly);
+- ``ycsb_e``       — YCSB-E: ~95% ordered range scans (zipfian scan
+                     start, uniform scan length) + ~5% puts — the
+                     learner-read-tier scan showcase;
+- ``trace``        — replay of an external YCSB trace file normalized
+                     by :meth:`WorkloadPlan.from_trace`: the ops ARE
+                     the trace rows (strided per client), and the
+                     timeline embeds the trace digest so external
+                     traces become byte-reproducible soak cells.
 
 Split of responsibilities: everything *logical* (op kinds, keys, value
 sizes, phase structure, rate multipliers) lives here and is a pure
@@ -53,6 +61,8 @@ WORKLOAD_CLASSES = (
     "value_mix",
     "multi_tenant",
     "hot_burst",
+    "ycsb_e",
+    "trace",
 )
 
 
@@ -92,6 +102,13 @@ class WorkloadPlan:
     shared_keys: int        # multi-tenant: size of the shared hot range
     shared_frac: float      # fraction of multi-tenant ops on shared keys
     phases: Tuple[WorkloadPhase, ...]
+    # ordered-range-read knobs (ycsb_e; default-zero keeps every older
+    # plan's constructor call AND timeline byte-identical)
+    scan_frac: float = 0.0  # fraction of non-put ops issued as scans
+    scan_max: int = 0       # uniform scan length in [1, scan_max]
+    # normalized external trace rows (wl_class "trace"): the op sequence
+    # IS this tuple, strided per client by OpStream
+    trace: Tuple[Tuple[str, str, int], ...] = ()
 
     # ------------------------------------------------------------ build
     @staticmethod
@@ -118,6 +135,7 @@ class WorkloadPlan:
         put_ratio, zipf_s = 0.5, 0.0
         value_lo, value_hi, log_values = 48, 64, False
         tenant_span, shared_keys, shared_frac = 0, 0, 0.0
+        scan_frac, scan_max = 0.0, 0
         steady = round(0.25 + rng.uniform(0.0, 0.15), 3)
         phases: List[WorkloadPhase] = [
             WorkloadPhase(0, horizon, steady)
@@ -151,10 +169,95 @@ class WorkloadPlan:
                 WorkloadPhase(t1, blen, burst_x),
                 WorkloadPhase(t1 + blen, horizon - t1 - blen, steady),
             ]
+        elif wl_class == "ycsb_e":
+            # YCSB workload E: short ordered scans dominate, a thin
+            # insert/update stream keeps the scanned state moving.
+            # Scan start is zipfian (the shared hot-key shuffle below),
+            # scan LENGTH is uniform in [1, scan_max] — the canonical
+            # E shape (zipfian request keys, uniform scan lengths)
+            put_ratio = round(rng.uniform(0.03, 0.07), 3)
+            zipf_s = round(rng.uniform(0.9, 1.2), 3)
+            value_lo, value_hi = 32, 96
+            scan_frac = round(rng.uniform(0.9, 1.0), 3)
+            scan_max = rng.randint(6, 12)
+        elif wl_class == "trace":
+            raise ValueError(
+                "wl_class 'trace' plans come from WorkloadPlan."
+                "from_trace, not generate()"
+            )
         return WorkloadPlan(
             seed, wl_class, clients, num_keys, put_ratio, zipf_s,
             value_lo, value_hi, log_values, tenant_span, shared_keys,
-            shared_frac, tuple(phases),
+            shared_frac, tuple(phases), scan_frac, scan_max,
+        )
+
+    @staticmethod
+    def from_trace(
+        rows,
+        seed: int = 0,
+        clients: int = 3,
+        horizon: int = 120,
+        rate_x: float = 0.3,
+    ) -> "WorkloadPlan":
+        """Normalize real YCSB trace rows into the seeded plan contract.
+
+        ``rows`` is a path to a trace file or an iterable of its lines;
+        accepted row shapes are the YCSB runner's operation lines —
+        ``READ <table> <key> ...``, ``INSERT|UPDATE <table> <key>
+        <fields...>``, ``SCAN <table> <startkey> <len> ...`` — plus the
+        bare 2/3-column form (``op key [len]``).  Unknown lines are
+        skipped, not errors (real trace dumps interleave progress
+        noise).  Parsing is PURE (H103: no wallclock, no unseeded
+        randomness, no pacing — the drivers own time): the same bytes
+        always yield the same plan, and :meth:`timeline` embeds the
+        normalized rows' sha256, so same trace ⇒ same digest is a
+        checkable contract, not a convention.  ``seed`` only salts the
+        client-stride offset, keeping distinct cells distinguishable
+        without touching the rows."""
+        if isinstance(rows, (str, bytes)):
+            with open(rows, "r", encoding="utf-8",
+                      errors="replace") as f:
+                lines = f.read().splitlines()
+        else:
+            lines = [str(r) for r in rows]
+        ops: List[Tuple[str, str, int]] = []
+        for line in lines:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            verb = parts[0].upper()
+            # full YCSB rows carry the table name second; the bare form
+            # puts the key there — disambiguate by verb arity
+            rest = parts[1:]
+            if verb in ("READ", "INSERT", "UPDATE", "SCAN") \
+                    and len(rest) >= 2 and not rest[1].isdigit():
+                rest = rest[1:]  # drop the table column
+            key = rest[0]
+            if verb == "READ":
+                ops.append(("get", key, 0))
+            elif verb in ("INSERT", "UPDATE"):
+                # field payload sizes vary per dump; normalize to the
+                # joined field text length (bounded below so empty
+                # fields still write a real value)
+                size = max(8, len(" ".join(rest[1:])))
+                ops.append(("put", key, min(size, 2048)))
+            elif verb == "SCAN":
+                n = 1
+                if len(rest) >= 2:
+                    try:
+                        n = max(1, int(rest[1]))
+                    except ValueError:
+                        n = 1
+                ops.append(("scan", key, min(n, 64)))
+        if not ops:
+            raise ValueError("trace contains no recognizable ops")
+        keys = {k for _, k, _ in ops}
+        puts = sum(1 for o in ops if o[0] == "put")
+        return WorkloadPlan(
+            seed, "trace", clients, len(keys),
+            round(puts / len(ops), 3), 0.0, 8, 2048, False, 0, 0, 0.0,
+            (WorkloadPhase(0, horizon, rate_x),),
+            0.0, 0, tuple(ops),
         )
 
     # ------------------------------------------------------- determinism
@@ -171,7 +274,26 @@ class WorkloadPlan:
             f" tenant_span={self.tenant_span}"
             f" shared={self.shared_keys}@{self.shared_frac:g}\n"
         )
+        # scan/trace lines render ONLY when the knobs are live, so every
+        # pre-scan plan's timeline (and committed digest) is unchanged
+        if self.scan_frac > 0.0 or self.scan_max > 0:
+            head += (
+                f"scan={self.scan_frac:g}@max{self.scan_max}\n"
+            )
+        if self.trace:
+            head += (
+                f"trace_sha={self.trace_sha()} rows={len(self.trace)}\n"
+            )
         return head + "".join(p.render() + "\n" for p in self.phases)
+
+    def trace_sha(self) -> str:
+        """sha256 over the normalized trace rows (canonical rendering):
+        the byte-reproducibility anchor — same trace file, same
+        normalization, same sha, same plan digest."""
+        h = hashlib.sha256()
+        for kind, key, n in self.trace:
+            h.update(f"{kind} {key} {n}\n".encode())
+        return h.hexdigest()[:16]
 
     def digest(self) -> str:
         return hashlib.sha256(self.timeline().encode()).hexdigest()[:16]
@@ -213,6 +335,19 @@ class OpStream:
         self._rng = random.Random(
             plan.seed * 7919 + self.ci * 104729 + 13
         )
+        if plan.trace:
+            # trace replay: this client's rows are the seed-rotated
+            # per-client stride of the normalized trace — every row is
+            # issued by exactly one client, and the union across
+            # clients is the trace itself
+            off = (self.ci + plan.seed) % max(plan.clients, 1)
+            self._trows = plan.trace[off::max(plan.clients, 1)] \
+                or plan.trace
+            self._tpos = 0
+            self.keys = []
+            self._shared, self._private = [], []
+            self._cdf = []
+            return
         if plan.tenant_span > 0:
             self._shared = [
                 f"t_shared{i}" for i in range(plan.shared_keys)
@@ -266,9 +401,18 @@ class OpStream:
         return self._rng.randint(p.value_lo, p.value_hi)
 
     def next(self) -> Tuple[str, str, int]:
-        """One op: ``("put"|"get", key, value_size)`` (size is 0 for
-        gets)."""
+        """One op: ``(kind, key, arg)`` — ``("put", key, value_size)``,
+        ``("get", key, 0)``, or ``("scan", start_key, scan_len)``."""
+        if self.plan.trace:
+            op = self._trows[self._tpos % len(self._trows)]
+            self._tpos += 1
+            return op
         key = self._pick_key()
         if self._rng.random() < self.plan.put_ratio:
             return "put", key, self._pick_size()
+        if self.plan.scan_max > 0 \
+                and self._rng.random() < self.plan.scan_frac:
+            return "scan", key, self._rng.randint(
+                1, self.plan.scan_max
+            )
         return "get", key, 0

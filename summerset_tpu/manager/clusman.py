@@ -254,6 +254,12 @@ class ClusterManager:
             # server — including ones that missed the original fan-out —
             # converges on the same installed set
             entry = dict(p.get("entry") or {})
+            # stamp the announcing server as the range's owner sid: the
+            # announcer IS the adopting proposer (destination-group
+            # leader), which is where proxies should steer ops for this
+            # range — per-group owner routing instead of pinning every
+            # installed range to the cluster-wide announced leader
+            entry.setdefault("owner", int(conn.sid))
             rc_id = int(entry.get("rc_id", 0))
             fresh = rc_id not in self._ranges_installed
             self._ranges_pending.pop(rc_id, None)

@@ -191,8 +191,12 @@ def _ensure_structs() -> None:
     _register(2, ApiReply,
               ("kind", "req_id", "result", "redirect", "success",
                "rq_retry", "local", "retry_after_ms", "seq", "notes"))
-    _register(3, Command, ("kind", "key", "value"))
-    _register(4, CommandResult, ("kind", "value", "old_value"))
+    # scan fields appended (wire-safe per the registry contract above):
+    # old decoders drop them, old encoders omit them and the dataclass
+    # defaults fill in — only scan traffic, which old peers never
+    # originate, actually populates them
+    _register(3, Command, ("kind", "key", "value", "end", "limit"))
+    _register(4, CommandResult, ("kind", "value", "old_value", "items"))
     _register(5, ShardPayload, ("data_len", "shards"))
     _CLS_REQ, _CLS_REPLY = ApiRequest, ApiReply
     _CLS_CMD, _CLS_RESULT = Command, CommandResult

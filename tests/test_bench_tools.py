@@ -47,7 +47,8 @@ class TestYcsbTrace:
             ("get", "user1", None),
             ("put", "user2", "field0=hello"),
             ("put", "user3", "field0=init"),
-            ("get", "user4", None),
+            # SCAN rows replay as range reads: slot 3 = YCSB count
+            ("scan", "user4", "17"),
         ]
 
 

@@ -363,6 +363,25 @@ class AutopilotDriver:
         return time.time()         # scrape pacing: outside the scope
 """
 
+# trace normalization rides the same seeded scope: from_trace is a
+# WorkloadPlan classmethod, so a clock read or unseeded draw while
+# parsing/striding trace rows breaks byte-reproducible replay exactly
+# like a dirty generate() would
+_FROM_TRACE_SEEDED_SCOPE = """
+import time, random
+
+class WorkloadPlan:
+    @classmethod
+    def from_trace(cls, path):
+        stamp = time.time()        # wallclock in the normalizer: the
+        jitter = random.random()   # same trace would yield different
+        return stamp, jitter       # plans run-to-run
+
+
+def tail_trace_file(path):
+    return time.monotonic()        # module-level I/O helper: exempt
+"""
+
 # H105 both-direction fixtures: every egress shape the rule must
 # decide — dominated by a straight-line fence wait (clean), carrying
 # the fence down as a kwarg (clean), fence only inside a conditional
@@ -499,6 +518,30 @@ def test_hostlint_workload_scope_is_module_keyed(tmp_path):
     class names."""
     findings, _ = _scan(
         tmp_path, _WORKLOAD_SEEDED_SCOPE, "host/other.py"
+    )
+    assert findings == []
+
+
+def test_hostlint_from_trace_joins_seeded_scope(tmp_path):
+    """Trace normalization is inside the workload seeded scope: a
+    wallclock read or unseeded RNG draw in ``WorkloadPlan.from_trace``
+    fires H103 (same trace file must always yield the same plan),
+    while a module-level file helper stays exempt."""
+    findings, _ = _scan(
+        tmp_path, _FROM_TRACE_SEEDED_SCOPE, "host/workload.py"
+    )
+    assert sorted(f.scope for f in findings) == [
+        "WorkloadPlan.from_trace:random.random",
+        "WorkloadPlan.from_trace:time.time",
+    ]
+    assert all(f.code == "H103" for f in findings)
+
+
+def test_hostlint_from_trace_scope_is_module_keyed(tmp_path):
+    """The same from_trace source outside host/workload.py is
+    untouched — the seeded scope is keyed on the module path."""
+    findings, _ = _scan(
+        tmp_path, _FROM_TRACE_SEEDED_SCOPE, "host/other.py"
     )
     assert findings == []
 

@@ -111,6 +111,18 @@ class TestRoutingTable:
         rt.set_ranges([("a", "c", 1), ("c", "d", 2)])  # unchanged
         assert rt.version == v  # refresh loop must not churn versions
 
+    def test_scan_start_key_routes_to_range_owner(self):
+        # scans route by their start key: the proxy forwards the whole
+        # span to the owner of [start, ...) and the learner/fused serve
+        # path bounds the slice — boundary keys land on the RIGHT range
+        rt = RoutingTable()
+        rt.update({0: ("h", 1), 1: ("h", 2), 2: ("h", 3)}, leader=0)
+        rt.set_ranges([("a", "c", 1), ("c", "d", 2)])
+        assert rt.owner_for("a") == 1    # scan starting at range head
+        assert rt.owner_for("b\x00") == 1
+        assert rt.owner_for("c") == 2    # exact split point: new owner
+        assert rt.owner_for("d") == 0    # past installed ranges: leader
+
 
 class _FakeProxy:
     """Duck-typed IngressProxy core for LearnerReadTier unit tests."""
@@ -204,6 +216,57 @@ class TestLearnerUnit:
             lt._probes[9] = time.monotonic() - 1
         lt.expire_probes(time.monotonic())
         assert 9 not in p._pends and not lt._probes
+        p._stop.set()
+
+    def _seed_scan_state(self, lt):
+        lt.kv = {"w1": "v1", "w2": "v2", "w3": "v3", "x9": "z"}
+        lt._keys = sorted(lt.kv)
+        lt.seq = 5
+
+    def test_probe_reply_serves_scan_from_ordered_index(self):
+        p, lt = self._mk()
+        self._seed_scan_state(lt)
+        p._pends[9] = {"client": 3, "req_id": 40,
+                       "cmd": Command("scan", "w1", end="w4", limit=2)}
+        with p._lock:
+            lt._probes[9] = time.monotonic() + 2
+        lt._on_probe_reply(ApiReply("probe", req_id=9, success=True,
+                                    seq=5))
+        assert p.replies and p.replies[0][0] == 3
+        rep = p.replies[0][1]
+        assert rep.kind == "reply" and rep.local
+        assert rep.result.kind == "scan"
+        # limit clips the ordered slice; "x9" excluded by end="w4"
+        assert rep.result.items == (("w1", "v1"), ("w2", "v2"))
+        assert p.metrics.counter_value("read_tier_served") == 1
+        assert p.metrics.counter_value("read_tier_scans") == 1
+        assert any(e["type"] == "scan_serve"
+                   for e in p.flight.dump()["events"])
+        p._stop.set()
+
+    def test_scan_stale_seq_falls_back_to_owner_path(self):
+        p, lt = self._mk()
+        self._seed_scan_state(lt)
+        lt.seq = 3  # learned stream behind the probe verdict
+        p._pends[9] = {"client": 3, "req_id": 40,
+                       "cmd": Command("scan", "w1", end="w4", limit=8)}
+        with p._lock:
+            lt._probes[9] = time.monotonic() + 2
+        lt._on_probe_reply(ApiReply("probe", req_id=9, success=True,
+                                    seq=8))
+        assert list(p._requeue) == [9]
+        assert not p.replies
+        assert p.metrics.counter_value("read_tier_scans") == 0
+        p._stop.set()
+
+    def test_scan_learned_open_end_and_no_limit(self):
+        p, lt = self._mk()
+        self._seed_scan_state(lt)
+        # open end runs to the index tail; limit=0 means unbounded
+        assert lt.scan_learned("w2", None, 0) == (
+            ("w2", "v2"), ("w3", "v3"), ("x9", "z"))
+        assert lt.scan_learned("w2", "w3", 0) == (("w2", "v2"),)
+        assert lt.scan_learned("zz", None, 0) == ()
         p._stop.set()
 
 

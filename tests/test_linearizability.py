@@ -9,6 +9,7 @@ from summerset_tpu.utils.linearize import (
     check_history,
     record_get,
     record_put,
+    record_scan,
     record_shed_put,
 )
 
@@ -215,3 +216,132 @@ class TestCheckerCatches:
         ]
         ok, _ = check_history(ops)
         assert not ok
+
+
+class TestScanDecisionTable:
+    """Ordered range reads through the checker: every row of the scan
+    semantics the serving planes promise.  A scan is one atomic cut —
+    each returned (key, value) must be legal at a single point inside
+    the scan window, and each ABSENT in-span key must be legally absent
+    at that same point (unless the scan was limit-truncated)."""
+
+    def test_clean_scan_cut(self):
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_put(0, "b", "2", 1.5, 2.5, True),
+            record_scan(1, "a", None, [("a", "1"), ("b", "2")],
+                        3.0, 4.0),
+        ]
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+    def test_scan_observing_shed_put_caught(self):
+        """A scan item carrying a SHED put's value is a violation —
+        same negative-ack asymmetry as the point-read row."""
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_shed_put(1, "a", "s0", 2.0, 2.1),
+            record_scan(2, "a", None, [("a", "s0")], 3.0, 4.0),
+        ]
+        ok, _ = check_history(ops)
+        assert not ok
+
+    def test_scan_observing_unacked_put_allowed(self):
+        """The same shape with a timed-out (unacked) put passes: the
+        put's effect is allowed to have surfaced."""
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_put(1, "a", "u0", 2.0, None, False),
+            record_scan(2, "a", None, [("a", "u0")], 3.0, 4.0),
+        ]
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+    def test_scan_missing_committed_key_caught(self):
+        """An acked put wholly BEFORE the scan window, to a key inside
+        the scanned span, must appear in an untruncated result — its
+        absence is a lost write, not a legal cut."""
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_put(0, "b", "2", 1.5, 2.5, True),
+            record_scan(1, "a", None, [("a", "1")], 3.0, 4.0),
+        ]
+        ok, _ = check_history(ops)
+        assert not ok
+
+    def test_truncated_scan_absence_allowed(self):
+        """The identical absence under a LIMIT-capped scan proves
+        nothing past the last returned key: the cut stops at "a"."""
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_put(0, "b", "2", 1.5, 2.5, True),
+            record_scan(1, "a", None, [("a", "1")], 3.0, 4.0,
+                        truncated=True),
+        ]
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+    def test_absence_outside_span_proves_nothing(self):
+        """A bounded scan [a, b) says nothing about keys >= b: the
+        committed put to "c" may be absent without violation."""
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_put(0, "c", "3", 1.5, 2.5, True),
+            record_scan(1, "a", "b", [("a", "1")], 3.0, 4.0),
+        ]
+        ok, diag = check_history(ops)
+        assert ok, diag
+
+    def test_cross_key_single_point_violation_caught(self):
+        """The cut must be ONE point: put(a=2) completed before
+        put(b=2) even started, so a scan observing the NEW b=2 next to
+        the OLD a=1 has no single legal linearization point."""
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_put(0, "b", "1", 0.0, 1.0, True),
+            record_put(1, "a", "2", 2.0, 3.0, True),
+            record_put(1, "b", "2", 4.0, 5.0, True),
+            record_scan(2, "a", None, [("a", "1"), ("b", "2")],
+                        6.0, 7.0),
+        ]
+        ok, _ = check_history(ops)
+        assert not ok
+        # the consistent cut over the same history passes
+        ops_ok = ops[:-1] + [
+            record_scan(2, "a", None, [("a", "2"), ("b", "2")],
+                        6.0, 7.0),
+        ]
+        ok, diag = check_history(ops_ok)
+        assert ok, diag
+
+    def test_scan_concurrent_with_put_reads_either(self):
+        """A put overlapping the scan window may or may not be in the
+        cut — both results pass."""
+        base = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_put(0, "a", "2", 2.0, 6.0, True),
+        ]
+        old = base + [record_scan(1, "a", None, [("a", "1")],
+                                  3.0, 4.0)]
+        new = base + [record_scan(1, "a", None, [("a", "2")],
+                                  3.0, 4.0)]
+        ok, diag = check_history(old)
+        assert ok, diag
+        ok, diag = check_history(new)
+        assert ok, diag
+
+    def test_scan_of_never_written_value_caught(self):
+        ops = [
+            record_put(0, "a", "1", 0.0, 1.0, True),
+            record_scan(1, "a", None, [("a", "ghost")], 2.0, 3.0),
+        ]
+        ok, _ = check_history(ops)
+        assert not ok
+
+    def test_empty_scan_before_any_write_allowed(self):
+        ops = [
+            record_scan(0, "a", None, [], 0.0, 1.0),
+            record_put(0, "a", "1", 2.0, 3.0, True),
+        ]
+        ok, diag = check_history(ops)
+        assert ok, diag

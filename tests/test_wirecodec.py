@@ -173,6 +173,18 @@ HOT_MESSAGES = [
              notes=[(7, "k1", "v1"), (8, "k2", None)]),
     ApiReply("reply", req_id=5, redirect=2, success=False,
              rq_retry=True),
+    # ordered range reads: the scan Command fields (end/limit) and the
+    # result's sorted items ride the same hot struct lanes — registry
+    # ids 3/4 append them, so old decoders drop them and old encoders
+    # leave the dataclass defaults
+    ApiRequest("req", req_id=6,
+               cmd=Command("scan", "w00", end="w10", limit=8)),
+    ApiRequest("req", req_id=7, cmd=Command("scan", "a")),  # unbounded
+    ApiReply("reply", req_id=6, result=CommandResult(
+        "scan", items=(("w00", "v0"), ("w03", "v3"), ("w07", "v7")),
+    )),
+    ApiReply("reply", req_id=7,
+             result=CommandResult("scan", items=())),
 ]
 
 COLD_MESSAGES = [

@@ -29,11 +29,15 @@ def _free_port() -> int:
 
 
 # ------------------------------------------------------------- plans --
-@pytest.mark.parametrize("wl_class", WORKLOAD_CLASSES)
+@pytest.mark.parametrize(
+    "wl_class", [c for c in WORKLOAD_CLASSES if c != "trace"]
+)
 def test_plan_seed_determinism(wl_class):
     """Same seed -> byte-identical timeline AND identical op streams;
     different seeds differ (the FaultPlan repro contract, workload
-    side)."""
+    side).  The "trace" class is excluded here: its plans come from
+    from_trace (generate() refuses it) — determinism for it is proven
+    in TestTracePlans below."""
     a = WorkloadPlan.generate(7, wl_class)
     b = WorkloadPlan.generate(7, wl_class)
     assert a.timeline() == b.timeline()
@@ -111,6 +115,135 @@ def test_hot_burst_phases_shape():
 def test_unknown_class_refused():
     with pytest.raises(ValueError):
         WorkloadPlan.generate(1, "nope")
+
+
+# --------------------------------------------------- scans & traces --
+def test_ycsb_e_shape():
+    """YCSB-E character: scans dominate (~95%), scan lengths are
+    uniform in [1, scan_max], scan starts are zipfian (hot start key
+    well above uniform share), and the thin put stream is live."""
+    p = WorkloadPlan.generate(9, "ycsb_e")
+    assert 0.9 <= p.scan_frac <= 1.0 and 6 <= p.scan_max <= 12
+    st = p.opstream(0)
+    n = 4000
+    ops = [st.next() for _ in range(n)]
+    kinds = [o[0] for o in ops]
+    scans = [o for o in ops if o[0] == "scan"]
+    assert len(scans) / n > 0.8
+    assert 0 < kinds.count("put") / n < 0.15
+    lens = {o[2] for o in scans}
+    assert min(lens) >= 1 and max(lens) <= p.scan_max
+    # uniform lengths actually spread across the range
+    assert len(lens) >= p.scan_max - 1
+    from collections import Counter
+
+    starts = Counter(o[1] for o in scans)
+    assert starts.most_common(1)[0][1] / len(scans) \
+        > 3.0 / p.num_keys
+    # scan knob is in the committed timeline (digest covers it)
+    assert f"scan={p.scan_frac:g}@max{p.scan_max}" in p.timeline()
+
+
+def test_ycsb_e_scans_issue_through_paced_driver():
+    """The open-loop paced driver lowers a plan scan into a scan
+    Command with the stream's length as the limit (wire-shape unit:
+    no cluster)."""
+    from summerset_tpu.host.statemach import Command as Cmd
+
+    sent = []
+
+    class _Ep:
+        def send_req(self, req_id, cmd):
+            sent.append(cmd)
+
+    drv = DriverOpenLoopPaced(_Ep(), max_inflight=4)
+    drv.issue("scan", "w3", 7, end="w9\x00")
+    (cmd,) = sent
+    assert isinstance(cmd, Cmd)
+    assert (cmd.kind, cmd.key, cmd.end, cmd.limit) \
+        == ("scan", "w3", "w9\x00", 7)
+
+
+class TestTracePlans:
+    ROWS = [
+        "READ usertable user3 [ field0 ]",
+        "INSERT usertable user7 [ field0=abcdefgh ]",
+        "SCAN usertable user2 12 [ field0 ]",
+        "UPDATE usertable user3 [ field0=x ]",
+        "[OVERALL] operations so far: 4",   # runner noise: skipped
+        "SCAN user5 3",                     # bare form
+        "READ user9",
+    ]
+
+    def test_normalization_both_directions(self):
+        p = WorkloadPlan.from_trace(self.ROWS, seed=1)
+        assert p.wl_class == "trace"
+        assert p.trace == (
+            # put sizes = joined field-text length (brackets included),
+            # floored at 8, capped at 2048
+            ("get", "user3", 0),
+            ("put", "user7", len("[ field0=abcdefgh ]")),
+            ("scan", "user2", 12),
+            ("put", "user3", len("[ field0=x ]")),
+            ("scan", "user5", 3),
+            ("get", "user9", 0),
+        )
+        # num_keys = distinct keys, put_ratio = observed put share
+        assert p.num_keys == 5
+        assert p.put_ratio == round(2 / 6, 3)
+
+    def test_same_trace_same_digest(self):
+        a = WorkloadPlan.from_trace(self.ROWS, seed=1)
+        b = WorkloadPlan.from_trace(list(self.ROWS), seed=1)
+        assert a.trace_sha() == b.trace_sha()
+        assert a.digest() == b.digest()
+        assert f"trace_sha={a.trace_sha()} rows=6" in a.timeline()
+        # one changed row changes both digests
+        c = WorkloadPlan.from_trace(
+            self.ROWS[:-1] + ["READ user8"], seed=1
+        )
+        assert c.trace_sha() != a.trace_sha()
+        assert c.digest() != a.digest()
+
+    def test_streams_cover_all_rows_in_order(self):
+        """Client streams stride the normalized rows: the union over
+        one pass of every client is exactly the trace."""
+        p = WorkloadPlan.from_trace(self.ROWS, seed=0, clients=2)
+        got = []
+        for ci in range(p.clients):
+            st = p.opstream(ci)
+            got.append([st.next() for _ in range(3)])
+        merged = [op for i in range(3) for ci in range(2)
+                  for op in [got[ci][i]]]
+        assert sorted(merged) == sorted(p.trace)
+
+    def test_file_roundtrip(self, tmp_path):
+        f = tmp_path / "t.trace"
+        f.write_text("\n".join(self.ROWS) + "\n")
+        a = WorkloadPlan.from_trace(str(f), seed=1)
+        b = WorkloadPlan.from_trace(self.ROWS, seed=1)
+        assert a.digest() == b.digest()
+
+    def test_empty_trace_refused(self):
+        with pytest.raises(ValueError):
+            WorkloadPlan.from_trace(["junk line", "# comment"])
+
+    def test_generate_refuses_trace_class(self):
+        with pytest.raises(ValueError):
+            WorkloadPlan.generate(1, "trace")
+
+    def test_committed_fixture_is_stable(self):
+        """The committed CI trace fixture regenerates the exact digests
+        the WORKLOADS.json trace cell carries."""
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "data", "ycsb_e_sample.trace",
+        )
+        p = WorkloadPlan.from_trace(path, seed=1)
+        assert p.trace_sha() == "5ed30ebc826f2d35"
+        assert len(p.trace) == 408
 
 
 # ------------------------------------------------- ingress backpressure --
