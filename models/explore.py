@@ -22,6 +22,16 @@ elections (timeouts are shrunk to fire within the horizon) and the
 install-snapshot heal plane all engage at W=4, which is exactly the
 regime where the sweep found the rspaxos exec-lag step-up bug.
 
+The sweep doubles as the **soundness oracle for the range prover**
+(``analysis/ranges.py``): every state the exploration visits must
+satisfy every proven per-leaf interval invariant and pairwise fact for
+the exact kernel instance being stepped.  The prover's documented
+no-wrap abstraction (saturating interval arithmetic) and its jaxpr
+walk are thereby cross-validated against concretely reached states —
+a violated invariant fails the run and names the leaf, the claimed
+interval, the witness bounds and the fault schedule step that reached
+it.
+
 Scope note: durability is checked edge-locally against each path's own
 accumulator; converging paths dedup on state hash PLUS a digest of the
 accumulator's out-of-window portion.  Identical states imply identical
@@ -133,6 +143,12 @@ class ExploreResult:
     # quorum-tally transport the kernel was compiled with
     # (core/quorum.py): "pairwise" or "collective"
     tally: str = "pairwise"
+    # range-prover oracle (module docstring): how many proven leaf
+    # invariants / pairwise facts were asserted at every visited state
+    # (0 = oracle off); violations land in `violations` like the
+    # safety properties
+    range_leaves: int = 0
+    range_pairs: int = 0
 
     def as_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -141,7 +157,7 @@ class ExploreResult:
 def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
             depth: int = 6, round_ticks: int = 2,
             config_overrides: Dict[str, Any] | None = None,
-            tally: str = "pairwise",
+            tally: str = "pairwise", range_oracle: bool = True,
             progress: bool = False) -> ExploreResult:
     """Breadth-first exhaustion of all fault schedules of ``depth`` rounds."""
     # probe the config type at a wide window (tiny W would trip the
@@ -167,6 +183,48 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
         **overrides,
     )
     kernel = make_protocol(protocol, G, R, W, cfg)
+    # range-prover oracle: derive the proven invariants for THIS exact
+    # kernel instance (same geometry, same shrunken-timeout config the
+    # exploration steps), then assert them at every visited state.  The
+    # engine runs the telemetry-free compile of the same step, so leaves
+    # absent from the stepped state (``telem``) are skipped.
+    inv_items: List[Tuple[str, Tuple[int, int]]] = []
+    pair_items: Tuple[Tuple[str, str], ...] = ()
+    if range_oracle:
+        from summerset_tpu.analysis.ranges import analyze_kernel_ranges
+
+        ra = analyze_kernel_ranges(kernel)
+        inv_items = sorted(ra.invariants.items())
+        pair_items = ra.pairs
+
+    def check_ranges(np_state: Dict[str, np.ndarray],
+                     where: str) -> List[str]:
+        out = []
+        for leaf, (lo, hi) in inv_items:
+            a = np_state.get(leaf)
+            if a is None:
+                continue
+            mn, mx = int(a.min()), int(a.max())
+            if mn < lo or mx > hi:
+                out.append(
+                    f"range invariant violated: {leaf} proven in "
+                    f"[{lo}, {hi}] but witness state at {where} has "
+                    f"[{mn}, {mx}]"
+                )
+        for x, y in pair_items:
+            ax, ay = np_state.get(x), np_state.get(y)
+            if ax is None or ay is None:
+                continue
+            if not bool(np.all(ax <= ay)):
+                i = int(np.argmax(np.ravel(ax > ay)))
+                out.append(
+                    f"range pair violated: {x} <= {y} proven but "
+                    f"witness state at {where} has {x}="
+                    f"{int(np.ravel(ax)[i])} > {y}={int(np.ravel(ay)[i])} "
+                    f"(flat index {i})"
+                )
+        return out
+
     eng = Engine(kernel, netcfg=NetConfig(delay_ticks=1), seed=0)
     state0, ns0 = eng.init()
     # drop the metric-lane block (core/telemetry.py): presence is a
@@ -196,6 +254,7 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
     dedup = 0
     max_committed = 0
     violations: List[str] = []
+    violations.extend(check_ranges(np0, "init"))
 
     while nodes:
         state, ns, acc, d = nodes.popleft()
@@ -217,6 +276,10 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
             except AssertionError as e:
                 violations.append(str(e))
                 continue
+            rv = check_ranges(np2, f"{name}@d{d}")
+            if rv:
+                violations.extend(rv)
+                continue
             acc2 = dict(acc)
             acc2.update(cm)
             max_committed = max(max_committed, len(acc2))
@@ -235,6 +298,7 @@ def explore(protocol: str = "multipaxos", R: int = 3, W: int = 4,
         nodes_expanded=expanded, dedup_hits=dedup,
         max_committed_slots=max_committed, violations=violations,
         tally=getattr(cfg, "tally", "pairwise"),
+        range_leaves=len(inv_items), range_pairs=len(pair_items),
     )
 
 
@@ -274,6 +338,9 @@ def main() -> None:
     ap.add_argument("--depth", type=int, default=6,
                     help="depth for entries without an explicit :depth")
     ap.add_argument("--round-ticks", type=int, default=2)
+    ap.add_argument("--no-range-oracle", action="store_true",
+                    help="skip asserting the range prover's invariants "
+                         "at every visited state")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
     results = []
@@ -284,6 +351,7 @@ def main() -> None:
                     round_ticks=args.round_ticks,
                     config_overrides=CLI_PRESETS.get(name),
                     tally=mode or "pairwise",
+                    range_oracle=not args.no_range_oracle,
                     progress=True)
         print(json.dumps(r.as_json()))
         results.append(r.as_json())

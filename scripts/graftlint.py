@@ -1,14 +1,20 @@
 #!/usr/bin/env python3
 """graftlint: kernel-contract verifier + host concurrency lint (CI tier 2e).
 
-Runs the three static passes of ``summerset_tpu/analysis`` over the
+Runs the four static passes of ``summerset_tpu/analysis`` over the
 whole repo and writes the deterministic ``LINT.json`` baseline:
 
 1. contract  — every registered protocol kernel against the
                machine-readable ``KERNEL_CONTRACT`` rules (C1–C9);
-2. taint     — the flags-taint dataflow pass (T1, stale-suppression T9);
-3. host      — the AST concurrency lint over host/manager/utils
-               (H101–H104, inline ``# graftlint: disable=... -- reason``
+2. ranges    — the inductive value-range prover: per-leaf interval
+               invariants + pairwise facts per config variant
+               (serialized into the report, drift-gated), plus
+               ``RANGE_CLAIMS`` inductiveness (R2);
+3. taint     — the flags-taint dataflow pass (T1, stale-suppression
+               T9), with gate polarity decided by the range proofs
+               (proven-vs-optimistic counts ride in the report);
+4. host      — the AST concurrency lint over host/manager/utils
+               (H101–H106, inline ``# graftlint: disable=... -- reason``
                suppressions).
 
 Usage:
@@ -16,6 +22,7 @@ Usage:
     python scripts/graftlint.py --check        # CI: fail on findings OR
                                                # drift vs committed LINT.json
     python scripts/graftlint.py --only taint --kernel Raft -v
+    python scripts/graftlint.py --only ranges  # just the range proofs
 
 Exit status: 0 = clean (and, with --check, baseline matches); 1 = any
 finding, pass error, or baseline drift.
@@ -43,6 +50,7 @@ from summerset_tpu.analysis import (  # noqa: E402
     dumps_report,
     lint_host,
     verify_kernel,
+    verify_kernel_ranges,
     verify_kernel_taint,
 )
 
@@ -56,7 +64,7 @@ def main() -> int:
                     help="compare against the committed baseline instead "
                          "of rewriting it; fail on findings or drift")
     ap.add_argument("--only", action="append",
-                    choices=("contract", "taint", "host"),
+                    choices=("contract", "ranges", "taint", "host"),
                     help="run a subset of passes (console only; LINT.json "
                          "is neither written nor checked)")
     ap.add_argument("--kernel", action="append",
@@ -64,7 +72,7 @@ def main() -> int:
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
 
-    passes = set(args.only or ("contract", "taint", "host"))
+    passes = set(args.only or ("contract", "ranges", "taint", "host"))
     partial = bool(args.only) or bool(args.kernel)
     if args.check and partial:
         ap.error("--check needs the full run: it compares the whole "
@@ -80,11 +88,15 @@ def main() -> int:
 
     kernels = {}
     n_findings = 0
+    gates_proven = gates_optimistic = 0
     for lname in names:
         kres = {}
         if "contract" in passes:
             kres["contract"] = verify_kernel(protocols.make_protocol,
                                              lname)
+        if "ranges" in passes:
+            kres["ranges"] = verify_kernel_ranges(protocols.make_protocol,
+                                                  lname)
         if "taint" in passes:
             kres["taint"] = verify_kernel_taint(protocols.make_protocol,
                                                 lname)
@@ -97,7 +109,21 @@ def main() -> int:
             status = "pass" if pres.ok else "FAIL"
             supp = f" ({len(pres.suppressed)} suppressed)" \
                 if pres.suppressed else ""
-            print(f"{disp:>14s} {pname:<9s} {status}{supp}")
+            note = ""
+            if pname == "ranges" and "variants" in pres.extra:
+                nv = len(pres.extra["variants"])
+                nl = sum(len(v["invariants"])
+                         for v in pres.extra["variants"].values())
+                np_ = sum(len(v["pairs"])
+                          for v in pres.extra["variants"].values())
+                note = f" ({nv} variants, {nl} leaves, {np_} pairs)"
+            elif pname == "taint" and "gates_proven" in pres.extra:
+                gp = pres.extra["gates_proven"]
+                go = pres.extra["gates_optimistic"]
+                gates_proven += gp
+                gates_optimistic += go
+                note = f" ({gp} proven / {go} optimistic gates)"
+            print(f"{disp:>14s} {pname:<9s} {status}{supp}{note}")
             for f in pres.findings:
                 n_findings += 1
                 print(f"    {f.render()}")
@@ -108,6 +134,9 @@ def main() -> int:
                 for f, reason in pres.suppressed:
                     print(f"    suppressed {f.render()}\n"
                           f"        reason: {reason}")
+                for r in pres.extra.get("residuals", []):
+                    print(f"    optimistic gate: {r['prim']} "
+                          f"[{r['where']}] sources={r['sources']}")
 
     if "host" in passes:
         host, n_files = lint_host(PKG_ROOT)
@@ -123,6 +152,11 @@ def main() -> int:
                       f"        reason: {reason}")
     else:
         host, n_files = None, 0
+
+    if "taint" in passes:
+        print(f"{'':>14s} gate polarity: {gates_proven} proven, "
+              f"{gates_optimistic} optimistic (residuals listed in "
+              "LINT.json extra)")
 
     if partial:
         print(f"graftlint (partial): {n_findings} finding(s)")

@@ -630,6 +630,12 @@ def export_chrome(dumps: Dict[Any, dict], align: bool = True,
                     "tid": TID["device scan"], "ts": t,
                     "args": {"g": ev["g"], "vid": ev["vid"]},
                 })
+            elif k == "transport_handshake_fail":
+                evs.append({
+                    "ph": "i", "s": "t", "name": k, "pid": me,
+                    "tid": TID["transport"], "ts": t,
+                    "args": {"error": ev.get("error")},
+                })
             elif k in ("fault_ctl", "demote", "crash", "restart",
                        "range_seal", "range_adopt", "range_unseal",
                        "autopilot_act"):

@@ -1,6 +1,6 @@
 """graftlint: static verification of the kernel SPI contract + host lint.
 
-Three passes, one committed baseline (``LINT.json``), one CI tier
+Four passes, one committed baseline (``LINT.json``), one CI tier
 (``ci.sh`` tier 2e → ``scripts/graftlint.py --check``):
 
 - :mod:`.contract` — the kernel-contract verifier: every registered
@@ -9,13 +9,20 @@ Three passes, one committed baseline (``LINT.json``), one CI tier
   ``KERNEL_CONTRACT`` rules (state/outbox geometry and dtypes, durable
   declarations, jaxpr purity, scan-carry stability, telemetry write
   path).
+- :mod:`.ranges` — the value-range prover: an inductive interval
+  abstract interpretation over each kernel's state leaves (widening to
+  fixpoint, narrowing, coinductive tightening, octagon-lite pairwise
+  facts), serialized into LINT.json and cross-validated by the model
+  checker; author-declared ``RANGE_CLAIMS`` violations are ``R2``.
 - :mod:`.taint` — the flags-taint pass: a dataflow walk over the step
   jaxpr proving every inbox read that lands in state passed a
-  ``flags``-derived gate; intentional flows are declared per kernel in
-  ``TAINT_ALLOW``.
+  ``flags``-derived gate; the range pass's invariants decide
+  state-entangled gate polarity; intentional flows are declared per
+  kernel in ``TAINT_ALLOW``.
 - :mod:`.hostlint` — AST concurrency lint over ``host/``, ``manager/``,
   ``utils/``: lock-held blocking calls, non-daemon threads, wallclock /
-  unseeded RNG in seeded-determinism scopes, fsync outside StorageHub.
+  unseeded RNG in seeded-determinism scopes, fsync outside StorageHub,
+  exception-swallowing handlers in hub threads.
 
 The paper-side motivation (PAPERS.md): protocol-parallel optimization
 porting (arxiv 1905.10786) only works when the shared substrate contract
@@ -26,6 +33,11 @@ it.
 
 from .contract import verify_kernel  # noqa: F401
 from .hostlint import lint_host  # noqa: F401
+from .ranges import (  # noqa: F401
+    RangeAnalysis,
+    analyze_kernel_ranges,
+    verify_kernel_ranges,
+)
 from .report import (  # noqa: F401
     Finding,
     PassResult,

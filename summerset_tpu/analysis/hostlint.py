@@ -24,6 +24,18 @@ all four are mechanically checkable:
 - **H104 fsync outside StorageHub** — durability points belong to the
   logger thread (single-writer discipline + fault injection + fsync
   telemetry); a stray ``os.fsync`` bypasses all three.
+- **H106 exception swallowed in a hub thread** — a broad ``except
+  Exception:`` (or bare ``except:``) whose handler neither re-raises,
+  nor records a typed flight/telemetry event, nor even reads the bound
+  exception, inside the hub-thread modules (server / transport /
+  storage / external / ingress).  Hub worker loops MUST wrap their
+  bodies to survive poison input — but a handler that drops the
+  exception on the floor turns every future bug in that loop into a
+  silent stall: the thread keeps spinning, the operator sees nothing.
+  The contract is "survive AND record": re-raise, or emit through the
+  flight recorder / telemetry counters (``pf_*``/``note_*`` helpers,
+  ``.record``/``.bump``/``.inc``), or at minimum consume the exception
+  value into some sink the operator can read.
 - **H105 unfenced egress in the pipelined tick loop** — the pipelined
   loop's durability contract is that no vote/ack computed by step N
   leaves the process (peer tick frame OR client reply) before step N's
@@ -120,6 +132,18 @@ BLOCKING_NAMES = frozenset({
 # blocking only without a timeout= kwarg (queue.get, thread.join)
 TIMEOUT_GATED_NAMES = frozenset({"get", "join"})
 
+# H106: modules whose classes run hub worker threads (long-lived loops
+# draining queues/sockets).  Broad excepts there must re-raise or
+# record — a swallowed exception stalls the loop's users silently.
+HUB_MODULES = frozenset({
+    "host/server.py", "host/transport.py", "host/storage.py",
+    "host/external.py", "host/ingress.py",
+})
+# call spellings that count as "recording" the failure: the flight-
+# recorder/print helpers and the telemetry-counter surface
+H106_RECORD_CALLS = frozenset({"record", "bump", "inc", "exception"})
+H106_RECORD_PREFIXES = ("pf_", "note_", "log_")
+
 # H105: the durability-fence owner module and its egress seams.  Egress
 # calls here must be fence-dominated (a `_fence_wait()` earlier in the
 # same function's straight-line body) or carry a `fence=` kwarg naming
@@ -155,6 +179,38 @@ def _dotted(node) -> str:
 
 def _has_kw(node: ast.Call, name: str) -> bool:
     return any(kw.arg == name for kw in node.keywords)
+
+
+def _broad_except(t) -> bool:
+    """Is this handler type a catch-(almost)-everything?  Bare
+    ``except:``, ``Exception``/``BaseException``, or a tuple containing
+    one of them."""
+    if t is None:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(_broad_except(e) for e in t.elts)
+    return _dotted(t) in ("Exception", "BaseException")
+
+
+def _handler_records(h: ast.ExceptHandler) -> bool:
+    """Does a broad handler discharge its H106 obligation?  True when
+    the body re-raises, calls a recording helper
+    (:data:`H106_RECORD_CALLS` / :data:`H106_RECORD_PREFIXES`), or at
+    least *reads* the bound exception value (feeding it into any sink
+    an operator can inspect)."""
+    for stmt in h.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                cn = _call_name(n)
+                if cn and (cn in H106_RECORD_CALLS
+                           or cn.startswith(H106_RECORD_PREFIXES)):
+                    return True
+            if (h.name and isinstance(n, ast.Name) and n.id == h.name
+                    and isinstance(n.ctx, ast.Load)):
+                return True
+    return False
 
 
 # 'lock' as its own word-start in the identifier (optionally r/w
@@ -198,6 +254,9 @@ class _Scanner(ast.NodeVisitor):
         # STRAIGHT-LINE (top-level-of-body) `..._fence_wait()` call
         # statements — a fence inside an `if` doesn't dominate
         self._fence_lines: List[List[int]] = []
+        # H106: per-qualname ordinal of broad excepts, so the scope
+        # symbol (`qual:except#k`) is stable across line-number churn
+        self._h106_ord: Dict[str, int] = {}
 
     # ---------------------------------------------------------- helpers
     def _qual(self) -> str:
@@ -249,6 +308,24 @@ class _Scanner(ast.NodeVisitor):
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self.rel in HUB_MODULES and _broad_except(node.type):
+            qual = self._qual()
+            k = self._h106_ord.get(qual, 0)
+            self._h106_ord[qual] = k + 1
+            if not _handler_records(node):
+                spelled = "bare except:" if node.type is None else \
+                    f"except {_dotted(node.type) or '...'}"
+                self._emit(
+                    "H106", f"{qual}:except#{k}",
+                    f"{spelled} in a hub-thread module neither "
+                    "re-raises, records a flight/telemetry event, nor "
+                    "reads the exception — a future bug in this loop "
+                    "becomes a silent stall (survive AND record)",
+                    node.lineno,
+                )
+        self.generic_visit(node)
 
     def visit_With(self, node: ast.With) -> None:
         is_lock = any(

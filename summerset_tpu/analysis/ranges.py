@@ -1,0 +1,1221 @@
+"""Inductive value-range invariants over kernel state (graftproof).
+
+The taint pass (``analysis/taint.py``) carried one documented soundness
+weakening: a flags-derived predicate whose dead-world class mixes with
+*state* — ``masked_bal > s["prep_pbal"]`` — got optimistic clearing,
+because deciding its dead-world polarity needs runtime invariants
+(ballot nonnegativity) that no pass derived.  This module derives them:
+an inductive interval abstract interpretation over each kernel's state
+leaves, run over the SAME traced step-jaxpr forest T1 walks.
+
+Three phases:
+
+1. **Init** — the leaf intervals of ``init_state`` are evaluated
+   concretely, over a small seed set (init is seed-dependent: heartbeat
+   counters start at seeded offsets), and unioned.
+2. **Step as interval transfer** — the step jaxpr runs as an interval
+   transfer function (``select_n`` joins/refines its reachable cases,
+   ``cond``/``scan``/``while``/``pjit`` recurse, inbox and ControlInputs
+   leaves are ⊤ within their dtype bounds) to a post-fixpoint with
+   threshold widening, then bounded narrowing; the final candidate is
+   re-checked inductive (``init ⊑ S`` and ``transfer(S) ⊑ S``) before
+   anything is claimed.  Alongside the intervals one relational pass
+   derives octagon-lite pairwise facts ``x <= y`` (elementwise, over the
+   ``[G, R]`` signed bar/ballot leaves) by greatest-fixpoint candidate
+   removal: start from every pair true at init, keep only pairs the
+   step provably re-establishes, iterate until stable.
+3. **Feed T1** — ``taint.py`` seeds each state input leaf's dead-world
+   interval with the proven invariant (sound: the invariant holds at
+   every reachable state, and the dead world is a reachable state with
+   flags zeroed — state leaves keep their values), so a state-entangled
+   comparison gets a *sound* dead-world class whenever the intervals
+   decide its sign.
+
+Abstraction contract (documented, oracle-checked): integer arithmetic
+is modeled as **saturating at dtype bounds** — an abstract ``add``
+computes the exact integer interval then clamps into the output dtype's
+range, rather than modeling two's-complement wraparound.  Kernel
+arithmetic never intentionally wraps (ballots, bars and window indices
+all live far from the bounds), and the exhaustive model checker
+(``models/explore.py``) cross-validates every proven invariant against
+every concretely reached state, so a wrap that broke an interval claim
+would fail the oracle with the leaf, interval and witness state.
+
+``RANGE_CLAIMS`` on a kernel class declares author-asserted per-leaf
+bounds; each is checked inductive under the same transfer (hold at
+init, preserved by one abstract step) and a violation is an ``R2``
+finding.  Derived invariants are serialized into LINT.json per
+kernel × config variant (deterministic, drift-gated by ``--check``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+try:  # jax >= 0.4.33 public spelling
+    from jax.extend.core import Literal as _Literal
+except ImportError:  # pragma: no cover - older jax
+    from jax.core import Literal as _Literal
+
+from .contract import (
+    build_kernel, collective_variant_differs, host_variant_differs,
+    rule_finding, trace_step,
+)
+from .report import PassResult
+
+Interval = Tuple[int, int]
+
+# symbolic finite bounds for the float avals that only broken-kernel
+# fixtures produce (C8 bans floats from real step jaxprs)
+_FINF = 2 ** 63
+
+# widening rounds are bounded by the threshold ladder length; this cap
+# only backstops analysis bugs, and hitting it is a hard error
+_OUTER_CAP = 64
+_INNER_CAP = 64
+_NARROW_ROUNDS = 3
+
+#: seeds the concrete init-interval evaluation unions over (init_state
+#: is seed-dependent: heartbeat counters start at seeded offsets)
+INIT_SEEDS = (0, 1, 2)
+
+# rel-set size cap: var-ref sets grow along pass-through chains; past
+# this they are dropped (sound — facts are only ever *removed*)
+_REL_CAP = 64
+_NO_REL = (frozenset(), frozenset())
+
+
+# ------------------------------------------------------ interval algebra --
+def aval_bounds(aval) -> Interval:
+    """The dtype's representable range: the ⊤ element for this aval."""
+    dt = getattr(aval, "dtype", None)
+    if dt is None:
+        return (-_FINF, _FINF)
+    dt = np.dtype(dt)
+    if dt.kind == "b":
+        return (0, 1)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return (int(info.min), int(info.max))
+    return (-_FINF, _FINF)
+
+
+def iv_clamp(iv: Interval, bounds: Interval) -> Interval:
+    """Saturate an exact-integer interval into a dtype's range."""
+    lo, hi = iv
+    blo, bhi = bounds
+    return (min(max(lo, blo), bhi), max(min(hi, bhi), blo))
+
+
+def iv_join(a: Interval, b: Interval) -> Interval:
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+def iv_meet(a: Interval, b: Interval) -> Optional[Interval]:
+    lo, hi = max(a[0], b[0]), min(a[1], b[1])
+    return None if lo > hi else (lo, hi)
+
+
+def iv_leq(a: Interval, b: Interval) -> bool:
+    """a ⊑ b in the interval lattice (containment)."""
+    return b[0] <= a[0] and a[1] <= b[1]
+
+
+def _corners(a: Interval, b: Interval, fn) -> Interval:
+    vs = [fn(x, y) for x in a for y in b]
+    return (min(vs), max(vs))
+
+
+def _tdiv(a: int, b: int) -> int:
+    """C-style truncating division (what lax.div does on ints)."""
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def _is_bool(aval) -> bool:
+    dt = getattr(aval, "dtype", None)
+    return dt is not None and np.dtype(dt).kind == "b"
+
+
+def literal_interval(v) -> Optional[Interval]:
+    """Interval of a jaxpr literal — min/max over the (possibly
+    non-uniform) array, which is strictly more informative than the
+    taint pass's uniform-only dead class."""
+    try:
+        val = np.asarray(v.val)
+    except Exception:
+        return None
+    if val.size == 0 or val.dtype.kind not in "biu":
+        if val.dtype.kind == "f" and val.size and np.all(np.isfinite(val)):
+            return (val.min().item(), val.max().item())
+        return None
+    return (int(val.min()), int(val.max()))
+
+
+def _axes_count(shape, axes) -> int:
+    n = 1
+    for a in axes:
+        n *= int(shape[a])
+    return max(n, 1)
+
+
+def _cmp_interval(name: str, a: Interval, b: Interval) -> Interval:
+    """Decide a comparison from operand intervals, else (0, 1)."""
+    if name == "eq":
+        if iv_meet(a, b) is None:
+            return (0, 0)
+        if a == b and a[0] == a[1]:
+            return (1, 1)
+    elif name == "ne":
+        if iv_meet(a, b) is None:
+            return (1, 1)
+        if a == b and a[0] == a[1]:
+            return (0, 0)
+    elif name == "lt":
+        if a[1] < b[0]:
+            return (1, 1)
+        if a[0] >= b[1]:
+            return (0, 0)
+    elif name == "le":
+        if a[1] <= b[0]:
+            return (1, 1)
+        if a[0] > b[1]:
+            return (0, 0)
+    elif name == "gt":
+        if a[0] > b[1]:
+            return (1, 1)
+        if a[1] <= b[0]:
+            return (0, 0)
+    elif name == "ge":
+        if a[0] >= b[1]:
+            return (1, 1)
+        if a[1] < b[0]:
+            return (0, 0)
+    return (0, 1)
+
+
+_CMP_NAMES = frozenset({"eq", "ne", "lt", "le", "gt", "ge"})
+_SHAPE_PRIMS = frozenset({
+    "reshape", "broadcast_in_dim", "squeeze", "expand_dims", "transpose",
+    "rev", "copy", "stop_gradient", "slice", "reduce_precision",
+    "all_gather",
+})
+# element-selection / element-keeping prims: output elements are drawn
+# from the first operand (indices contribute no values)
+_PICK_PRIMS = frozenset({"gather", "dynamic_slice"})
+_REDUCE_SAME = frozenset({
+    "reduce_max", "reduce_min", "reduce_or", "reduce_and", "pmax", "pmin",
+})
+
+
+def prim_intervals(name: str, eqn, ivs: List[Interval]
+                   ) -> Optional[List[Interval]]:
+    """Interval transfer for one non-control-flow primitive.
+
+    Pure: the result depends only on the primitive, its params/avals and
+    the operand intervals.  Returns ``None`` for primitives this table
+    does not model (the caller falls back to dtype-⊤, which is sound).
+    Every result is saturated into the output dtype's bounds (module
+    docstring: the documented no-wrap abstraction).
+    """
+    outs = eqn.outvars
+    n_out = len(outs)
+    bounds = aval_bounds(outs[0].aval) if outs else (-_FINF, _FINF)
+
+    def one(iv: Interval) -> List[Interval]:
+        return [iv_clamp(iv, aval_bounds(o.aval)) for o in outs]
+
+    if name in _SHAPE_PRIMS or name == "convert_element_type":
+        return one(ivs[0]) if ivs else None
+    if name in _PICK_PRIMS:
+        return one(ivs[0]) if ivs else None
+    if name in _REDUCE_SAME:
+        # max/min/or/and over elements of one operand stay inside its
+        # interval (bool or == max, bool and == min)
+        return one(ivs[0]) if ivs else None
+    if name in _CMP_NAMES and len(ivs) == 2:
+        return one(_cmp_interval(name, ivs[0], ivs[1]))
+    if name == "select_n" and len(ivs) >= 2:
+        pred, cases = ivs[0], ivs[1:]
+        live = [c for i, c in enumerate(cases)
+                if pred[0] <= i <= pred[1]] or cases
+        acc = live[0]
+        for c in live[1:]:
+            acc = iv_join(acc, c)
+        return one(acc)
+    if name == "add" and len(ivs) == 2:
+        return one((ivs[0][0] + ivs[1][0], ivs[0][1] + ivs[1][1]))
+    if name == "sub" and len(ivs) == 2:
+        return one((ivs[0][0] - ivs[1][1], ivs[0][1] - ivs[1][0]))
+    if name == "mul" and len(ivs) == 2:
+        return one(_corners(ivs[0], ivs[1], lambda a, b: a * b))
+    if name == "neg" and ivs:
+        return one((-ivs[0][1], -ivs[0][0]))
+    if name == "abs" and ivs:
+        lo, hi = ivs[0]
+        if lo >= 0:
+            return one((lo, hi))
+        if hi <= 0:
+            return one((-hi, -lo))
+        return one((0, max(-lo, hi)))
+    if name == "sign" and ivs:
+        lo, hi = ivs[0]
+        return one((
+            1 if lo > 0 else (0 if lo >= 0 else -1),
+            -1 if hi < 0 else (0 if hi <= 0 else 1),
+        ))
+    if name == "max" and len(ivs) == 2:
+        return one((max(ivs[0][0], ivs[1][0]), max(ivs[0][1], ivs[1][1])))
+    if name == "min" and len(ivs) == 2:
+        return one((min(ivs[0][0], ivs[1][0]), min(ivs[0][1], ivs[1][1])))
+    if name == "clamp" and len(ivs) == 3:
+        # lax.clamp(min, x, max) == min(max(x, min), max)
+        lo_iv, x, hi_iv = ivs
+        t = (max(x[0], lo_iv[0]), max(x[1], lo_iv[1]))
+        return one((min(t[0], hi_iv[0]), min(t[1], hi_iv[1])))
+    if name == "not" and ivs:
+        if _is_bool(outs[0].aval):
+            return one((1 - ivs[0][1], 1 - ivs[0][0]))
+        return one((-ivs[0][1] - 1, -ivs[0][0] - 1))
+    if name in ("and", "or", "xor") and len(ivs) == 2:
+        (alo, ahi), (blo, bhi) = ivs
+        if _is_bool(outs[0].aval):
+            # bool: and == min, or == max, xor via {0,1} corners
+            if name == "and":
+                return one((min(alo, blo), min(ahi, bhi)))
+            if name == "or":
+                return one((max(alo, blo), max(ahi, bhi)))
+            return one(_corners(ivs[0], ivs[1], lambda a, b: a ^ b))
+        if alo >= 0 and blo >= 0:
+            if name == "and":
+                return one((0, min(ahi, bhi)))
+            mask = (1 << max(ahi.bit_length(), bhi.bit_length())) - 1
+            if name == "or":
+                # x|y >= both operands for nonnegatives
+                return one((max(alo, blo), mask))
+            return one((0, mask))
+        return one(bounds)
+    if name == "shift_left" and len(ivs) == 2:
+        (slo, shi) = ivs[1]
+        slo, shi = max(slo, 0), min(max(shi, 0), 64)
+        return one(_corners(ivs[0], (slo, shi), lambda x, s: x << s))
+    if name == "shift_right_logical" and len(ivs) == 2:
+        (xlo, xhi), (slo, shi) = ivs
+        if xlo < 0:
+            return one(bounds)  # bit reinterpretation of the sign bit
+        slo, shi = max(slo, 0), min(max(shi, 0), 64)
+        return one((xlo >> shi, xhi >> slo))
+    if name == "shift_right_arithmetic" and len(ivs) == 2:
+        (slo, shi) = ivs[1]
+        slo, shi = max(slo, 0), min(max(shi, 0), 64)
+        # x >> s is monotone in x for fixed s and monotone in s for
+        # fixed x (toward 0 / -1), so corner evaluation is exact
+        return one(_corners(ivs[0], (slo, shi), lambda x, s: x >> s))
+    if name == "div" and len(ivs) == 2:
+        (blo, bhi) = ivs[1]
+        if blo <= 0 <= bhi:
+            return one(bounds)  # possible division by zero
+        return one(_corners(ivs[0], ivs[1], _tdiv))
+    if name == "rem" and len(ivs) == 2:
+        (alo, ahi), (blo, bhi) = ivs
+        if blo > 0:
+            # C-style remainder: |r| < divisor, sign follows dividend,
+            # and |r| <= |dividend|
+            return one((max(-(bhi - 1), min(alo, 0)),
+                        min(bhi - 1, max(ahi, 0))))
+        return one(bounds)
+    if name == "population_count" and ivs:
+        lo, hi = ivs[0]
+        if lo >= 0:
+            return one((0 if lo == 0 else 1, max(hi.bit_length(), 1)))
+        return one((0, 64))
+    if name == "iota":
+        dim = eqn.params.get("dimension", 0)
+        shape = eqn.params.get("shape", (1,))
+        return one((0, max(int(shape[dim]) - 1, 0)))
+    if name in ("argmax", "argmin") and ivs:
+        op_shape = getattr(eqn.invars[0].aval, "shape", (1,))
+        n = _axes_count(op_shape, eqn.params.get("axes", ()))
+        return one((0, n - 1))
+    if name in ("reduce_sum", "psum", "cumsum") and ivs:
+        op_shape = getattr(eqn.invars[0].aval, "shape", (1,))
+        if name == "cumsum":
+            n = int(op_shape[eqn.params.get("axis", 0)])
+            lo, hi = ivs[0]
+            return one((min(lo, n * lo), max(hi, n * hi)))
+        axes = eqn.params.get("axes")
+        n = (_axes_count(op_shape, axes) if axes is not None
+             else int(np.prod(op_shape)) or 1)
+        return one((n * ivs[0][0] if ivs[0][0] < 0 else ivs[0][0],
+                    n * ivs[0][1] if ivs[0][1] > 0 else ivs[0][1]))
+    if name == "reduce_prod" and ivs:
+        return one(bounds)
+    if name == "dot_general" and len(ivs) == 2:
+        dims = eqn.params.get("dimension_numbers")
+        try:
+            (lc, _), _ = dims
+            n = _axes_count(getattr(eqn.invars[0].aval, "shape", (1,)), lc)
+        except Exception:
+            n = int(np.prod(getattr(eqn.invars[0].aval, "shape", (1,)))) or 1
+        m = _corners(ivs[0], ivs[1], lambda a, b: a * b)
+        return one((n * m[0] if m[0] < 0 else m[0],
+                    n * m[1] if m[1] > 0 else m[1]))
+    if name in ("concatenate",) and ivs:
+        acc = ivs[0]
+        for iv in ivs[1:]:
+            acc = iv_join(acc, iv)
+        return one(acc)
+    if name == "pad" and len(ivs) >= 2:
+        return one(iv_join(ivs[0], ivs[1]))
+    if name in ("dynamic_update_slice",) and len(ivs) >= 2:
+        return one(iv_join(ivs[0], ivs[1]))
+    if name == "scatter" and len(ivs) >= 3:
+        return one(iv_join(ivs[0], ivs[2]))
+    if name == "scatter-max" and len(ivs) >= 3:
+        # max-combine only ever raises scattered elements
+        return one((ivs[0][0], max(ivs[0][1], ivs[2][1])))
+    if name == "scatter-min" and len(ivs) >= 3:
+        return one((min(ivs[0][0], ivs[2][0]), ivs[0][1]))
+    if name == "scatter-add" and len(ivs) >= 3:
+        n = int(np.prod(getattr(eqn.invars[2].aval, "shape", (1,)))) or 1
+        ulo, uhi = ivs[2]
+        return one((ivs[0][0] + min(0, n * ulo),
+                    ivs[0][1] + max(0, n * uhi)))
+    if name == "sort" and len(ivs) == n_out:
+        # each output is a permutation of the matching input operand
+        return [iv_clamp(iv, aval_bounds(o.aval))
+                for iv, o in zip(ivs, outs)]
+    return None
+
+
+# -------------------------------------------------------------- widening --
+def _thresholds(kernel) -> Tuple[List[int], List[int]]:
+    """Per-kernel widening ladders: geometry-derived landmarks so a
+    bound that is *actually* ``W-1`` or ``R`` stabilizes there instead
+    of jumping straight to the dtype bound."""
+    g, r, w = kernel.G, kernel.R, kernel.W
+    his = sorted({0, 1, 2, g, r, w, w - 1, r - 1, 255, 256,
+                  (1 << 8) * (w + 1), 1 << 16, 1 << 30})
+    los = sorted({0, -1, -2, -r, -w, -256, -(1 << 16), -(1 << 30)})
+    return los, his
+
+
+def _widen(old: Interval, new: Interval, los: List[int],
+           his: List[int], bounds: Interval) -> Interval:
+    lo, hi = new
+    if lo < old[0]:
+        lo = bounds[0]
+        for t in reversed(los):
+            if t <= new[0]:
+                lo = max(t, bounds[0])
+                break
+    else:
+        lo = old[0]
+    if hi > old[1]:
+        hi2 = bounds[1]
+        for t in his:
+            if t >= new[1]:
+                hi2 = min(t, bounds[1])
+                break
+        hi = hi2
+    else:
+        hi = old[1]
+    return (lo, hi)
+
+
+# --------------------------------------------------------------- walker --
+def _sub_jaxpr(obj):
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    if hasattr(obj, "eqns"):
+        return obj
+    return None
+
+
+def _call_jaxpr(eqn):
+    """The single sub-jaxpr of a call-like eqn (pjit & friends)."""
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in eqn.params:
+            j = _sub_jaxpr(eqn.params[key])
+            if j is not None:
+                return j
+    return None
+
+
+class _Walker:
+    """One interval (+ optional relational) pass over a jaxpr forest.
+
+    Abstract values are ``(interval, lbs, ubs)``: the value's integer
+    interval plus — in relational mode — frozensets of lower/upper
+    bound witnesses (pre-state leaf tokens ``"leaf:<name>"`` and
+    ``(ctx, var-id)`` tokens for intermediate values), used by the
+    pairwise-fact pass.  The ctx component is a fresh id per dynamic
+    ``run`` invocation: jax *shares* sub-jaxpr bodies across call
+    sites (every same-shape ``jnp.where`` reuses one body), so a bare
+    var id would equate distinct runtime values and forge bound
+    witnesses.
+    """
+
+    def __init__(self, rel: bool = False):
+        self.rel = rel
+        self.defs: Dict[Any, Any] = {}  # var -> defining eqn
+        # inner call-jaxpr invar -> the outer operand it binds (pjit and
+        # cond boundaries only: those bind the same value; loop carries
+        # change per iteration and are deliberately NOT aliased).  This
+        # is what lets the select_n branch refinement see through
+        # `jnp.where` — a jitted function whose body invars are fresh
+        # vars — and still match the predicate's comparison operands
+        # against the case operands by identity.
+        self.alias: Dict[Any, Any] = {}
+        self._envs: List[Dict[Any, Tuple]] = []
+        self._ctx: List[int] = []
+        self._next_ctx = 0
+
+    # -- env helpers -----------------------------------------------------
+    def _read(self, env, v):
+        if isinstance(v, _Literal):
+            iv = literal_interval(v)
+            if iv is None:
+                iv = aval_bounds(v.aval)
+            return (iv, _NO_REL[0], _NO_REL[1])
+        val = env.get(v)
+        if val is None:
+            return (aval_bounds(v.aval), _NO_REL[0], _NO_REL[1])
+        if not self.rel:
+            return val
+        # x <= x: the var itself witnesses both bounds, which is what
+        # lets `exec' = min(exec + adv, commit_var)` relate to the
+        # commit output without naming intermediate vars up front
+        r = (self._ctx[-1], id(v))
+        lbs, ubs = val[1], val[2]
+        if len(lbs) < _REL_CAP:
+            lbs = lbs | {r}
+        if len(ubs) < _REL_CAP:
+            ubs = ubs | {r}
+        return (val[0], lbs, ubs)
+
+    def run(self, jaxpr, in_vals: List[Tuple],
+            const_vals: List[Tuple] | None = None) -> List[Tuple]:
+        env: Dict[Any, Tuple] = {}
+        self._envs.append(env)
+        self._ctx.append(self._next_ctx)
+        self._next_ctx += 1
+        try:
+            consts = const_vals or [
+                (aval_bounds(v.aval), _NO_REL[0], _NO_REL[1])
+                for v in jaxpr.constvars
+            ]
+            for v, t in zip(jaxpr.constvars, consts):
+                env[v] = t
+            for v, t in zip(jaxpr.invars, in_vals):
+                env[v] = t
+            for eqn in jaxpr.eqns:
+                for ov in eqn.outvars:
+                    self.defs[ov] = eqn
+                ins = [self._read(env, v) for v in eqn.invars]
+                outs = self._transfer(eqn.primitive.name, eqn, ins, env)
+                for v, t in zip(eqn.outvars, outs):
+                    env[v] = t
+            return [self._read(env, v) for v in jaxpr.outvars]
+        finally:
+            self._envs.pop()
+            self._ctx.pop()
+
+    # -- predicate structure (select_n branch refinement) ----------------
+    def _alias_invars(self, jaxpr, operands) -> None:
+        for iv, outer in zip(jaxpr.invars, operands):
+            self.alias[iv] = outer
+
+    def _base(self, v, frames: Tuple = (), inward: bool = True):
+        """Chase a var through value-preserving wrappers —
+        convert/copy, call-boundary bindings, and (when ``inward``)
+        pjit output-to-body hops — so `convert(x)` inside a jitted
+        wrapper still matches `x` in conjunct/operand identity checks.
+
+        ``frames`` is the stack of invar->outer-operand bindings for
+        bodies entered by *descending* from a call eqn during this
+        chase.  Descended bodies must use their frame (NOT
+        ``self.alias``): jax shares bodies across call sites, so the
+        global alias entry may belong to a different, later call of
+        the same body.  ``self.alias`` is only valid for the currently
+        *running* ancestor chain, which is exactly the frames-empty
+        case.
+
+        ``inward=False`` stops at the outermost stable var instead of
+        hopping into call bodies whose envs have been popped — use it
+        when the result's *interval* will be looked up (inward hops
+        land on scope-dead vars and lose the interval); full inward
+        chasing is for identity comparison only.
+
+        An inward descent is identity-preserving only if the chase
+        pops back *out* of the body through its frame: jax shares call
+        bodies across sites, so *every* `where(...)`-shaped call of
+        the same signature owns the same body-local vars, and two
+        semantically unrelated outer values would "converge" on the
+        same inner select outvar if the chase were allowed to
+        terminate there.  ``pend`` records the pre-descent outer var
+        for each descent still on the frames stack; a chase that
+        terminates while inside a descended body returns the
+        *outermost* pre-descent var instead of the body-local one."""
+        pend: List[Tuple[int, Any]] = []
+        for _ in range(64):
+            if isinstance(v, _Literal):
+                return v
+            hit = None
+            for i in range(len(frames) - 1, -1, -1):
+                if v in frames[i]:
+                    hit = (i, frames[i][v])
+                    break
+            if hit is not None:
+                frames = frames[:hit[0]]
+                while pend and pend[-1][0] >= len(frames):
+                    pend.pop()
+                v = hit[1]
+                continue
+            a = self.alias.get(v)
+            if a is not None and not frames:
+                v = a
+                continue
+            e = self.defs.get(v)
+            if e is None:
+                break
+            p = e.primitive.name
+            if p in ("convert_element_type", "copy"):
+                v = e.invars[0]
+                continue
+            if inward and p not in ("scan", "while", "cond"):
+                j = _call_jaxpr(e)
+                if j is not None and len(j.outvars) == len(e.outvars):
+                    pend.append((len(frames), v))
+                    frames = frames + (dict(zip(j.invars, e.invars)),)
+                    v = j.outvars[e.outvars.index(v)]
+                    continue
+            break
+        return pend[0][1] if pend else v
+
+    def _conjuncts(self, v, depth: int = 0, frames: Tuple = ()):
+        """Comparison conjuncts implied true wherever ``v`` is true.
+
+        Returns ``(conjs, pure)``: ``conjs`` is a list of
+        ``(cmp_name, lhs, rhs)`` with operands already resolved
+        through :meth:`_base` (so they compare by identity against a
+        resolved case operand, and scope-local vars of shared bodies
+        never leak out); ``pure`` is True when the chain contains no
+        ``and``/``reduce_and`` — i.e. ``v`` IS the single comparison,
+        so its *negation* is also a usable fact on the false branch.
+        """
+        if depth > 24 or isinstance(v, _Literal):
+            return [], False
+        for i in range(len(frames) - 1, -1, -1):
+            if v in frames[i]:
+                return self._conjuncts(frames[i][v], depth + 1,
+                                       frames[:i])
+        a = self.alias.get(v)
+        if a is not None and not frames:
+            return self._conjuncts(a, depth + 1)
+        e = self.defs.get(v)
+        if e is None:
+            return [], False
+        p = e.primitive.name
+        if p not in ("scan", "while", "cond", "and", "reduce_and"):
+            j = _call_jaxpr(e)
+            if j is not None and len(j.outvars) == len(e.outvars):
+                return self._conjuncts(
+                    j.outvars[e.outvars.index(v)], depth + 1,
+                    frames + (dict(zip(j.invars, e.invars)),)
+                )
+        if p == "and":
+            a, _ = self._conjuncts(e.invars[0], depth + 1, frames)
+            b, _ = self._conjuncts(e.invars[1], depth + 1, frames)
+            return a + b, False
+        if p == "reduce_and":
+            a, _ = self._conjuncts(e.invars[0], depth + 1, frames)
+            return a, False
+        if p in ("broadcast_in_dim", "reshape", "squeeze", "expand_dims",
+                 "convert_element_type", "copy"):
+            return self._conjuncts(e.invars[0], depth + 1, frames)
+        if p == "ne":
+            # `ne(x, 0/False)` over a bool x is the identity wrapper
+            # jnp inserts around predicates — chase through it
+            rhs = e.invars[1]
+            rhs_zero = (
+                isinstance(rhs, _Literal)
+                and literal_interval(rhs) == (0, 0)
+            )
+            if rhs_zero and _is_bool(e.invars[0].aval):
+                return self._conjuncts(e.invars[0], depth + 1, frames)
+            return [(p, self._base(e.invars[0], frames, inward=False),
+                     self._base(e.invars[1], frames, inward=False))], True
+        if p in _CMP_NAMES:
+            return [(p, self._base(e.invars[0], frames, inward=False),
+                     self._base(e.invars[1], frames, inward=False))], True
+        return [], False
+
+    def _iv_of(self, env, v) -> Interval:
+        """Interval of a conjunct operand: it may live in an enclosing
+        scope (the predicate is computed outside the jitted `where`
+        wrapper the select sits in), so search the whole env stack."""
+        if isinstance(v, _Literal):
+            iv = literal_interval(v)
+            return iv if iv is not None else aval_bounds(v.aval)
+        for e in reversed(self._envs):
+            if v in e:
+                return e[v][0]
+        return aval_bounds(v.aval)
+
+    @staticmethod
+    def _refine(civ: Interval, cmpn: str, left: bool,
+                other: Interval) -> Optional[Interval]:
+        """Meet a case interval with `case <cmpn> other` (or mirrored
+        when the case var is the right operand)."""
+        lo, hi = civ
+        olo, ohi = other
+        if not left:
+            mirror = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                      "eq": "eq", "ne": "ne"}
+            cmpn = mirror[cmpn]
+        if cmpn == "ge":
+            lo = max(lo, olo)
+        elif cmpn == "gt":
+            lo = max(lo, olo + 1)
+        elif cmpn == "le":
+            hi = min(hi, ohi)
+        elif cmpn == "lt":
+            hi = min(hi, ohi - 1)
+        elif cmpn == "eq":
+            lo, hi = max(lo, olo), min(hi, ohi)
+        return None if lo > hi else (lo, hi)
+
+    _NEGATE = {"eq": "ne", "ne": "eq", "lt": "ge", "le": "gt",
+               "gt": "le", "ge": "lt"}
+
+    def _refined_case(self, env, eqn, idx: int, civ: Interval,
+                      conjs, pure: bool) -> Optional[Interval]:
+        """Refine case ``idx`` of a 2-case select by the predicate's
+        conjuncts: on the true branch every conjunct holds; on the
+        false branch only a *pure* single comparison can be negated."""
+        case_v = self._base(eqn.invars[idx + 1])
+        use = conjs
+        if idx == 0:
+            if not (pure and len(conjs) == 1):
+                return civ
+            n, a, b = conjs[0]
+            use = [(self._NEGATE[n], a, b)]
+        out = civ
+        for (cmpn, a, b) in use:
+            if cmpn == "ne":
+                continue
+            # operands arrive outward-resolved (their envs are live for
+            # _iv_of); finish the identity match with full inward
+            # chasing, which is deterministic so both sides converge
+            if not isinstance(a, _Literal) and self._base(a) is case_v:
+                out2 = self._refine(out, cmpn, True, self._iv_of(env, b))
+            elif not isinstance(b, _Literal) and self._base(b) is case_v:
+                out2 = self._refine(out, cmpn, False, self._iv_of(env, a))
+            else:
+                continue
+            if out2 is None:
+                return None  # branch unreachable under the conjuncts
+            out = out2
+        return out
+
+    # -- transfer --------------------------------------------------------
+    def _transfer(self, name, eqn, ins, env) -> List[Tuple]:
+        n_out = len(eqn.outvars)
+
+        def tops():
+            return [(aval_bounds(o.aval), _NO_REL[0], _NO_REL[1])
+                    for o in eqn.outvars]
+
+        if name == "select_n" and len(ins) >= 2:
+            piv = ins[0][0]
+            cases = ins[1:]
+            idxs = [i for i in range(len(cases))
+                    if piv[0] <= i <= piv[1]] or list(range(len(cases)))
+            conjs, pure = ([], False)
+            if len(cases) == 2 and len(idxs) > 1:
+                conjs, pure = self._conjuncts(eqn.invars[0])
+            ivs = []
+            for i in idxs:
+                civ = cases[i][0]
+                if len(cases) == 2 and (conjs or pure):
+                    civ = self._refined_case(env, eqn, i, civ, conjs, pure)
+                if civ is not None:
+                    ivs.append(civ)
+            if not ivs:  # every branch refined empty: fall back unrefined
+                ivs = [cases[i][0] for i in idxs]
+            acc = ivs[0]
+            for iv in ivs[1:]:
+                acc = iv_join(acc, iv)
+            acc = iv_clamp(acc, aval_bounds(eqn.outvars[0].aval))
+            lbs = ubs = frozenset()
+            if self.rel:
+                lbs = frozenset.intersection(*[cases[i][1] for i in idxs])
+                ubs = frozenset.intersection(*[cases[i][2] for i in idxs])
+            return [(acc, lbs, ubs)] * n_out
+
+        sub = self._sub_transfer(name, eqn, ins)
+        if sub is not None:
+            return sub
+
+        ivs = prim_intervals(name, eqn, [t[0] for t in ins])
+        if ivs is None:
+            return tops()
+        rels = [_NO_REL] * n_out
+        if self.rel:
+            rels = [self._rel_transfer(name, eqn, ins)] * n_out
+        return [(iv, r[0], r[1]) for iv, r in zip(ivs, rels)]
+
+    def _rel_transfer(self, name, eqn, ins) -> Tuple[FrozenSet, FrozenSet]:
+        """Bound-witness propagation for the order-preserving prims.
+        Only exercised on same-shape elementwise ops — shape changes
+        break the elementwise alignment the pairwise facts rely on."""
+        out_shape = getattr(eqn.outvars[0].aval, "shape", None)
+        shapes_ok = all(
+            getattr(v.aval, "shape", None) == out_shape
+            for v in eqn.invars if not isinstance(v, _Literal)
+        )
+        if not shapes_ok:
+            return _NO_REL
+        if name == "max" and len(ins) == 2:
+            return (ins[0][1] | ins[1][1], ins[0][2] & ins[1][2])
+        if name == "min" and len(ins) == 2:
+            return (ins[0][1] & ins[1][1], ins[0][2] | ins[1][2])
+        if name in ("convert_element_type", "copy") and ins:
+            return (ins[0][1], ins[0][2])
+        if name == "add" and len(ins) == 2:
+            (aiv, albs, aubs), (biv, blbs, bubs) = ins[0], ins[1]
+            lbs, ubs = frozenset(), frozenset()
+            if biv[0] >= 0:
+                lbs |= albs
+            if biv[1] <= 0:
+                ubs |= aubs
+            if aiv[0] >= 0:
+                lbs |= blbs
+            if aiv[1] <= 0:
+                ubs |= bubs
+            return (lbs, ubs)
+        if name == "sub" and len(ins) == 2:
+            (_, albs, aubs), (biv, _, _) = ins[0], ins[1]
+            lbs, ubs = frozenset(), frozenset()
+            if biv[1] <= 0:
+                lbs |= albs
+            if biv[0] >= 0:
+                ubs |= aubs
+            return (lbs, ubs)
+        if name == "clamp" and len(ins) == 3:
+            return (ins[0][1], ins[2][2])
+        return _NO_REL
+
+    def _sub_transfer(self, name, eqn, ins) -> Optional[List[Tuple]]:
+        params = eqn.params
+        join = _join_vals
+        if name == "cond":
+            outs = None
+            for br in params["branches"]:
+                j = _sub_jaxpr(br)
+                if j is None:
+                    continue
+                self._alias_invars(j, eqn.invars[1:])
+                res = self.run(j, list(ins[1:]))
+                outs = res if outs is None else [
+                    join(a, b) for a, b in zip(outs, res)
+                ]
+            return outs
+        if name == "while":
+            bj = _sub_jaxpr(params["body_jaxpr"])
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            carry = list(ins[cn + bn:])
+            body_consts = list(ins[cn:cn + bn])
+            carry = self._loop_fixpoint(
+                bj, body_consts, carry, n_carry=len(carry))[0]
+            return carry
+        if name == "scan":
+            j = _sub_jaxpr(params["jaxpr"])
+            if j is None:
+                return None
+            nc, ncar = params["num_consts"], params["num_carry"]
+            consts = list(ins[:nc])
+            carry = list(ins[nc:nc + ncar])
+            xs = list(ins[nc + ncar:])
+            carry, ys = self._loop_fixpoint(
+                j, consts, carry, n_carry=ncar, xs=xs)
+            return carry + ys
+        j = _call_jaxpr(eqn)
+        if j is not None:
+            self._alias_invars(j, eqn.invars)
+            return self.run(j, list(ins))
+        return None
+
+    def _loop_fixpoint(self, jaxpr, consts, carry, n_carry, xs=None):
+        """Inner loop-carry fixpoint with the same threshold widening as
+        the outer state fixpoint (scan carries are state-like)."""
+        los = [0, -1, -256, -(1 << 30)]
+        his = [0, 1, 2, 255, 256, 1 << 16, 1 << 30]
+        ys_acc = None
+        for it in range(_INNER_CAP):
+            res = self.run(jaxpr, consts + carry + (xs or []))
+            nxt = [_join_vals(a, b) for a, b in zip(res[:n_carry], carry)]
+            ys = res[n_carry:]
+            ys_acc = ys if ys_acc is None else [
+                _join_vals(a, b) for a, b in zip(ys_acc, ys)
+            ]
+            if it >= 2:
+                nxt = [
+                    (_widen(c[0], n[0], sorted(los), his,
+                            (-_FINF, _FINF)), n[1], n[2])
+                    for c, n in zip(carry, nxt)
+                ]
+            if nxt == carry:
+                return carry, (ys_acc or [])
+            carry = nxt
+        raise RuntimeError("range loop-carry fixpoint did not converge")
+
+
+def _join_vals(a: Tuple, b: Tuple) -> Tuple:
+    return (iv_join(a[0], b[0]), a[1] & b[1], a[2] & b[2])
+
+
+# --------------------------------------------------------- kernel driver --
+@dataclasses.dataclass(frozen=True)
+class RangeAnalysis:
+    """Proven inductive invariants for one kernel instance."""
+
+    #: state leaf -> (lo, hi), inclusive, proven inductive
+    invariants: Dict[str, Interval]
+    #: elementwise pairwise facts (x, y) meaning x <= y, proven
+    #: inductive over the [G, R] signed-int leaves
+    pairs: Tuple[Tuple[str, str], ...]
+    #: outer widening/narrowing rounds it took
+    iterations: int
+
+    def as_json(self) -> dict:
+        return {
+            "invariants": {
+                k: [int(v[0]), int(v[1])]
+                for k, v in sorted(self.invariants.items())
+            },
+            "pairs": [[a, b] for a, b in self.pairs],
+            "iterations": self.iterations,
+        }
+
+
+# one analysis per traced surface, same key shape as
+# contract._TRACE_CACHE so a graftlint run or pytest session pays once
+_RANGE_CACHE: Dict[Tuple, RangeAnalysis] = {}
+
+
+def _init_intervals(kernel, state_keys) -> Dict[str, Interval]:
+    from ..core import telemetry
+
+    out: Dict[str, Interval] = {}
+    for seed in INIT_SEEDS:
+        st = telemetry.attach(
+            kernel.init_state(seed=seed), kernel.G, kernel.R
+        )
+        for k in state_keys:
+            a = np.asarray(st[k])
+            iv = (int(a.min()), int(a.max()))
+            out[k] = iv if k not in out else iv_join(out[k], iv)
+    return out
+
+
+def _in_vals(in_paths, cur: Dict[str, Interval], closed,
+             rel_seed: Optional[Dict[str, Tuple]] = None) -> List[Tuple]:
+    vals = []
+    for (idx, leaf), var in zip(in_paths, closed.jaxpr.invars):
+        if idx == 0 and leaf in cur:
+            rel = (rel_seed or {}).get(leaf, _NO_REL)
+            vals.append((iv_clamp(cur[leaf], aval_bounds(var.aval)),
+                         rel[0], rel[1]))
+        else:
+            # inbox and ControlInputs leaves: ⊤ within dtype bounds —
+            # the netmodel and the host may deliver anything
+            vals.append((aval_bounds(var.aval), _NO_REL[0], _NO_REL[1]))
+    return vals
+
+
+def _step_intervals(closed, in_paths, out_paths,
+                    cur: Dict[str, Interval]) -> Dict[str, Interval]:
+    w = _Walker(rel=False)
+    outs = w.run(closed.jaxpr, _in_vals(in_paths, cur, closed))
+    res: Dict[str, Interval] = {}
+    for (idx, leaf), val in zip(out_paths, outs):
+        if idx == 0:
+            res[leaf] = (val[0] if leaf not in res
+                         else iv_join(res[leaf], val[0]))
+    return res
+
+
+def analyze_kernel_ranges(kernel) -> RangeAnalysis:
+    """Phase 1+2: inductive per-leaf intervals and pairwise facts."""
+    key = (type(kernel), kernel.G, kernel.R, kernel.W,
+           repr(getattr(kernel, "config", None)))
+    hit = _RANGE_CACHE.get(key)
+    if hit is not None:
+        return hit
+
+    closed, in_paths, out_paths, _, state = trace_step(kernel)
+    state_keys = sorted(state.keys())
+    init_iv = _init_intervals(kernel, state_keys)
+    dtype_top = {
+        leaf: aval_bounds(var.aval)
+        for (idx, leaf), var in zip(in_paths, closed.jaxpr.invars)
+        if idx == 0
+    }
+    los, his = _thresholds(kernel)
+
+    cur = dict(init_iv)
+    rounds = 0
+    for it in range(_OUTER_CAP):
+        rounds = it + 1
+        step = _step_intervals(closed, in_paths, out_paths, cur)
+        nxt = {
+            k: iv_join(cur[k], step.get(k, cur[k])) for k in cur
+        }
+        if nxt == cur:
+            break
+        if it >= 2:
+            nxt = {
+                k: _widen(cur[k], nxt[k], los, his,
+                          dtype_top.get(k, (-_FINF, _FINF)))
+                for k in cur
+            }
+        cur = nxt
+    else:
+        raise RuntimeError(
+            f"{kernel.name}: range fixpoint did not converge in "
+            f"{_OUTER_CAP} rounds"
+        )
+
+    # bounded narrowing recovers precision widening overshot, then the
+    # candidate is re-checked inductive before anything is claimed
+    cand = dict(cur)
+    for _ in range(_NARROW_ROUNDS):
+        step = _step_intervals(closed, in_paths, out_paths, cand)
+        nar = {
+            k: iv_join(init_iv[k], step.get(k, cand[k])) for k in cand
+        }
+        if nar == cand:
+            break
+        cand = nar
+        rounds += 1
+    step = _step_intervals(closed, in_paths, out_paths, cand)
+    inductive = all(
+        iv_leq(init_iv[k], cand[k])
+        and iv_leq(step.get(k, cand[k]), cand[k])
+        for k in cand
+    )
+    final = cand if inductive else cur
+    if not inductive:
+        # `cur` converged as a post-fixpoint containing init, so it is
+        # inductive by construction; assert the safety net anyway
+        step = _step_intervals(closed, in_paths, out_paths, cur)
+        if not all(
+            iv_leq(init_iv[k], cur[k])
+            and iv_leq(step.get(k, cur[k]), cur[k])
+            for k in cur
+        ):  # pragma: no cover - analysis bug guard
+            raise RuntimeError(
+                f"{kernel.name}: widened fixpoint failed its own "
+                "inductiveness re-check"
+            )
+
+    final, t_rounds = _tighten(closed, in_paths, out_paths,
+                               init_iv, final)
+    rounds += t_rounds
+
+    pairs = _pair_facts(kernel, closed, in_paths, out_paths,
+                        state, final)
+    res = RangeAnalysis(
+        invariants=final, pairs=pairs, iterations=rounds
+    )
+    _RANGE_CACHE[key] = res
+    return res
+
+
+def _tighten(closed, in_paths, out_paths, init_iv, proven,
+             max_rounds: int = 64):
+    """Coinductive per-side tightening after the widening fixpoint.
+
+    Widening can drag a self-dependent leaf to dtype-top in an early
+    round (before the leaves it reads were themselves proven), and
+    narrowing cannot recover it: once `dur_bar` is top, `dur_bar' =
+    min(next_slot, dur_bar + lag)` stays top.  So run the dual
+    direction: propose the init-derived bound on every side the
+    fixpoint left strictly weaker, then repeatedly *revert* (to the
+    proven bound) any side that one abstract step refutes.  On
+    convergence the survivors satisfy both `init ⊑ S` (each candidate
+    side contains the union-over-seeds init bound) and
+    `transfer(S) ⊑ S` (the final step refuted nothing) — inductive by
+    construction.
+    """
+    cand = {}
+    for k, (plo, phi) in proven.items():
+        ilo, ihi = init_iv[k]
+        cand[k] = (max(plo, ilo), min(phi, ihi))
+    if cand == dict(proven):
+        return dict(proven), 0
+    for it in range(max_rounds):
+        step = _step_intervals(closed, in_paths, out_paths, cand)
+        changed = False
+        for k, (clo, chi) in list(cand.items()):
+            slo, shi = step.get(k, cand[k])
+            nlo = proven[k][0] if slo < clo else clo
+            nhi = proven[k][1] if shi > chi else chi
+            if (nlo, nhi) != (clo, chi):
+                cand[k] = (nlo, nhi)
+                changed = True
+        if not changed:
+            return cand, it + 1
+    return dict(proven), max_rounds
+
+
+def _pair_facts(kernel, closed, in_paths, out_paths, state,
+                invariants) -> Tuple[Tuple[str, str], ...]:
+    """Octagon-lite pairwise `x <= y` facts over the [G, R] signed-int
+    leaves, by greatest-fixpoint candidate removal (module docstring)."""
+    from ..core import telemetry
+
+    gr = (kernel.G, kernel.R)
+    cand_leaves = sorted(
+        k for k, v in state.items()
+        if getattr(v, "shape", None) == gr
+        and np.dtype(getattr(v, "dtype", np.int32)).kind == "i"
+    )
+    if len(cand_leaves) < 2:
+        return ()
+    inits = []
+    for seed in INIT_SEEDS:
+        st = telemetry.attach(
+            kernel.init_state(seed=seed), kernel.G, kernel.R
+        )
+        inits.append({k: np.asarray(st[k]) for k in cand_leaves})
+    assumed = {
+        (x, y)
+        for x in cand_leaves for y in cand_leaves if x != y
+        if all(bool(np.all(st[x] <= st[y])) for st in inits)
+    }
+    out_vars = {
+        leaf: i for i, (idx, leaf) in enumerate(out_paths) if idx == 0
+    }
+
+    def closure(rel):
+        c = set(rel)
+        changed = True
+        while changed:
+            changed = False
+            for (a, b) in list(c):
+                for (b2, d) in list(c):
+                    if b2 == b and (a, d) not in c and a != d:
+                        c.add((a, d))
+                        changed = True
+        return c
+
+    while assumed:
+        cl = closure(assumed)
+        rel_seed = {}
+        for leaf in cand_leaves:
+            tok = "leaf:" + leaf
+            lbs = {tok} | {"leaf:" + a for (a, b) in cl if b == leaf}
+            ubs = {tok} | {"leaf:" + b for (a, b) in cl if a == leaf}
+            rel_seed[leaf] = (frozenset(lbs), frozenset(ubs))
+        w = _Walker(rel=True)
+        outs = w.run(closed.jaxpr,
+                     _in_vals(in_paths, invariants, closed, rel_seed))
+        ok_tokens = {("leaf:" + a, "leaf:" + b) for (a, b) in cl}
+
+        def survives(x, y):
+            ox, oy = outs[out_vars[x]], outs[out_vars[y]]
+            for z in ox[2]:          # z >= x'
+                for v in oy[1]:      # v <= y'
+                    if z == v or (z, v) in ok_tokens:
+                        return True
+            return False
+
+        kept = {(x, y) for (x, y) in assumed if survives(x, y)}
+        if kept == assumed:
+            break
+        assumed = kept
+    return tuple(sorted(assumed))
+
+
+# ---------------------------------------------------------- claims / R2 --
+def check_claims(kernel, analysis: RangeAnalysis) -> List[Tuple[str, str]]:
+    """Inductiveness check for each author-declared RANGE_CLAIMS entry;
+    returns (leaf, reason) per violated claim (R2 material)."""
+    claims = getattr(kernel, "RANGE_CLAIMS", ()) or ()
+    if not claims:
+        return []
+    closed, in_paths, out_paths, _, state = trace_step(kernel)
+    init_iv = _init_intervals(kernel, sorted(state.keys()))
+    bad: List[Tuple[str, str]] = []
+    for leaf, lo, hi in claims:
+        claim = (int(lo), int(hi))
+        if leaf not in init_iv:
+            bad.append((leaf, f"claimed leaf {leaf!r} is not a state leaf"))
+            continue
+        if not iv_leq(init_iv[leaf], claim):
+            bad.append((leaf, (
+                f"claim [{lo}, {hi}] does not hold at init_state: "
+                f"init interval is {list(init_iv[leaf])}"
+            )))
+            continue
+        if iv_leq(analysis.invariants[leaf], claim):
+            continue  # implied by the proven invariant
+        seeded = dict(analysis.invariants)
+        m = iv_meet(seeded[leaf], claim)
+        seeded[leaf] = m if m is not None else claim
+        step = _step_intervals(closed, in_paths, out_paths, seeded)
+        got = step.get(leaf, seeded[leaf])
+        if not iv_leq(got, claim):
+            bad.append((leaf, (
+                f"claim [{lo}, {hi}] is not inductive: one abstract "
+                f"step from the claimed interval reaches "
+                f"{[int(got[0]), int(got[1])]}"
+            )))
+    return bad
+
+
+# ------------------------------------------------------------ entrypoint --
+def variant_analyses(make_protocol, name: str
+                     ) -> List[Tuple[str, Any, RangeAnalysis]]:
+    """(variant, kernel, analysis) for every config variant that
+    differs — the same variant set the contract and taint passes walk."""
+    kernel = build_kernel(make_protocol, name)
+    out = [("device", kernel, analyze_kernel_ranges(kernel))]
+    if host_variant_differs(kernel):
+        k = build_kernel(make_protocol, name, "host")
+        out.append(("host", k, analyze_kernel_ranges(k)))
+    if collective_variant_differs(kernel):
+        k = build_kernel(make_protocol, name, "collective")
+        out.append(("collective", k, analyze_kernel_ranges(k)))
+    return out
+
+
+def verify_kernel_ranges(make_protocol, name: str) -> PassResult:
+    """Range-proof pass for one registered kernel: derive the inductive
+    invariants per config variant (serialized into the report extra) and
+    check every RANGE_CLAIMS declaration (violations are R2 findings)."""
+    res = PassResult()
+    try:
+        variants = {}
+        seen = set()
+        for vname, kernel, ra in variant_analyses(make_protocol, name):
+            variants[vname] = ra.as_json()
+            for leaf, reason in check_claims(kernel, ra):
+                f = rule_finding(
+                    "R2", kernel.name, leaf,
+                    f"RANGE_CLAIMS[{leaf!r}]: {reason}",
+                )
+                if f.fingerprint not in seen:
+                    seen.add(f.fingerprint)
+                    res.findings.append(f)
+        res.extra["variants"] = variants
+    except Exception as e:
+        res.error = f"{type(e).__name__}: {e}"
+    return res

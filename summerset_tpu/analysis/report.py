@@ -79,6 +79,12 @@ class PassResult:
         default_factory=list
     )  # (finding, reason)
     error: Optional[str] = None  # pass crashed (counts as failure)
+    # pass-specific structured payload riding into LINT.json: the range
+    # pass serializes its proven invariants here, the taint pass its
+    # proven-vs-optimistic gate counts and residual predicates.  Must be
+    # JSON-serializable, deterministic (pre-sorted lists), and is NOT
+    # part of ok/fail — it is drift-gated data, not findings.
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -98,6 +104,8 @@ class PassResult:
         }
         if self.error is not None:
             out["error"] = self.error
+        if self.extra:
+            out["extra"] = self.extra
         return out
 
 

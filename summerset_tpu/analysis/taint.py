@@ -61,11 +61,22 @@ selected when the link is ALIVE — the dead-selected branch's sources
 survive — so an inverted gate like ``jnp.where(valid, 0, inbox_lane)``
 (which hands the lane to the dead-link case) no longer launders taint,
 and a provably-inverted mask (``~valid & lane``, dead-world nonzero)
-clears nothing.  A flags-derived gate whose dead-world class mixes with
-*state* (``tick_bal > s["prep_pbal"]`` — deciding it would need runtime
-invariants like ballot nonnegativity) keeps the prior optimistic
-clearing, documented weakening: over the tracked classes the pass is a
-proof, over state-entangled predicates it remains a high-signal lint.
+clears nothing.  State-entangled predicates (``tick_bal >
+s["prep_pbal"]`` — deciding them needs runtime invariants like ballot
+nonnegativity) are closed by the range pass (``analysis/ranges.py``):
+every abstract value also carries a dead-world *interval* ``rng``,
+state input leaves are seeded with the proven inductive invariants
+(sound: an invariant holds at every reachable state, and the dead
+world is a reachable state with ``flags`` zeroed — state leaves keep
+their values), the shared interval transfer table propagates them,
+and a comparison whose operand intervals decide its sign gets a sound
+dead-world polarity the flat ``dead`` lattice cannot see.  Every gate
+that drops live taint is counted: *proven* when its polarity was
+decided (dead class or interval), *optimistic* when the legacy
+clearing fired undecided — and each optimistic clear is reported as a
+residual descriptor (primitive, trace name stack, operand avals,
+sources).  Over the proven set the pass is a proof; the residual list
+is the complete, checked-in statement of what is still lint.
 """
 
 from __future__ import annotations
@@ -83,6 +94,7 @@ from .contract import (
     rule_finding, trace_step,
 )
 from .report import PassResult
+from . import ranges as _ranges
 
 EMPTY: FrozenSet[str] = frozenset()
 
@@ -97,10 +109,18 @@ class Taint:
     # nonzero, magnitude unknown", None means unknown.  Gates clear
     # taint ONLY when the polarity is tracked (see module docstring).
     dead: Any = None
+    # dead-world value *interval* ``(lo, hi)`` or None when untracked:
+    # state leaves are seeded with the range pass's proven inductive
+    # invariants, ``ranges.prim_intervals`` propagates them, and the
+    # polarity predicates (`_dead_zero`/`_dead_nonzero`) consult them —
+    # the channel that decides state-entangled gates.  Joins are FLAT
+    # (agreement keeps, disagreement -> None), keeping the loop-carry
+    # lattice finite like ``dead``.
+    rng: Any = None
 
 
 CLEAN = Taint()
-GUARD = Taint(EMPTY, True, 0)
+GUARD = Taint(EMPTY, True, 0, (0, 0))
 
 # primitives whose first operand selects among the rest
 _SELECT_PRIMS = frozenset({"select_n"})
@@ -143,11 +163,16 @@ _FIXPOINT_CAP = 10_000
 
 def _dead_zero(t: Taint) -> bool:
     """Is this value provably zero in the dead world?  (`==` would let
-    False/0.0 sneak through "nz" — compare the class explicitly.)"""
+    False/0.0 sneak through "nz" — compare the class explicitly.)
+    Either channel decides: the flat class or a point interval."""
+    if t.rng is not None and t.rng[0] == 0 and t.rng[1] == 0:
+        return True
     return t.dead is not None and not isinstance(t.dead, str) and t.dead == 0
 
 
 def _dead_nonzero(t: Taint) -> bool:
+    if t.rng is not None and (t.rng[0] > 0 or t.rng[1] < 0):
+        return True
     return t.dead == "nz" or (
         t.dead is not None and not isinstance(t.dead, str) and t.dead != 0
     )
@@ -162,13 +187,26 @@ def _join_dead(*deads):
     return first
 
 
+def _join_rng(*rngs):
+    """Flat interval join: agreement keeps, disagreement is unknown
+    (an interval hull would be more precise but makes the loop-carry
+    lattice tall — a counter growing one slot per round would walk the
+    whole dtype range before the fixpoint check fired)."""
+    first = rngs[0] if rngs else None
+    for r in rngs[1:]:
+        if r is None or first is None or r != first:
+            return None
+    return first
+
+
 def _join(*ts: Taint) -> Taint:
     src: Set[str] = set()
     guard = False
     for t in ts:
         src |= t.sources
         guard |= t.guard
-    return Taint(frozenset(src), guard, _join_dead(*[t.dead for t in ts]))
+    return Taint(frozenset(src), guard, _join_dead(*[t.dead for t in ts]),
+                 _join_rng(*[t.rng for t in ts]))
 
 
 def _literal_dead(v):
@@ -229,6 +267,27 @@ class _Walker:
 
     def __init__(self):
         self.depth = 0
+        # gate accounting (module docstring): descriptor-keyed sets of
+        # the sources each gate dropped, split by whether the gate's
+        # dead-world polarity was decided ("proven") or the legacy
+        # optimistic clearing fired ("optimistic" — the residual list)
+        self.gates: Dict[str, Dict[Tuple, Set[str]]] = {
+            "proven": {}, "optimistic": {},
+        }
+
+    def _gate(self, kind: str, eqn, cleared) -> None:
+        """Record one gate occurrence that dropped live taint.  Keyed by
+        a line-number-free descriptor (primitive, trace name stack,
+        operand avals) so the counts and residual list serialized into
+        LINT.json are deterministic across regenerations."""
+        if not cleared:
+            return
+        key = (
+            eqn.primitive.name,
+            str(getattr(eqn.source_info, "name_stack", "")),
+            tuple(str(v.aval) for v in eqn.invars),
+        )
+        self.gates[kind].setdefault(key, set()).update(cleared)
 
     def run(self, jaxpr, in_taints: List[Taint],
             const_taints: List[Taint] | None = None) -> List[Taint]:
@@ -236,7 +295,8 @@ class _Walker:
 
         def read(v) -> Taint:
             if isinstance(v, _Literal):
-                return Taint(EMPTY, False, _literal_dead(v))
+                return Taint(EMPTY, False, _literal_dead(v),
+                             _ranges.literal_interval(v))
             return env.get(v, CLEAN)
 
         def write(v, t: Taint) -> None:
@@ -258,6 +318,34 @@ class _Walker:
 
     # ------------------------------------------------------- transfer --
     def _transfer(self, name: str, eqn, ins: List[Taint]) -> List[Taint]:
+        """Core transfer plus the dead-world interval overlay: any
+        primitive computes the same function in every world, so the
+        range pass's value-interval table is a sound transfer for the
+        dead-world ``rng`` channel as-is.  The overlay only fills
+        outputs whose core rule did not claim a (tighter) interval
+        itself; call-like / control-flow prims return ``None`` from the
+        table and keep the recursion's results."""
+        outs = self._transfer_core(name, eqn, ins)
+        ivs = []
+        for v, t in zip(eqn.invars, ins):
+            r = t.rng
+            if r is None:
+                r = _ranges.aval_bounds(v.aval)
+            ivs.append((int(r[0]), int(r[1])))
+        try:
+            rngs = _ranges.prim_intervals(name, eqn, ivs)
+        except Exception:  # pragma: no cover - table bug must not kill T1
+            rngs = None
+        if rngs:
+            outs = [
+                t if t.rng is not None or r is None
+                else dataclasses.replace(t, rng=(int(r[0]), int(r[1])))
+                for t, r in zip(outs, rngs)
+            ]
+        return outs
+
+    def _transfer_core(self, name: str, eqn,
+                       ins: List[Taint]) -> List[Taint]:
         n_out = len(eqn.outvars)
         if name in _SELECT_PRIMS and ins:
             pred, cases = ins[0], ins[1:]
@@ -276,13 +364,24 @@ class _Walker:
                 # ITS sources are consumed on a dead link — the alive-
                 # selected branches are cleared (that is the gate), and an
                 # inverted gate keeps the lane's taint alive
+                cleared: Set[str] = set()
+                for c in cases:
+                    if c is not sel:
+                        cleared |= c.sources
+                self._gate("proven", eqn, cleared - sel.sources)
                 out = Taint(
-                    frozenset(pred.sources | sel.sources), True, sel.dead
+                    frozenset(pred.sources | sel.sources), True, sel.dead,
+                    sel.rng,
                 )
             elif pred.guard:
-                # flags-derived predicate whose dead-world class mixes
-                # with state: the prior optimistic clearing (documented
-                # weakening — see module docstring)
+                # flags-derived predicate neither the dead class nor the
+                # proven intervals decide: the optimistic clearing
+                # remains, counted and reported as a residual (module
+                # docstring)
+                dropped: Set[str] = set()
+                for c in cases:
+                    dropped |= c.sources
+                self._gate("optimistic", eqn, dropped)
                 out = Taint(pred.sources, True, None)
             else:
                 out = _join(pred, *cases)
@@ -295,13 +394,22 @@ class _Walker:
             # nonzero) passes the lane exactly on dead links and clears
             # nothing; unknown polarity keeps the optimistic clearing
             src: Set[str] = set()
+            prv: Set[str] = set()
+            opt: Set[str] = set()
             for i, t in enumerate(ins):
-                if any(
-                    o.guard and not _dead_nonzero(o)
-                    for j, o in enumerate(ins) if j != i
-                ):
+                gaters = [
+                    o for j, o in enumerate(ins)
+                    if j != i and o.guard and not _dead_nonzero(o)
+                ]
+                if gaters:
+                    if any(_dead_zero(o) for o in gaters):
+                        prv |= t.sources
+                    else:
+                        opt |= t.sources
                     continue
                 src |= t.sources
+            self._gate("proven", eqn, prv)
+            self._gate("optimistic", eqn, opt)
             deads = [t.dead for t in ins]
             if any(_dead_zero(t) for t in ins):
                 dead = 0  # 0 & x == 0 * x == 0
@@ -485,21 +593,45 @@ class _Walker:
         return None
 
 
-def analyze_kernel_flows(kernel) -> Set[Tuple[str, str]]:
-    """All ungated (inbox_leaf -> state_leaf) flows in one traced step."""
+def analyze_kernel_flows(kernel, invariants=None,
+                         stats=None) -> Set[Tuple[str, str]]:
+    """All ungated (inbox_leaf -> sink) flows in one traced step.
+
+    ``invariants`` (leaf -> ``(lo, hi)``, from
+    :func:`ranges.analyze_kernel_ranges`) seeds each state input leaf's
+    dead-world interval — phase 3 of the range pass: sound because an
+    inductive invariant holds at every reachable state and the dead
+    world is a reachable state with ``flags`` zeroed, which leaves
+    state values untouched.  ``stats``, when given, is merged with the
+    walker's gate accounting (``"proven"``/``"optimistic"`` descriptor
+    maps) so the caller can aggregate across config variants.
+    """
     closed, in_paths, out_paths, _, _ = trace_step(kernel)
+    inv = invariants or {}
     in_taints: List[Taint] = []
-    for idx, leaf in in_paths:
+    for (idx, leaf), var in zip(in_paths, closed.jaxpr.invars):
         if idx == 1:  # inbox tree
             if leaf == "flags":
                 in_taints.append(GUARD)
             else:
                 in_taints.append(Taint(frozenset({leaf}), False))
+        elif idx == 0 and leaf in inv:
+            iv = _ranges.iv_clamp(
+                (int(inv[leaf][0]), int(inv[leaf][1])),
+                _ranges.aval_bounds(var.aval),
+            )
+            in_taints.append(Taint(EMPTY, False, None, iv))
         else:
             in_taints.append(CLEAN)
-    out_taints = _Walker().run(
+    w = _Walker()
+    out_taints = w.run(
         closed.jaxpr, in_taints, [CLEAN] * len(closed.jaxpr.constvars)
     )
+    if stats is not None:
+        for kind, d in w.gates.items():
+            tgt = stats.setdefault(kind, {})
+            for key, srcs in d.items():
+                tgt.setdefault(key, set()).update(srcs)
     flows: Set[Tuple[str, str]] = set()
     for (idx, leaf), taint in zip(out_paths, out_taints):
         if idx == 0:
@@ -525,23 +657,49 @@ def analyze_kernel_flows(kernel) -> Set[Tuple[str, str]]:
     return flows
 
 
-def verify_kernel_taint(make_protocol, name: str) -> PassResult:
-    """T1/T9 findings for one registered kernel (both config variants)."""
+def verify_kernel_taint(make_protocol, name: str,
+                        use_ranges: bool = True) -> PassResult:
+    """T1/T9 findings for one registered kernel (all config variants).
+
+    ``use_ranges`` feeds the range pass's proven invariants into the
+    dead-world interval channel (phase 3 — see module docstring); gate
+    accounting rides into ``extra``: ``gates_proven`` /
+    ``gates_optimistic`` count distinct gate descriptors that dropped
+    live taint, and ``residuals`` lists every still-optimistic clear
+    with its predicate shape.  A range-analysis failure (broken-kernel
+    fixtures) degrades to interval-free analysis and is surfaced in
+    ``extra["ranges_error"]`` rather than failing the pass.
+    """
     res = PassResult()
     try:
         kernel = build_kernel(make_protocol, name)
-        flows = analyze_kernel_flows(kernel)
+        kernels = [kernel]
         if host_variant_differs(kernel):
-            flows |= analyze_kernel_flows(
-                build_kernel(make_protocol, name, "host")
-            )
+            kernels.append(build_kernel(make_protocol, name, "host"))
         if collective_variant_differs(kernel):
             # the collective tally's [G, R] lane views are their own
             # taint surface: every tally-lane read must still pass the
             # per-link flags gate (core/quorum.py equivalence argument)
-            flows |= analyze_kernel_flows(
-                build_kernel(make_protocol, name, "collective")
+            kernels.append(build_kernel(make_protocol, name, "collective"))
+        flows: Set[Tuple[str, str]] = set()
+        stats: Dict[str, Dict[Tuple, Set[str]]] = {}
+        for k in kernels:
+            inv = None
+            if use_ranges:
+                try:
+                    inv = _ranges.analyze_kernel_ranges(k).invariants
+                except Exception as e:
+                    res.extra["ranges_error"] = f"{type(e).__name__}: {e}"
+            flows |= analyze_kernel_flows(k, invariants=inv, stats=stats)
+        res.extra["gates_proven"] = len(stats.get("proven", {}))
+        res.extra["gates_optimistic"] = len(stats.get("optimistic", {}))
+        res.extra["residuals"] = [
+            {"prim": p, "where": wh, "avals": list(av),
+             "sources": sorted(srcs)}
+            for (p, wh, av), srcs in sorted(
+                stats.get("optimistic", {}).items()
             )
+        ]
         allow = {
             (src, dst): reason
             for src, dst, reason in kernel.TAINT_ALLOW

@@ -111,6 +111,11 @@ KERNEL_CONTRACT: Tuple[Tuple[str, str, str], ...] = (
      "the telem lane block is written only via the stacked "
      "accumulate/bump path in core/telemetry.py, contributed through "
      "the _telemetry hook"),
+    ("R2", "range-claims",
+     "every RANGE_CLAIMS entry (leaf, lo, hi) is an inductive value-"
+     "range invariant: it holds at init_state and is preserved by one "
+     "abstract step under the saturating interval semantics of "
+     "analysis/ranges.py"),
     ("T1", "flags-gating",
      "every inbox read that lands in a state update, an effects "
      "output, or an outbox lane (a relay hop back onto the wire) "
@@ -200,6 +205,17 @@ class ProtocolKernel:
     # fails on any flow not listed here AND on stale entries that no
     # longer occur — suppressions are explicit and cannot rot.
     TAINT_ALLOW: Tuple[Tuple[str, str, str], ...] = ()
+    # -- value-range proof plane (analysis/ranges.py) -----------------------
+    # Author-asserted per-leaf bounds as (state_leaf, lo, hi), inclusive.
+    # The range pass derives inductive interval invariants for every
+    # state leaf mechanically; entries here are *additional* claims a
+    # kernel wants pinned tighter than the derived invariant (e.g. a
+    # window index provably < W).  Each is checked inductive — holds at
+    # init_state AND is preserved by one abstract step — and a violated
+    # claim is an R2 finding.  The derived invariants themselves need no
+    # declaration: they are serialized into LINT.json and cross-checked
+    # against every state the exhaustive model checker visits.
+    RANGE_CLAIMS: Tuple[Tuple[str, int, int], ...] = ()
 
     # -- durable acceptor contract ------------------------------------------
     # State arrays forming this kernel's per-replica durable acceptor

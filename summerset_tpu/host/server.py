@@ -39,6 +39,7 @@ import dataclasses
 import functools
 import os
 import pickle
+import queue
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -645,7 +646,12 @@ class ServerReplica:
                 self.ctrl.send_ctrl(join)
                 try:
                     msg = self.ctrl.recv_ctrl(timeout=3)
-                except Exception:
+                except (queue.Empty, SummersetError):
+                    # the only two recv_ctrl outcomes besides a frame:
+                    # poll timeout and manager-gone — both mean "re-send
+                    # the join and keep waiting".  Anything else (a
+                    # decode bug, a poisoned frame) must surface, not
+                    # dissolve into an infinite join loop.
                     msg = None
                 if msg is not None and msg.kind == "connect_to_peers":
                     for peer, addr in msg.payload["to_peers"].items():
@@ -695,6 +701,7 @@ class ServerReplica:
             if tr is not None:
                 try:
                     tr.close()
+                # graftlint: disable=H106 -- best-effort unwind: the original bring-up exception is re-raised below, and a close() failure on a half-built hub must not mask it
                 except Exception:
                     pass
             for closer in (
@@ -702,6 +709,7 @@ class ServerReplica:
             ):
                 try:
                     closer()
+                # graftlint: disable=H106 -- best-effort unwind: the original bring-up exception is re-raised below, and a stop() failure on a half-built hub must not mask it
                 except Exception:
                     pass
             raise

@@ -111,6 +111,11 @@ EVENT_TYPES = (
                      # (act, plus actuator-specific fields like reason/
                      # api_max_batch/pipeline, tick) — the policy
                      # tier's instant on the exported ctrl track
+    "transport_handshake_fail",
+                     # a p2p dialer never completed the id handshake
+                     # (error) — one stray is a port scan; a stream of
+                     # them is codec skew after a partial upgrade, and
+                     # without the record the mesh silently never forms
 )
 _EVENT_SET = frozenset(EVENT_TYPES)
 

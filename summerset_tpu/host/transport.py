@@ -275,7 +275,17 @@ class TransportHub:
                 return
             try:
                 peer = int(safetcp.recv_msg_sync(sock))
-            except Exception:
+            except Exception as e:
+                # a dialer that never completes the id handshake (port
+                # scanner, crashed peer) is survivable — but record it:
+                # a systematic handshake failure (codec skew after a
+                # partial upgrade) would otherwise look like a mesh
+                # that silently never forms
+                if self.flight is not None:
+                    self.flight.record(
+                        "transport_handshake_fail",
+                        error=f"{type(e).__name__}: {e}",
+                    )
                 sock.close()
                 continue
             self._register(peer, sock)

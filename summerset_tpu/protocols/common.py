@@ -106,9 +106,13 @@ def client_intake(s, inputs, serving, cap: int, window: int,
     G, R = s["exec_bar"].shape
     i32 = jnp.int32
     space = jnp.maximum(s["exec_bar"] + window - s[frontier], 0)
-    n_prop = jnp.broadcast_to(
+    # clamp the host-supplied count at the kernel edge: ControlInputs
+    # are untrusted (top) to the analysis passes, and a negative count
+    # would walk every slot frontier backwards — the clamp is what
+    # makes `next_slot >= 0` (and the bars above it) inductive
+    n_prop = jnp.maximum(jnp.broadcast_to(
         inputs["n_proposals"][:, None].astype(i32), (G, R)
-    )
+    ), 0)
     n_new = jnp.where(
         serving, jnp.minimum(jnp.minimum(n_prop, space), cap), 0
     )

@@ -128,6 +128,51 @@ class InvertedGateKernel(GoodKernel):
         return st
 
 
+class RangeUnsoundKernel(GoodKernel):
+    """R2: an author-claimed ceiling the transfer refutes.  The claim
+    holds at ``init_state`` (commit_bar starts 0), but ``_fold`` maxes
+    an unbounded inbox lane into commit_bar, so one abstract step from
+    the claimed ``[0, 100]`` escapes to the dtype ceiling — the check
+    is *inductiveness*, not just the init snapshot."""
+
+    name = "FixtureRangeUnsound"
+    RANGE_CLAIMS = (("commit_bar", 0, 100),)
+
+
+class RangeEntangledKernel(GoodKernel):
+    """The state-entangled gate only the interval prover clears: the
+    dead-world select predicate ``bal > s["prep_bal"]`` compares a
+    dead-world-known ``-1`` sentinel against a *state* leaf, so the
+    flags polarity lattice alone cannot decide it — but the proven
+    inductive invariant ``prep_bal >= 0`` does (``-1 > prep_bal`` is
+    False in every reachable dead world).  With the range pass live the
+    gate is a PROVEN clear; without it the same select is the legacy
+    optimistic clearing — the pair of counters is the fixture's
+    assertion surface."""
+
+    name = "FixtureRangeEntangled"
+
+    def init_state(self, seed: int = 0):
+        st = super().init_state(seed)
+        st["prep_bal"] = jnp.zeros((self.G, self.R), jnp.int32)
+        return st
+
+    def step(self, state, inbox, inputs):
+        s = dict(state)
+        valid = (inbox["flags"] & jnp.uint32(1)) != 0
+        # dead world: valid is zero, so bal collapses to the -1 sentinel
+        bal = jnp.max(jnp.where(valid, inbox["data"], -1), axis=2)
+        payload = jnp.max(inbox["data"], axis=2)  # raw: stays tainted
+        # the entangled gate: decidable only via prep_bal's invariant
+        ok = bal > s["prep_bal"]
+        s["commit_bar"] = jnp.where(ok, payload, s["commit_bar"])
+        s["prep_bal"] = jnp.maximum(s["prep_bal"], bal)
+        s["exec_bar"] = s["commit_bar"]
+        return s, self.zero_outbox(), StepEffects(
+            commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+        )
+
+
 class StaleAllowKernel(GoodKernel):
     """T9: declares a suppression for a flow that never occurs."""
 
@@ -331,6 +376,8 @@ FIXTURES = {
     "fixtureinvertedgate": InvertedGateKernel,
     "fixtureunflagged": UnflaggedInboxReadKernel,
     "fixtureunflaggedeffects": UnflaggedEffectsKernel,
+    "fixturerangeunsound": RangeUnsoundKernel,
+    "fixturerangeentangled": RangeEntangledKernel,
     "fixturestaleallow": StaleAllowKernel,
     "fixturefloatstate": FloatStateKernel,
     "fixturemissingflags": MissingFlagsKernel,

@@ -11,9 +11,13 @@ Three layers:
 2. **Host AST lint units**: synthetic sources through ``scan_file``
    covering each H-rule and the suppression-comment format.
 3. **The acceptance property**: every registered protocol kernel
-   verifies clean (contract + taint), and the host lint over the real
-   tree is finding-free modulo annotated suppressions — the same
-   invariant CI tier 2e pins via ``scripts/graftlint.py --check``.
+   verifies clean (contract + ranges + taint), and the host lint over
+   the real tree is finding-free modulo annotated suppressions — the
+   same invariant CI tier 2e pins via ``scripts/graftlint.py --check``.
+
+The range prover's own decision tables and fixpoint units live in
+``tests/test_ranges.py``; this file holds its R2 fingerprint and the
+proven-vs-optimistic gate accounting the interval channel feeds T1.
 """
 
 import os
@@ -32,6 +36,9 @@ from summerset_tpu.analysis.report import (  # noqa: E402
     Finding,
     assemble_report,
     dumps_report,
+)
+from summerset_tpu.analysis.ranges import (  # noqa: E402
+    verify_kernel_ranges,
 )
 from summerset_tpu.analysis.taint import verify_kernel_taint  # noqa: E402
 
@@ -61,6 +68,9 @@ def test_good_fixture_is_clean():
         ("fixturebrokenforwarder", verify_kernel_taint,
          ["6ffff174820c"]),
         ("fixturestaleallow", verify_kernel_taint, ["c6fab01b5c86"]),
+        # an author range claim the transfer refutes: holds at init,
+        # one abstract step escapes the ceiling — R2, not a crash
+        ("fixturerangeunsound", verify_kernel_ranges, ["4772bac7adcd"]),
         ("fixturefloatstate", verify_kernel, ["aec22b6e38a8"]),
         ("fixturemissingflags", verify_kernel, ["c746d187a51b"]),
         ("fixtureundeclaredbroadcast", verify_kernel, ["43ec345af97e"]),
@@ -99,6 +109,35 @@ def test_broken_fixtures_fail_only_their_rule():
     assert verify_kernel_taint(make_fixture, "fixturefloatstate").ok
     assert verify_kernel_taint(make_fixture, "fixturebogusdurable").ok
     assert verify_kernel_taint(make_fixture, "fixtureundeclaredinput").ok
+    assert verify_kernel(make_fixture, "fixturerangeunsound").ok
+    assert verify_kernel_taint(make_fixture, "fixturerangeunsound").ok
+
+
+def test_range_entangled_gate_is_proven_only_with_intervals():
+    """The fixture whose gate ONLY the interval prover clears: the
+    dead-world predicate compares a known ``-1`` sentinel against a
+    state leaf, undecidable in the polarity lattice alone.  With the
+    range pass live the select is a PROVEN gate (and the kernel is
+    clean); without it the identical select is the legacy optimistic
+    clearing — the counter pair is the whole point of the tentpole."""
+    with_rng = verify_kernel_taint(
+        make_fixture, "fixturerangeentangled", use_ranges=True
+    )
+    without = verify_kernel_taint(
+        make_fixture, "fixturerangeentangled", use_ranges=False
+    )
+    assert with_rng.ok and without.ok
+    assert with_rng.extra["gates_proven"] == 2
+    assert with_rng.extra["gates_optimistic"] == 0
+    assert with_rng.extra["residuals"] == []
+    assert without.extra["gates_proven"] == 1
+    assert without.extra["gates_optimistic"] == 1
+    assert [r["prim"] for r in without.extra["residuals"]] == ["select_n"]
+    # the enabling invariant is on record: prep_bal proven nonnegative
+    dev = verify_kernel_ranges(
+        make_fixture, "fixturerangeentangled"
+    ).extra["variants"]["device"]
+    assert dev["invariants"]["prep_bal"][0] == 0
 
 
 def test_collective_in_tally_scope_is_clean():
@@ -408,6 +447,70 @@ class Replica:
         self.external.send_replies(self.queue)
 """
 
+# H106 both-direction fixtures: every handler shape the rule must
+# decide — swallowing broad/bare excepts (fire), re-raising / recording
+# / reading the bound exception (clean), narrow types (out of scope)
+_H106_EXCEPTS = """
+class Hub:
+    def swallow(self):
+        try:
+            self.pump()
+        except Exception:
+            pass
+
+    def bare(self):
+        try:
+            self.pump()
+        except:
+            pass
+
+    def tuple_broad(self):
+        try:
+            self.pump()
+        except (ValueError, Exception):
+            self.retries += 1
+
+    def reraises(self):
+        try:
+            self.pump()
+        except Exception:
+            raise
+
+    def records(self):
+        try:
+            self.pump()
+        except Exception:
+            pf_warn(logger, "pump failed")
+
+    def flight_records(self):
+        try:
+            self.pump()
+        except Exception:
+            self.flight.record("pump_fail")
+
+    def reads_the_exception(self):
+        try:
+            self.pump()
+        except Exception as e:
+            self.last_error = repr(e)
+
+    def narrow(self):
+        try:
+            self.pump()
+        except OSError:
+            pass
+"""
+
+_H106_WAIVED = """
+class Hub:
+    def swallow(self):
+        try:
+            self.pump()
+        # graftlint: disable=H106 -- fixture: unwind must not mask
+        except Exception:
+            pass
+"""
+
 _MONO_SCOPE = """
 import time
 
@@ -628,6 +731,42 @@ def test_hostlint_real_workload_module_is_clean():
     assert findings == [] and suppressed == []
 
 
+def test_hostlint_broad_except_must_record(tmp_path):
+    """H106 both directions in a hub-thread module: broad/bare excepts
+    that swallow fire (a tuple containing Exception is broad too); the
+    handlers that re-raise, call a recording helper, or at least read
+    the bound exception are clean, and narrow types are out of scope."""
+    findings, suppressed = _scan(
+        tmp_path, _H106_EXCEPTS, "host/server.py"
+    )
+    assert not suppressed
+    assert sorted((f.code, f.scope) for f in findings) == [
+        ("H106", "Hub.bare:except#0"),
+        ("H106", "Hub.swallow:except#0"),
+        ("H106", "Hub.tuple_broad:except#0"),
+    ]
+
+
+def test_hostlint_broad_except_waiver(tmp_path):
+    """The standalone waiver comment above the except line suppresses
+    H106 and keeps the reason on record."""
+    findings, suppressed = _scan(
+        tmp_path, _H106_WAIVED, "host/server.py"
+    )
+    assert findings == []
+    assert [(f.code, r) for f, r in suppressed] == [
+        ("H106", "fixture: unwind must not mask")
+    ]
+
+
+def test_hostlint_broad_except_is_module_keyed(tmp_path):
+    """The same handlers outside the hub-thread modules are untouched —
+    H106 is scoped to the modules whose worker loops must survive
+    poison input, not a repo-wide style rule."""
+    findings, _ = _scan(tmp_path, _H106_EXCEPTS, "host/metrics.py")
+    assert findings == []
+
+
 def test_hostlint_monotonic_scope_allows_monotonic_flags_wallclock(
     tmp_path,
 ):
@@ -679,6 +818,22 @@ def test_registered_kernel_contract_clean(name):
 def test_registered_kernel_taint_clean(name):
     res = verify_kernel_taint(protocols.make_protocol, name)
     assert res.ok, [f.render() for f in res.findings] or res.error
+    # the proof surface: wherever the kernel gates at all, the interval
+    # channel decided real gates, and every remaining optimistic clear
+    # is on record as a residual
+    n_gates = res.extra["gates_proven"] + res.extra["gates_optimistic"]
+    if n_gates:
+        assert res.extra["gates_proven"] > 0
+    assert res.extra["gates_optimistic"] == len(res.extra["residuals"])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", protocols.protocol_names())
+def test_registered_kernel_ranges_clean(name):
+    res = verify_kernel_ranges(protocols.make_protocol, name)
+    assert res.ok, [f.render() for f in res.findings] or res.error
+    inv = res.extra["variants"]["device"]["invariants"]
+    assert inv, "no proven invariants for a real kernel"
 
 
 def test_host_tree_lint_clean():
@@ -724,7 +879,7 @@ def test_kernel_contract_table_is_authoritative():
     assert codes == sorted(set(codes)), "table codes unsorted/duplicated"
     assert codes == [
         "C1", "C10", "C2", "C3", "C4", "C5", "C6", "C7", "C8", "C9",
-        "T1", "T9",
+        "R2", "T1", "T9",
     ]
     assert rule_finding("C1", "K", "leaf", "m").code == "C1"
     with pytest.raises(KeyError):

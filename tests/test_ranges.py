@@ -1,0 +1,294 @@
+"""Range-prover test suite: the interval transfer's decision tables +
+the kernel-level fixpoint machinery.
+
+Three layers:
+
+1. **Decision-table units**: each interval-transfer primitive class
+   (arithmetic with dtype saturation, comparisons, selects, bitwise,
+   div/rem, reductions/index makers) through ``prim_intervals`` with a
+   synthetic eqn — the table is pure, so no tracing is needed.
+2. **Fixpoint units**: tiny kernels through ``analyze_kernel_ranges``
+   pinning widening convergence on loop carries, comparison-guarded
+   select refinement, and the octagon-lite pair facts.
+3. **Claims**: RANGE_CLAIMS inductiveness, positive direction here (the
+   violated-claim fingerprint lives in test_graftlint.py with the other
+   broken fixtures).
+"""
+
+import os
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from graftlint_fixtures import GoodKernel, make_fixture  # noqa: E402
+
+from summerset_tpu.analysis.ranges import (  # noqa: E402
+    _cmp_interval,
+    analyze_kernel_ranges,
+    aval_bounds,
+    check_claims,
+    iv_clamp,
+    iv_join,
+    iv_leq,
+    iv_meet,
+    literal_interval,
+    prim_intervals,
+    verify_kernel_ranges,
+)
+from summerset_tpu.analysis.contract import build_kernel  # noqa: E402
+
+I32 = (-(2 ** 31), 2 ** 31 - 1)
+
+
+def _aval(dtype="int32", shape=()):
+    return SimpleNamespace(dtype=np.dtype(dtype), shape=shape)
+
+
+def _eqn(out="int32", ins=(), params=None, n_out=1):
+    """Synthetic eqn carrying just what ``prim_intervals`` reads: output
+    avals (dtype saturation), input avals (reduction cardinalities) and
+    the params dict."""
+    return SimpleNamespace(
+        outvars=[SimpleNamespace(aval=_aval(out)) for _ in range(n_out)],
+        invars=[SimpleNamespace(aval=_aval(*i)) for i in ins],
+        params=dict(params or {}),
+    )
+
+
+def _run(name, ivs, **kw):
+    outs = prim_intervals(name, _eqn(**kw), list(ivs))
+    assert outs is not None, f"{name} unmodeled"
+    return outs[0]
+
+
+# ---------------------------------------------------- interval algebra --
+def test_interval_algebra():
+    assert iv_join((0, 3), (5, 9)) == (0, 9)
+    assert iv_meet((0, 5), (3, 9)) == (3, 5)
+    assert iv_meet((0, 2), (5, 9)) is None
+    assert iv_leq((1, 2), (0, 3)) and not iv_leq((0, 3), (1, 2))
+    assert iv_clamp((-10, 10), (0, 7)) == (0, 7)
+    assert aval_bounds(_aval("int32")) == I32
+    assert aval_bounds(_aval("uint32")) == (0, 2 ** 32 - 1)
+    assert aval_bounds(_aval("bool")) == (0, 1)
+
+
+def test_literal_interval_spans_nonuniform_arrays():
+    assert literal_interval(
+        SimpleNamespace(val=np.array([3, -1, 7], np.int32))
+    ) == (-1, 7)
+    assert literal_interval(SimpleNamespace(val=np.uint32(5))) == (5, 5)
+
+
+@pytest.mark.parametrize(
+    "name,a,b,expected",
+    [
+        # decided-true, decided-false, undecided for each comparison
+        ("lt", (0, 4), (5, 9), (1, 1)),
+        ("lt", (5, 9), (0, 5), (0, 0)),
+        ("lt", (0, 5), (5, 9), (0, 1)),
+        ("le", (0, 5), (5, 9), (1, 1)),
+        ("le", (6, 9), (0, 5), (0, 0)),
+        ("gt", (6, 9), (0, 5), (1, 1)),
+        ("gt", (0, 5), (5, 9), (0, 0)),
+        # the ROADMAP exemplar shape: dead-world -1 vs proven-nonneg
+        ("gt", (-1, -1), (0, 2 ** 31 - 1), (0, 0)),
+        ("ge", (5, 9), (0, 5), (1, 1)),
+        ("ge", (0, 4), (5, 9), (0, 0)),
+        ("eq", (0, 4), (5, 9), (0, 0)),
+        ("eq", (3, 3), (3, 3), (1, 1)),
+        ("eq", (0, 4), (4, 9), (0, 1)),
+        ("ne", (0, 4), (5, 9), (1, 1)),
+        ("ne", (3, 3), (3, 3), (0, 0)),
+    ],
+)
+def test_cmp_decision_table(name, a, b, expected):
+    assert _cmp_interval(name, a, b) == expected
+    assert _run(name, [a, b], out="bool") == expected
+
+
+# ---------------------------------------------------------- arithmetic --
+def test_add_sub_saturate_at_dtype_bounds():
+    """The documented no-wrap abstraction: results saturate into the
+    output dtype instead of wrapping."""
+    top = 2 ** 31 - 1
+    assert _run("add", [(top, top), (1, 1)]) == (top, top)
+    assert _run("add", [(0, 5), (10, 20)]) == (10, 25)
+    assert _run("sub", [(I32[0], I32[0]), (1, 1)]) == (I32[0], I32[0])
+    assert _run("sub", [(0, 5), (1, 2)]) == (-2, 4)
+
+
+def test_mul_neg_abs_sign_corners():
+    assert _run("mul", [(-2, 3), (-5, 4)]) == (-15, 12)
+    assert _run("neg", [(-2, 3)]) == (-3, 2)
+    assert _run("abs", [(-5, 3)]) == (0, 5)
+    assert _run("abs", [(-5, -2)]) == (2, 5)
+    assert _run("sign", [(-5, 3)]) == (-1, 1)
+    assert _run("sign", [(2, 9)]) == (1, 1)
+    assert _run("max", [(0, 5), (3, 9)]) == (3, 9)
+    assert _run("min", [(0, 5), (3, 9)]) == (0, 5)
+    assert _run("clamp", [(0, 0), (-9, 99), (7, 7)]) == (0, 7)
+
+
+def test_div_rem():
+    # a divisor interval straddling zero is dtype-top (possible /0)
+    assert _run("div", [(0, 100), (-1, 1)]) == I32
+    assert _run("div", [(0, 100), (8, 8)]) == (0, 12)
+    assert _run("div", [(-7, 7), (2, 2)]) == (-3, 3)  # C truncation
+    # positive divisor: |r| < divisor, sign follows the dividend
+    assert _run("rem", [(0, 100), (8, 8)]) == (0, 7)
+    assert _run("rem", [(-100, 100), (8, 8)]) == (-7, 7)
+    assert _run("rem", [(0, 3), (8, 8)]) == (0, 3)
+
+
+# -------------------------------------------------------------- bitwise --
+def test_bitwise_uint32():
+    assert _run("and", [(0, 12), (0, 300)], out="uint32") == (0, 12)
+    # or >= both operands for nonnegatives, bounded by the joint mask
+    assert _run("or", [(5, 12), (3, 9)], out="uint32") == (5, 15)
+    assert _run("xor", [(0, 12), (0, 9)], out="uint32") == (0, 15)
+    # a possibly-negative operand falls back to dtype bounds
+    assert _run("and", [(-1, 12), (0, 300)]) == I32
+    assert _run(
+        "shift_right_logical", [(64, 256), (3, 4)], out="uint32"
+    ) == (4, 32)
+    assert _run("shift_left", [(1, 1), (0, 4)], out="uint32") == (1, 16)
+    assert _run("not", [(0, 1)], out="bool") == (0, 1)
+    assert _run("not", [(1, 1)], out="bool") == (0, 0)
+
+
+# ----------------------------------------------- selects / reductions --
+def test_select_n_joins_only_reachable_cases():
+    cases = {"ins": (("int32",), ("int32",), ("int32",))}
+    # decided predicate: only the selected case flows through
+    assert _run("select_n", [(0, 0), (3, 5), (70, 90)], **cases) == (3, 5)
+    assert _run("select_n", [(1, 1), (3, 5), (70, 90)], **cases) == (70, 90)
+    # undecided: the join
+    assert _run("select_n", [(0, 1), (3, 5), (70, 90)], **cases) == (3, 90)
+
+
+def test_reductions_and_index_makers():
+    shp = (("int32", (2, 3, 8)),)
+    assert _run("reduce_max", [(0, 9)], ins=shp) == (0, 9)
+    assert _run("reduce_sum", [(0, 9)], ins=shp,
+                params={"axes": (2,)}) == (0, 72)
+    assert _run("reduce_sum", [(-2, 9)], ins=shp,
+                params={"axes": (1, 2)}) == (-48, 216)
+    assert _run("argmax", [(0, 9)], ins=shp,
+                params={"axes": (2,)}) == (0, 7)
+    assert _run("iota", [], params={"dimension": 0, "shape": (8,)}) \
+        == (0, 7)
+    assert _run("concatenate", [(0, 3), (10, 12)],
+                ins=shp + shp) == (0, 12)
+
+
+def test_unmodeled_primitive_returns_none():
+    assert prim_intervals("custom_call", _eqn(), [(0, 1)]) is None
+
+
+# ------------------------------------------------- kernel-level fixpoint --
+def _kernel_of(cls):
+    return build_kernel(lambda _n, *a, **kw: cls(*a, **kw),
+                        cls.name.lower())
+
+
+def test_scan_carry_widening_converges():
+    """A clamped scan carry stabilizes at a widening-ladder threshold —
+    NOT at the dtype top — and the analysis terminates in bounded
+    rounds."""
+    import jax
+    import jax.numpy as jnp
+
+    from summerset_tpu.core.protocol import StepEffects
+
+    class ScanCarry(GoodKernel):
+        name = "FixtureScanCarry"
+
+        def step(self, state, inbox, inputs):
+            s = dict(state)
+            self._fold(s, inbox)
+
+            def body(c, _):
+                return jnp.minimum(c + 1, jnp.int32(7)), None
+
+            c, _ = jax.lax.scan(body, jnp.int32(0), None, length=5)
+            s["exec_bar"] = jnp.minimum(s["commit_bar"], c)
+            return s, self.zero_outbox(), StepEffects(
+                commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+            )
+
+    ra = analyze_kernel_ranges(_kernel_of(ScanCarry))
+    assert ra.invariants["exec_bar"][0] == 0
+    assert ra.invariants["exec_bar"][1] <= 255  # ladder, not 2**31-1
+    assert ra.iterations < 64
+
+
+def test_select_refinement_narrows_the_taken_branch():
+    """``where(x < 5, x, 0)``: inside the taken branch the comparison
+    refines x's interval, so the select's result is [0, 4] even though
+    x itself is unbounded above."""
+    import jax.numpy as jnp
+
+    from summerset_tpu.core.protocol import StepEffects
+
+    class Refined(GoodKernel):
+        name = "FixtureRefined"
+
+        def step(self, state, inbox, inputs):
+            s = dict(state)
+            self._fold(s, inbox)
+            s["exec_bar"] = jnp.where(
+                s["commit_bar"] < 5, s["commit_bar"], 0
+            )
+            return s, self.zero_outbox(), StepEffects(
+                commit_bar=s["commit_bar"], exec_bar=s["exec_bar"]
+            )
+
+    ra = analyze_kernel_ranges(_kernel_of(Refined))
+    assert ra.invariants["commit_bar"] == (0, 2 ** 31 - 1)
+    assert ra.invariants["exec_bar"] == (0, 4)
+
+
+def test_pair_facts_on_aliased_bars():
+    """``exec_bar = commit_bar`` proves BOTH octagon-lite directions;
+    untouched window leaves pin at their init interval."""
+    ra = analyze_kernel_ranges(_kernel_of(GoodKernel))
+    assert ("commit_bar", "exec_bar") in ra.pairs
+    assert ("exec_bar", "commit_bar") in ra.pairs
+    assert ra.invariants["win_val"] == (0, 0)
+    assert ra.invariants["commit_bar"][0] == 0  # nonneg is proven
+
+
+# --------------------------------------------------------------- claims --
+def test_inductive_claim_passes():
+    class Claimed(GoodKernel):
+        name = "FixtureClaimed"
+        RANGE_CLAIMS = (("win_val", 0, 0), ("commit_bar", 0, 2 ** 31 - 1))
+
+    k = _kernel_of(Claimed)
+    assert check_claims(k, analyze_kernel_ranges(k)) == []
+
+
+def test_claim_on_missing_leaf_is_reported():
+    class Ghost(GoodKernel):
+        name = "FixtureGhostClaim"
+        RANGE_CLAIMS = (("no_such_leaf", 0, 1),)
+
+    k = _kernel_of(Ghost)
+    bad = check_claims(k, analyze_kernel_ranges(k))
+    assert [leaf for leaf, _ in bad] == ["no_such_leaf"]
+    assert "not a state leaf" in bad[0][1]
+
+
+def test_verify_pass_serializes_variants_deterministically():
+    res = verify_kernel_ranges(make_fixture, "fixturegood")
+    assert res.ok, res.error or [f.render() for f in res.findings]
+    dev = res.extra["variants"]["device"]
+    assert set(dev) == {"invariants", "pairs", "iterations"}
+    assert dev["invariants"]["win_val"] == [0, 0]
+    res2 = verify_kernel_ranges(make_fixture, "fixturegood")
+    assert res.extra == res2.extra
