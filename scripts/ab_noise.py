@@ -1,0 +1,68 @@
+"""Shared A/B overhead arithmetic with an explicit noise floor.
+
+Every instrumentation-overhead gate in the tree (telemetry lanes,
+flight recorder, graftwatch streaming) compares best-of interleaved
+with/without samples.  Raw best-of deltas on a shared CI box can come
+out NEGATIVE (TRACE.json once committed -1.87% "overhead") — not
+because instrumentation speeds anything up, but because the per-sample
+noise exceeds the true cost.  Committing a negative overhead reads as
+nonsense, and gating on the raw value lets noise mask a real
+regression equally well.
+
+``gated_overhead`` makes the noise explicit: the floor is the larger
+side's best-to-median relative spread (how much the samples of ONE
+variant disagree with themselves).  A raw delta inside the floor is
+indistinguishable from noise and gates as 0.0; a delta above it gates
+at face value.  The raw number and the floor both ride the artifact,
+so "0.0%" is always auditable against what was actually measured.
+"""
+
+from typing import Dict, List
+
+
+def _rel_spread_pct(samples: List[float], lower_is_better: bool) -> float:
+    """Best-to-median spread of one side's samples, as a % of best."""
+    if len(samples) < 2:
+        return 0.0
+    s = sorted(samples)
+    best = s[0] if lower_is_better else s[-1]
+    med = s[len(s) // 2]
+    if not best:
+        return 0.0
+    return abs(med - best) / abs(best) * 100.0
+
+
+def gated_overhead(on: List[float], off: List[float],
+                   mode: str = "time") -> Dict[str, float]:
+    """Overhead of the instrumented (``on``) side vs the bare (``off``)
+    side, noise-gated.
+
+    ``mode="time"``: samples are durations (lower is better, best-of is
+    the min).  ``mode="rate"``: samples are throughputs (higher is
+    better, best-of is the max).  Returns ``overhead_raw_pct`` (signed,
+    exactly what best-of measured), ``noise_floor_pct`` (the larger
+    side's own spread), and ``overhead_pct`` — the number gates assert
+    against: 0.0 when the raw delta is within the floor, the raw value
+    when it genuinely clears it, never negative.
+    """
+    if mode == "time":
+        best_on, best_off = min(on), min(off)
+        raw = (
+            (best_on - best_off) / best_off * 100.0 if best_off else 0.0
+        )
+        floor = max(_rel_spread_pct(on, True), _rel_spread_pct(off, True))
+    elif mode == "rate":
+        best_on, best_off = max(on), max(off)
+        raw = (
+            (best_off - best_on) / best_off * 100.0 if best_off else 0.0
+        )
+        floor = max(_rel_spread_pct(on, False),
+                    _rel_spread_pct(off, False))
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    gated = 0.0 if raw <= floor else raw
+    return {
+        "overhead_raw_pct": round(raw, 2),
+        "noise_floor_pct": round(floor, 2),
+        "overhead_pct": round(max(0.0, gated), 2),
+    }

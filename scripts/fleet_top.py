@@ -1,0 +1,145 @@
+#!/usr/bin/env python3
+"""fleet_top: live graftwatch dashboard over the ctrl plane.
+
+Scrapes the manager's ``watch_series`` ring (one round-trip to the
+manager — no server fan-out; servers stream delta frames on their own
+tick cadence), aligns the per-server frames into fleet windows, and
+renders the last few windows as a text table plus the SLO burn-rate
+status per declared objective.  Wallclock-free: columns are window
+indices (``tick // span_ticks``), not timestamps, so the same scrape
+renders identically anywhere.
+
+Usage:
+    python scripts/fleet_top.py --manager 127.0.0.1:52700          # live
+    python scripts/fleet_top.py --manager 127.0.0.1:52700 --once   # one shot
+
+``--once`` prints a single snapshot and exits 0 (exit 1 if the scrape
+came back empty) — the mode scripts and CI drive.  Without it the
+screen redraws every ``--interval`` seconds until interrupted.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from summerset_tpu.host.graftwatch import (  # noqa: E402
+    DEFAULT_OBJECTIVES, SloPolicy, windows,
+)
+
+# per-window fleet counters worth a column (deltas over the window)
+COUNTER_COLS = (
+    ("req", "api_requests_total"),
+    ("shed", "api_shed"),
+    ("commit", "commits_applied_total"),
+    ("fsync", "wal_appends_total"),
+    ("scan", "scan_served"),
+)
+
+
+def _p99_ms(win: dict, metric: str) -> str:
+    h = win["hists"].get(metric)
+    if h is None or h.count == 0:
+        return "-"
+    return f"{h.quantile(0.99) / 1e3:.1f}"
+
+
+def render(export: dict, n_windows: int, tier=None) -> str:
+    rows = windows(export, tier=tier)
+    lines = []
+    series = export.get("series", [])
+    lines.append(
+        f"graftwatch fleet  series={len(series)} "
+        f"frames={export.get('frames_ingested', 0)} "
+        f"retain={export.get('retain')}"
+    )
+    for s in series:
+        lines.append(
+            f"  sid={s['sid']} tier={s['tier']} group={s['group']} "
+            f"frames={len(s['frames'])}"
+        )
+    if not rows:
+        lines.append("  (no complete windows yet)")
+        return "\n".join(lines)
+
+    shown = rows[-n_windows:]
+    hdr = (
+        f"{'widx':>6} {'sids':>4} "
+        + " ".join(f"{label:>8}" for label, _ in COUNTER_COLS)
+        + f" {'p99ms':>8} {'fsync99':>8}"
+    )
+    lines.append("")
+    lines.append(hdr)
+    for w in shown:
+        vals = " ".join(
+            f"{w['counters'].get(name, 0):>8}"
+            for _, name in COUNTER_COLS
+        )
+        lines.append(
+            f"{w['widx']:>6} {len(w['sids']):>4} {vals} "
+            f"{_p99_ms(w, 'api_request_latency_us'):>8} "
+            f"{_p99_ms(w, 'wal_fsync_us'):>8}"
+        )
+
+    # burn-rate status: replay every aligned window through a fresh
+    # policy so the rendered state is a pure function of the scrape
+    pol = SloPolicy(DEFAULT_OBJECTIVES)
+    for w in rows:
+        pol.observe_window(w)
+    lines.append("")
+    lines.append("SLO burn rates (fast/slow window means, budget=1.0):")
+    status = pol.status()
+    for name in sorted(status):
+        row = status[name]
+        flag = "ALERT" if row.get("alerting") else "ok"
+        lines.append(
+            f"  {name:<16} burn={row.get('burn', 0.0):7.3f} "
+            f"fast={row.get('fast', 0.0):7.3f} "
+            f"slow={row.get('slow', 0.0):7.3f}  {flag}"
+        )
+    if not status:
+        lines.append("  (no windows observed yet)")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--manager", required=True,
+                    help="host:port of the cluster manager cli endpoint")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--windows", type=int, default=8,
+                    help="how many trailing fleet windows to show")
+    ap.add_argument("--tier", default=None,
+                    help="only merge frames from this tier "
+                         "(shard/proxy); default: all")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    args = ap.parse_args()
+
+    from summerset_tpu.client.endpoint import scrape_fleet
+
+    host, port = args.manager.rsplit(":", 1)
+    addr = (host, int(port))
+
+    while True:
+        export = scrape_fleet(addr)
+        if export is None:
+            print("fleet scrape failed (manager unreachable?)")
+            if args.once:
+                return 1
+        else:
+            text = render(export, args.windows, tier=args.tier)
+            if not args.once:
+                # ANSI clear + home: redraw in place like top(1)
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(text, flush=True)
+            if args.once:
+                return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

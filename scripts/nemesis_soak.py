@@ -129,6 +129,29 @@ def fail_bundle_doc(result: dict, plan, runner, ops: list) -> dict:
     }
 
 
+def fleet_summary(manager_addr, tag: str = "") -> None:
+    """graftwatch sidecar: one line per soak cell showing how many
+    fleet windows the ctrl-plane stream captured during the run (and
+    which sids contributed — a faulted replica's tick counter lags, so
+    its missing windows are visible here, not silent).  Print-only:
+    committed soak artifacts are unchanged."""
+    try:
+        from summerset_tpu.client.endpoint import scrape_fleet
+        from summerset_tpu.host.graftwatch import windows
+
+        ex = scrape_fleet(manager_addr)
+        rows = windows(ex) if ex else []
+        if rows:
+            sids = sorted({s for w in rows for s in w["sids"]})
+            print(
+                f"    graftwatch{tag}: {len(rows)} fleet windows "
+                f"(widx {rows[0]['widx']}..{rows[-1]['widx']}, "
+                f"sids {sids})", flush=True,
+            )
+    except Exception:
+        pass  # observability sidecar must never fail a soak cell
+
+
 def run_one(protocol: str, seed: int, args) -> dict:
     from test_cluster import Cluster
 
@@ -226,6 +249,7 @@ def run_one(protocol: str, seed: int, args) -> dict:
         result["server_metrics"] = scrape_metrics(
             cluster.manager_addr, compact=True
         )
+        fleet_summary(cluster.manager_addr)
         result["num_ops"] = len(ops)
         if len(ops) <= args.min_ops:
             result["error"] = f"history too small: {len(ops)}"
@@ -480,6 +504,7 @@ def run_failslow(protocol: str, cls: str, mitigated: bool, args) -> dict:
         stop.set()
         for t in threads:
             t.join(timeout=30)
+        fleet_summary(cluster.manager_addr, tag="[failslow]")
         result["num_ops"] = len(ops)
         if mitigated:
             if result["demotions"] < 1:

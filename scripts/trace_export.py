@@ -742,6 +742,59 @@ def validate_chrome(doc: dict) -> List[str]:
     return errors
 
 
+def validate_dumps(dumps: Dict[Any, dict]) -> List[str]:
+    """Drop-accounting gate over raw flight dumps (empty = valid).
+
+    Schema v2 dumps carry per-type accounting; a v2 dump whose drops
+    don't reconcile is a recorder bug (the exact failure mode the
+    per-type reserve rings exist to rule out: a silent, skewed ring
+    where one chatty event type evicted everything else unreported).
+    Checks per dump: ``recorded_by_type`` / ``dropped_by_type``
+    present, ``sum(recorded_by_type) == count``,
+    ``sum(dropped_by_type) == dropped``, and per type
+    ``recorded - retained == dropped`` against the events actually in
+    the dump.  v1 dumps (pre-accounting) pass untouched so old
+    committed fixtures stay loadable.
+    """
+    errors: List[str] = []
+    for sid, d in sorted(dumps.items(), key=lambda kv: str(kv[0])):
+        if int(d.get("v", 1)) < 2:
+            continue
+        rec = d.get("recorded_by_type")
+        drop = d.get("dropped_by_type")
+        if rec is None or drop is None:
+            errors.append(
+                f"server {sid}: v{d['v']} dump missing per-type "
+                "drop accounting"
+            )
+            continue
+        if sum(rec.values()) != d.get("count", 0):
+            errors.append(
+                f"server {sid}: sum(recorded_by_type)="
+                f"{sum(rec.values())} != count={d.get('count', 0)}"
+            )
+        if sum(drop.values()) != d.get("dropped", 0):
+            errors.append(
+                f"server {sid}: sum(dropped_by_type)="
+                f"{sum(drop.values())} != dropped="
+                f"{d.get('dropped', 0)} — drops unaccounted"
+            )
+        retained: Dict[str, int] = {}
+        for ev in _events(d):
+            t = ev["type"]
+            retained[t] = retained.get(t, 0) + 1
+        for t in sorted(set(rec) | set(retained) | set(drop)):
+            want = rec.get(t, 0) - retained.get(t, 0)
+            got = drop.get(t, 0)
+            if want != got:
+                errors.append(
+                    f"server {sid}: type {t!r} recorded "
+                    f"{rec.get(t, 0)} retained {retained.get(t, 0)} "
+                    f"=> expected {want} dropped, accounting says {got}"
+                )
+    return errors
+
+
 # ----------------------------------------------------------------- CLI --
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -797,7 +850,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                 d["dropped"] = (
                     d.get("count", len(evs)) - len(d["events"])
                 )
+                # v2 dumps account drops per type — the trim must keep
+                # that ledger balanced or validate_dumps below flags
+                # the trimmed doc itself as a recorder bug
+                if int(d.get("v", 1)) >= 2 and "recorded_by_type" in d:
+                    retained: dict = {}
+                    for ev in d["events"]:
+                        t = ev["type"]
+                        retained[t] = retained.get(t, 0) + 1
+                    d["dropped_by_type"] = {
+                        t: n - retained.get(t, 0)
+                        for t, n in sorted(
+                            d["recorded_by_type"].items()
+                        )
+                        if n - retained.get(t, 0) > 0
+                    }
 
+    acct_errors = validate_dumps(dumps)
     pairs = paired_frames(dumps)  # once; export reuses it
     doc = export_chrome(dumps, align=not args.no_align, pairs=pairs,
                         phase_profile=phase_profile)
@@ -808,9 +877,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     n_ev = len(doc["traceEvents"])
     print(f"wrote {args.out}: {n_ev} events, {len(chains)} connected "
           f"request chain(s), {len(pairs)} paired frame(s)")
+    for e in acct_errors[:20]:
+        print(f"DROPS {e}")
     for e in errors[:20]:
         print(f"SCHEMA {e}")
-    return 1 if errors else 0
+    return 1 if (errors or acct_errors) else 0
 
 
 if __name__ == "__main__":

@@ -95,6 +95,8 @@ def overhead_gate(cluster, ep, pairs: int, window: float,
     Best-of is monotone in the window count, so extra pairs can only
     RESCUE a spurious failure — a true regression's on-side max stays
     low no matter how many windows run, and still fails the gate."""
+    from ab_noise import gated_overhead
+
     on, off = [], []
     i = 0
     while True:
@@ -104,11 +106,13 @@ def overhead_gate(cluster, ep, pairs: int, window: float,
         off.append(_bench_window(ep, window, seed=2 * i + 1))
         i += 1
         best_on, best_off = max(on), max(off)
-        overhead = (
-            (best_off - best_on) / best_off * 100.0
-            if best_off > 0 else 0.0
-        )
-        if i >= pairs and (overhead <= max_pct or i >= max_pairs):
+        # the gate asserts the noise-gated overhead: the raw best-of
+        # delta here used to come out negative on lucky on-sides, and
+        # committing that as "overhead" reads as nonsense
+        ov = gated_overhead(on, off, mode="rate")
+        if i >= pairs and (
+            ov["overhead_pct"] <= max_pct or i >= max_pairs
+        ):
             break
     _set_recorders(cluster, True)
     return {
@@ -118,7 +122,7 @@ def overhead_gate(cluster, ep, pairs: int, window: float,
         "ops_s_off": [round(r, 1) for r in off],
         "best_on": round(best_on, 1),
         "best_off": round(best_off, 1),
-        "overhead_pct": round(overhead, 2),
+        **ov,
     }
 
 
@@ -192,6 +196,14 @@ def main() -> None:
             with open(profile_path) as f:
                 phase_profile = json.load(f)
 
+        # drop accounting must be self-consistent per dump (schema v2:
+        # sum of dropped_by_type == dropped) before anything downstream
+        # trusts the per-type counts
+        acct_errors = trace_export.validate_dumps(dumps)
+        assert not acct_errors, (
+            f"drop accounting violations: {acct_errors[:10]}"
+        )
+
         pairs = trace_export.paired_frames(dumps)  # once; export reuses
         doc = trace_export.export_chrome(dumps, pairs=pairs,
                                          phase_profile=phase_profile)
@@ -247,6 +259,10 @@ def main() -> None:
             "events_by_type": dict(sorted(by_type.items())),
             "dropped": {
                 sid: d.get("dropped", 0)
+                for sid, d in sorted(dumps.items())
+            },
+            "dropped_by_type": {
+                sid: dict(sorted(d.get("dropped_by_type", {}).items()))
                 for sid, d in sorted(dumps.items())
             },
         }

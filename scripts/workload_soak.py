@@ -541,6 +541,8 @@ def run_one(protocol: str, wl_class: str, seed: int, fault_seed,
         result["shed"], result["held"] = shed, held
         # server-side shed accounting: the api_shed counters must agree
         # that shedding happened (scraped full, committed trimmed)
+        from nemesis_soak import fleet_summary
+        fleet_summary(cluster.manager_addr)
         full = scrape_metrics(cluster.manager_addr)
         api_shed = {}
         for sid, snap in (full or {}).items():

@@ -16,6 +16,7 @@ import time
 from typing import List, Optional, Tuple
 
 from ..host.statemach import Command
+from ..host.telemetry import Histogram
 from ..utils.logging import pf_info, pf_logger
 from .drivers import DriverClosedLoop, DriverOpenLoop
 from .endpoint import GenericEndpoint
@@ -153,7 +154,14 @@ class ClientBench:
         t_start = time.monotonic()
         issued = acked = 0
         lats: List[float] = []
-        int_acked, int_lats = 0, []
+        # interval stats ride a cumulative exponential histogram and
+        # its since() window view (host/telemetry.py) instead of a
+        # per-interval sample list: a long soak's interval lines cost
+        # O(1) memory, while the exact end-of-run summary still sorts
+        # the full `lats` list
+        lat_hist = Histogram()
+        int_prev = lat_hist.copy()
+        int_acked = 0
         t_int = t_start
         pace = 1.0 / self.freq if self.freq > 0 else 0.0
         t_next = t_start
@@ -173,18 +181,21 @@ class ClientBench:
                 acked += 1
                 int_acked += 1
                 lats.append(rep.latency)
-                int_lats.append(rep.latency)
+                lat_hist.observe(int(rep.latency * 1e6))
             if now - t_int >= self.interval:
                 dt = now - t_int
                 tput = int_acked / dt
-                p50, p99 = _pctiles(int_lats)
+                win = lat_hist.since(int_prev)
+                p50 = win.quantile(0.50) / 1e6
+                p99 = win.quantile(0.99) / 1e6
                 pf_info(
                     logger,
                     f"tput {tput:10.2f} reqs/s  "
                     f"lat p50 {p50 * 1e3:7.3f} p99 {p99 * 1e3:7.3f} ms",
                 )
                 t_int = now
-                int_acked, int_lats = 0, []
+                int_acked = 0
+                int_prev = lat_hist.copy()
 
         # drain stragglers briefly
         t_end = time.monotonic() + 1.0

@@ -97,6 +97,21 @@ def scrape_flight(manager_addr: Tuple[str, int],
     return {} if out is None else out
 
 
+def scrape_fleet(manager_addr: Tuple[str, int],
+                 timeout: float = 15.0) -> Optional[dict]:
+    """One-shot graftwatch scrape: ``watch_series`` through the manager,
+    returning the FleetSeries export (``{"v", "retain", "series": [...]}``)
+    or ``None`` when the manager is unreachable.  Answered from the
+    manager's own ring — no server fan-out, so it stays cheap enough
+    for a dashboard to poll every second (``scripts/fleet_top.py``)."""
+    out = _ctrl_scrape(
+        manager_addr, CtrlRequest("watch_series"), timeout
+    )
+    if out is None:
+        return None
+    return out.get("fleet")
+
+
 class ClientCtrlStub:
     def __init__(self, manager_addr: Tuple[str, int]):
         self.sock = socket.create_connection(manager_addr, timeout=15)

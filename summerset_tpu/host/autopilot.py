@@ -94,6 +94,20 @@ ACTUATORS = (
 #: conf_resize actuator is a no-op elsewhere)
 LEASE_PROTOCOLS = ("quorumleases", "bodega")
 
+#: graftwatch SLO objective -> actuator lowering: a latched burn-rate
+#: alert that persists a full streak drives the EXISTING actuators
+#: through the same admission/budget/fire path as every other signal.
+#: Latency and shed burns escalate the batch ladder; a WAL-fsync burn
+#: indicts the leader's disk (the fail-slow demote path); a scan-
+#: starvation burn has no live knob — it lowers as a log-only
+#: recommendation (route scans to the learner tier).
+SLO_ACTUATORS = {
+    "reply_p99": "batch",
+    "shed_rate": "batch",
+    "wal_fsync_lag": "lead_move",
+    "scan_starvation": "recommend",
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class Decision:
@@ -205,6 +219,12 @@ class AutopilotPolicy:
         self._batch_base: Optional[int] = None
         self._recommended = False
         self.last_quorum = False
+        # graftwatch burn-alert streaks, per objective name.  Kept OFF
+        # config_line(): a policy evaluated without slo_burn senses
+        # renders and digests byte-identically to one built before the
+        # graftwatch plane existed (the committed AUTOPILOT.json drift
+        # gate regenerates digests from this code)
+        self._slo_streaks: Dict[str, int] = {}
 
     # ------------------------------------------------------- admission
     def _admit(self, actuator: str, group: int) -> bool:
@@ -291,6 +311,7 @@ class AutopilotPolicy:
         out.extend(self._eval_conf_resize(senses, leader, ingress))
         out.extend(self._eval_reshard(senses))
         out.extend(self._eval_recommend(senses, cur_batch))
+        out.extend(self._eval_slo_burn(senses, leader))
         return out
 
     # ------------------------------------------------- actuator rules
@@ -492,6 +513,67 @@ class AutopilotPolicy:
         self._decisions.append(d)
         return [d]
 
+    def _eval_slo_burn(self, senses: Dict[str, Any],
+                       leader: int) -> List[Decision]:
+        """graftwatch burn-rate alerts as a sense input: each LATCHED
+        alert (fast AND slow burn over the policy's hi bound —
+        host/graftwatch.py SloPolicy) must persist a full streak of
+        rounds, then lowers through :data:`SLO_ACTUATORS` under the
+        same admission gates as every native signal.  INERT without
+        the ``slo_burn`` sense key: no streak state moves, no RNG
+        draw happens, so a driver without a graftwatch attachment
+        evaluates byte-identically to pre-graftwatch code."""
+        burns = senses.get("slo_burn")
+        if not burns:
+            return []
+        out: List[Decision] = []
+        cur_batch = int(senses.get("api_max_batch", 0) or 0)
+        for name in sorted(burns):
+            row = burns[name] or {}
+            streak = (
+                self._slo_streaks.get(name, 0) + 1
+                if row.get("alerting") else 0
+            )
+            self._slo_streaks[name] = streak
+            if streak < self.streak_need:
+                continue
+            actuator = SLO_ACTUATORS.get(name)
+            reason = (f"slo:{name} fast={row.get('fast')} "
+                      f"slow={row.get('slow')}")
+            if actuator == "batch":
+                if not cur_batch or cur_batch >= self.batch_max \
+                        or not self._admit("batch", 0):
+                    continue
+                self._slo_streaks[name] = 0
+                arg = min(cur_batch * 2, self.batch_max)
+                out.append(self._fire("batch", 0, None, arg, reason))
+                cur_batch = arg
+            elif actuator == "lead_move":
+                if not self._admit("lead_move", 0):
+                    continue
+                self._slo_streaks[name] = 0
+                # successor deliberately unspecified (no RNG draw —
+                # the kernel's own election decides): the signal is
+                # "this leader's durability path is burning budget",
+                # not a placement preference
+                out.append(self._fire(
+                    "lead_move", 0, leader, None, reason
+                ))
+            elif actuator == "recommend":
+                if self._recommended:
+                    continue
+                self._slo_streaks[name] = 0
+                self._recommended = True
+                st = self._acts["recommend"]
+                st.fires += 1
+                d = Decision(
+                    self._round, "recommend", 0, None,
+                    {"scan_tier": "learner"}, reason,
+                )
+                self._decisions.append(d)
+                out.append(d)
+        return out
+
     # -------------------------------------------------- decision trace
     def decisions(self) -> List[Decision]:
         return list(self._decisions)
@@ -626,6 +708,7 @@ class AutopilotDriver:
         conf_ctl: Optional[Callable[[List[int]], Any]] = None,
         proxy_ctl: Optional[Callable[[Any], Any]] = None,
         sense_fn: Optional[Callable[[], Optional[dict]]] = None,
+        slo_policy: Optional[Any] = None,
     ):
         if mode not in ("observe", "act"):
             raise ValueError(f"unknown autopilot mode {mode!r}")
@@ -638,6 +721,13 @@ class AutopilotDriver:
         self.conf_ctl = conf_ctl
         self.proxy_ctl = proxy_ctl
         self._sense_fn = sense_fn
+        # graftwatch attachment (host/graftwatch.py SloPolicy): when
+        # given, each scrape also pulls the manager's fleet series
+        # (watch_series — a read-only request, so observe mode stays
+        # mutation-free), folds any NEW windows through the policy, and
+        # feeds the latched burn verdicts as senses["slo_burn"]
+        self.slo_policy = slo_policy
+        self._slo_widx = -1
         self._prev: Optional[dict] = None
         self._stub = None
         #: rendered ctrl mutations actually SENT (empty in observe mode
@@ -683,7 +773,36 @@ class AutopilotDriver:
             return None
         snaps = scrape_metrics(self.manager_addr, timeout=self.timeout)
         senses, self._prev = build_senses(snaps, info, self._prev)
+        if self.slo_policy is not None:
+            burn = self._scrape_burn()
+            if burn:
+                senses["slo_burn"] = burn
         return senses
+
+    def _scrape_burn(self) -> Optional[dict]:
+        """Pull the fleet series and fold NEW windows (widx strictly
+        beyond the last observed one) through the attached SloPolicy;
+        return its latched status.  Windows still in flight next scrape
+        are re-merged then — only completed indices are consumed, so
+        one window is never double-counted."""
+        from .graftwatch import windows
+
+        rep = self._request(CtrlRequest("watch_series"))
+        export = (getattr(rep, "payloads", None) or {}).get("fleet") \
+            if rep is not None else None
+        if not export:
+            return None
+        fresh = [
+            w for w in windows(export) if w["widx"] > self._slo_widx
+        ]
+        # the newest widx may still be accumulating frames; hold it
+        # back one scrape so a partial window can't fake a burn dip
+        if fresh:
+            fresh = fresh[:-1]
+        for w in fresh:
+            self.slo_policy.observe_window(w)
+            self._slo_widx = w["widx"]
+        return self.slo_policy.status() or None
 
     # ------------------------------------------------------------ loop
     def step(self) -> List[Decision]:

@@ -137,6 +137,11 @@ class CtrlMsg:
     #     "announce") + actuator fields (reason / api_max_batch /
     #     pipeline / mode / cooldowns) — the autopilot driver's
     #     actuation fan-out (host/autopilot.py)
+    #   watch_frame: one graftwatch delta frame (host/graftwatch.py
+    #     WatchEmitter.frame — sid/tier/group/widx + counter deltas,
+    #     gauge values, histogram window snapshots), server -> manager
+    #     one-way on the watch cadence; clusman ingests it into the
+    #     FleetSeries ring, no reply
     #   leave / leave_reply
     payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -154,6 +159,9 @@ class CtrlRequest:
     #            | autopilot_ctl (payload: act + actuator fields,
     #              relayed verbatim to target servers; the autopilot
     #              driver's actuation plane — host/autopilot.py)
+    #            | watch_series (graftwatch: the manager's FleetSeries
+    #              export — answered locally from the ring, no server
+    #              fan-out; reply carries payloads={"fleet": export})
     servers: Optional[List[int]] = None  # None = all
     durable: bool = True                 # reset: keep durable files?
     payload: Optional[Dict[str, Any]] = None  # inject_faults: fault spec

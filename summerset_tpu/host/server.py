@@ -56,6 +56,7 @@ from ..utils.logging import pf_info, pf_logger, pf_warn
 from .codeword import assigned_sids
 from .control import ControlHub
 from .external import ExternalApi
+from .graftwatch import WatchEmitter
 from .health import HealthScorer
 from .messages import ApiReply, ApiRequest, CtrlMsg, ShardPayload
 from .payload import PayloadStore
@@ -268,6 +269,14 @@ class ServerReplica:
             self.metrics, sample_every=int(cfg.pop("trace_sample", 8)),
             flight=self.flight,
         )
+        # graftwatch streaming (host/graftwatch.py): every watch_ticks
+        # ticks the replica ships one delta frame (counter deltas, gauge
+        # values, histogram window snapshots) over the ctrl connection
+        # as a one-way watch_frame; graftwatch=0 compiles the emitter
+        # out entirely — the streaming-OFF ablation variant
+        self.graftwatch = bool(cfg.pop("graftwatch", True))
+        self.watch_ticks = max(1, int(cfg.pop("watch_ticks", 50)))
+        self.watch: Optional[WatchEmitter] = None
         self._trace_replied: List[Tuple[int, int]] = []
         # gray-failure plane (host/health.py): the quorum-median outlier
         # scorer over signals the hubs already emit.  health_enabled
@@ -313,6 +322,11 @@ class ServerReplica:
         self.me = self.ctrl.me
         self.population = self.ctrl.population
         self.flight.me = self.me
+        if self.graftwatch:
+            self.watch = WatchEmitter(
+                self.metrics, self.me, span_ticks=self.watch_ticks,
+                tier="shard", group=0,
+            )
 
         # gray-failure scorer (host/health.py): beacons ride the tick
         # frames, every replica assembles the same signal table, and the
@@ -358,6 +372,11 @@ class ServerReplica:
         self.metrics.counter_add("autopilot_actions", 0)
         self.metrics.gauge_set("autopilot_mode", 0.0)
         self.metrics.gauge_set("autopilot_cooldown", 0.0)
+        # graftscope ring accounting + graftwatch streaming: zero until
+        # the ring actually overwrites / the first frame ships
+        self.metrics.counter_add("trace_dropped_total", 0)
+        self.metrics.counter_add("watch_frames_total", 0)
+        self.metrics.observe("watch_emit_us", 0)
 
         # protocol kernel over [G, R]; host applier drives the exec bar
         kercfg_cls = type(
@@ -2623,6 +2642,17 @@ class ServerReplica:
             self.ctrl.send_ctrl(CtrlMsg(
                 "snapshot_up_to", {"new_start": list(self.applied)}
             ))
+        if self.watch is not None and self.tick % self.watch_ticks == 0:
+            # graftwatch delta frame on the watch cadence: built from
+            # one export_raw diff, shipped one-way over the existing
+            # ctrl connection (never blocks the tick on a reply)
+            t_emit = time.monotonic()
+            frame = self.watch.frame(self.tick)
+            self.ctrl.send_ctrl(CtrlMsg("watch_frame", frame))
+            self.metrics.counter_add("watch_frames_total", 1)
+            self.metrics.observe_s(
+                "watch_emit_us", time.monotonic() - t_emit
+            )
 
         now = time.monotonic()
         rem = deadline - now
@@ -3708,6 +3738,10 @@ class ServerReplica:
         )
         for k, n in self._range_heat.top(8):
             self.metrics.gauge_set("range_heat", float(n), key=k)
+        # graftscope ring accounting: mirror per-type drop counts into
+        # trace_dropped_total{type=...} (scrape-time, never the record
+        # hot path)
+        self.flight.publish_drops(self.metrics)
         return {
             "me": self.me,
             "protocol": self.protocol,

@@ -107,6 +107,63 @@ class Histogram:
         out.buckets = list(self.buckets)
         return out
 
+    def merge(self, other: Optional["Histogram"]) -> "Histogram":
+        """Fold ``other``'s samples into this histogram in place (and
+        return self).  The inverse of :meth:`since`:
+        ``prev.copy().merge(cur.since(prev))`` reproduces ``cur``'s
+        count/sum/buckets exactly — the delta-snapshot round-trip the
+        graftwatch stream relies on.  min/max combine conservatively
+        (a window inherits cumulative extremes, so merging a window
+        back never widens them wrongly)."""
+        if other is None or other.count == 0 and other.total == 0 and \
+                not any(other.buckets):
+            return self
+        self.count += other.count
+        self.total += other.total
+        if other.vmin is not None:
+            self.vmin = other.vmin if self.vmin is None \
+                else min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+        for i, n in enumerate(other.buckets):
+            if n:
+                self.buckets[i] += n
+        return self
+
+    def frac_over(self, threshold: int) -> float:
+        """Fraction of samples strictly above ``threshold`` — the
+        "error rate" an SLO burn computes from a latency window
+        (budget = 1 - objective quantile).  Interpolates inside the
+        bucket the threshold lands in, consistent with
+        :meth:`quantile`'s uniform-within-bucket model."""
+        if self.count <= 0:
+            return 0.0
+        t = max(0, int(threshold))
+        over = 0.0
+        for i, n in enumerate(self.buckets):
+            if n == 0:
+                continue
+            lo = 0 if i == 0 else 1 << (i - 1)
+            hi = (1 << i) - 1
+            if t < lo:
+                over += n
+            elif t < hi:
+                over += n * (hi - t) / (hi - lo)
+        return min(1.0, max(0.0, over / self.count))
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any]) -> "Histogram":
+        """Rebuild a histogram from :meth:`snapshot`'s sparse form (the
+        shape that rides wire frames and committed artifacts).  JSON
+        round-trips stringify bucket indices, so keys may be str."""
+        out = cls()
+        out.count = int(snap.get("count", 0))
+        out.total = int(snap.get("sum", 0))
+        out.vmin = None if out.count == 0 else int(snap.get("min", 0))
+        out.vmax = int(snap.get("max", 0))
+        for i, n in (snap.get("buckets") or {}).items():
+            out.buckets[int(i)] = int(n)
+        return out
+
     def snapshot(self) -> Dict[str, Any]:
         return {
             "count": self.count,
@@ -171,6 +228,19 @@ class MetricsRegistry:
                 list(self._counters) + list(self._gauges) + list(self._hists)
             )
         return {k.split("{", 1)[0] for k in keys}
+
+    def export_raw(self) -> Tuple[Dict[str, int], Dict[str, float],
+                                  Dict[str, Histogram]]:
+        """One consistent point-in-time copy of every series (counters,
+        gauges, histogram COPIES) under a single lock hold — the
+        graftwatch emitter diffs two of these to build a delta frame.
+        Copies are cheap (a histogram is 64 ints); the caller owns them."""
+        with self._lock:
+            return (
+                dict(self._counters),
+                dict(self._gauges),
+                {k: h.copy() for k, h in self._hists.items()},
+            )
 
     def snapshot(self) -> Dict[str, Any]:
         """Deterministic JSON-able dump: same recorded ops -> same dict."""
@@ -354,6 +424,16 @@ DECLARED = (
     "autopilot_actions",
     "autopilot_mode",
     "autopilot_cooldown",
+    # graftscope ring accounting (host/tracing.py): events the flight
+    # ring overwrote, labeled by event type — pre-registered bare at
+    # zero so "nothing dropped" reads as 0, not a missing series; the
+    # labeled series appear per type once overflow actually happens
+    "trace_dropped_total",
+    # graftwatch streaming (host/graftwatch.py): delta frames emitted
+    # over the ctrl plane, and the per-emit build cost — pre-registered
+    # so streaming-off runs read as zero series, not missing ones
+    "watch_frames_total",
+    "watch_emit_us",
 )
 
 # canonical metric names every INGRESS PROXY (host/ingress.py) must

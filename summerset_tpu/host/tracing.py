@@ -36,6 +36,19 @@ truncated view is always visible as truncated.  Stamps are
 ``time.monotonic()`` microseconds — never wallclock, which can jump and
 reorder spans (graftlint H103 enforces this for the whole module).
 
+Drop accounting is PER TYPE (schema v2): a leader's ring is dominated
+by high-rate types (frame_tx/rx, tick), which used to silently evict
+every rare-but-load-bearing event (demote, range_seal, crash) — TRACE.json
+showed sid 0 dropping 27k events while its peers dropped none, with no
+way to tell WHAT was lost.  Now every type keeps a small reserve ring
+beside the main one (union-deduped at dump time), so a burst of frames
+can no longer wash out the last demotion, and every dump carries
+``recorded_by_type`` + ``dropped_by_type`` with the invariant
+``sum(dropped_by_type.values()) == dropped``.  ``publish_drops``
+mirrors the per-type drop counts into ``trace_dropped_total{type=...}``
+registry counters at scrape time, and ``scripts/trace_export.py``
+fails its schema check when a v2 dump's drops are unaccounted.
+
 Dumps travel the ctrl plane: ``CtrlRequest("flight_dump")`` fans out and
 gathers ``{sid: dump}`` exactly like ``metrics_dump``; NemesisRunner
 failure repro bundles and the test_cluster supervisor's crash reports
@@ -119,7 +132,7 @@ EVENT_TYPES = (
 )
 _EVENT_SET = frozenset(EVENT_TYPES)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 
 class FlightRecorder:
@@ -127,17 +140,32 @@ class FlightRecorder:
 
     ``enabled=False`` turns every ``record`` into one attribute read —
     the recorder-off variant the tier-2f overhead gate compares against.
-    ``capacity`` bounds memory AND dump size; overflow drops oldest.
+    ``capacity`` bounds memory AND dump size; overflow drops oldest —
+    but each event type additionally keeps ``reserve_per_type`` newest
+    events of its own in a side ring, so rare types survive a flood of
+    hot ones.  A dump is the seq-ordered union (main ∪ reserves,
+    deduped), which for a single-type stream is exactly the main ring.
     """
 
     def __init__(self, capacity: int = 8192, enabled: bool = True,
-                 me: int = -1):
+                 me: int = -1, reserve_per_type: Optional[int] = None):
         self.capacity = max(16, int(capacity))
         self.enabled = bool(enabled)
         self.me = me
+        self.reserve_per_type = (
+            max(8, self.capacity // 64) if reserve_per_type is None
+            else max(1, int(reserve_per_type))
+        )
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=self.capacity)
         self._seq = 0  # events ever recorded (>= len(_buf))
+        # per-type reservations + lifetime counts (schema v2 accounting)
+        self._reserve: Dict[str, deque] = {}
+        self._recorded: Dict[str, int] = {}
+        # publish_drops cursor: per-type drops already mirrored into the
+        # registry (drop counts are monotone — an evicted event never
+        # returns — so the delta is always >= 0)
+        self._published: Dict[str, int] = {}
         # incarnation floor: a crash-restarted server gets a FRESH
         # recorder (and restarts its tick counter, reusing wire seqs),
         # so the exporter uses this birth stamp to refuse pairing the
@@ -158,7 +186,15 @@ class FlightRecorder:
             # writer append behind a later-stamped peer, breaking the
             # ring's oldest-first stamp order that dumps/tails rely on
             t_us = int(time.monotonic() * 1e6)
-            self._buf.append((self._seq, t_us, etype, fields))
+            ev = (self._seq, t_us, etype, fields)
+            self._buf.append(ev)
+            res = self._reserve.get(etype)
+            if res is None:
+                res = self._reserve[etype] = deque(
+                    maxlen=self.reserve_per_type
+                )
+            res.append(ev)
+            self._recorded[etype] = self._recorded.get(etype, 0) + 1
             self._seq += 1
 
     # -- read side -----------------------------------------------------------
@@ -169,16 +205,40 @@ class FlightRecorder:
         with self._lock:
             events = list(self._buf)
             total = self._seq
+            recorded = dict(self._recorded)
+            reserves = [list(r) for r in self._reserve.values()]
+        # union the per-type reserves in (dedup by ring seq): a rare
+        # type washed out of the main ring survives in its reserve, so
+        # the dump keeps at least the newest few of EVERY type
+        seen = {ev[0] for ev in events}
+        extra = [
+            ev for ring in reserves for ev in ring if ev[0] not in seen
+        ]
+        if extra:
+            events = sorted(events + extra)
         if last_n is not None:
             n = int(last_n)
             # n <= 0 means "metadata only" (events[-0:] would be ALL)
             events = events[-n:] if n > 0 else []
+        retained: Dict[str, int] = {}
+        for ev in events:
+            retained[ev[2]] = retained.get(ev[2], 0) + 1
+        # invariant: sum(dropped_by_type.values()) == dropped — every
+        # recorded event has exactly one type, so the per-type ledger
+        # always reconciles against the scalar drop count
+        dropped_by_type = {
+            t: recorded[t] - retained.get(t, 0)
+            for t in sorted(recorded)
+            if recorded[t] - retained.get(t, 0) > 0
+        }
         return {
             "v": SCHEMA_VERSION,
             "me": self.me,
             "t_start_us": self._t_start_us,
             "count": total,
             "dropped": total - len(events),
+            "recorded_by_type": {t: recorded[t] for t in sorted(recorded)},
+            "dropped_by_type": dropped_by_type,
             "t_dump_us": int(time.monotonic() * 1e6),
             # "n" is the ring's own event counter ("seq" stays free for
             # the frame events' wire sequence field)
@@ -187,6 +247,35 @@ class FlightRecorder:
                 for seq, t_us, etype, fields in events
             ],
         }
+
+    def publish_drops(self, registry) -> None:
+        """Mirror per-type drop counts into the metrics registry as
+        ``trace_dropped_total{type=...}`` counters (scrape-time path —
+        called from ``metrics_snapshot``, never the record hot path).
+        Only NEW drops since the last publish are added, so repeated
+        scrapes don't double-count.  Drops here are main-ring evictions
+        net of reserve survival — the events a dump can no longer show."""
+        with self._lock:
+            retained: Dict[str, int] = {}
+            for ev in self._buf:
+                retained[ev[2]] = retained.get(ev[2], 0) + 1
+            # reserve events absent from the main ring still ride
+            # dumps, so they count as retained, not dropped
+            reserve_extra: Dict[str, int] = {}
+            main_seqs = {ev[0] for ev in self._buf}
+            for t, ring in self._reserve.items():
+                reserve_extra[t] = sum(
+                    1 for ev in ring if ev[0] not in main_seqs
+                )
+            deltas = []
+            for t, rec in self._recorded.items():
+                dropped = rec - retained.get(t, 0) - reserve_extra.get(t, 0)
+                new = dropped - self._published.get(t, 0)
+                if new > 0:
+                    self._published[t] = dropped
+                    deltas.append((t, new))
+        for t, new in deltas:
+            registry.counter_add("trace_dropped_total", new, type=t)
 
     def tail(self, n: int = 64) -> List[str]:
         """The last ``n`` events rendered one per line — the

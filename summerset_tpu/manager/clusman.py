@@ -17,6 +17,7 @@ import dataclasses
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..host.graftwatch import FleetSeries
 from ..host.messages import CtrlMsg, CtrlReply, CtrlRequest
 from ..host.resharding import RangeChange
 from ..utils.errors import SummersetError
@@ -103,6 +104,10 @@ class ClusterManager:
         # leader re-asks and gets ok=True again), not the TTL.
         self._ranges_expired: Dict[int, dict] = {}
         self._adopt_granted: set = set()
+        # graftwatch (host/graftwatch.py): the fleet time-series ring —
+        # servers stream one-way watch_frame deltas on their tick
+        # cadence; clients read the aligned ring via watch_series
+        self.fleet = FleetSeries(retain=256)
         # kind -> list of waiter queues: every waiter sees every reply of
         # that kind (and filters by sid), so concurrent ctrl clients can't
         # steal each other's acks
@@ -330,6 +335,11 @@ class ClusterManager:
                 logger,
                 f"server {conn.sid} snapshot up to {p.get('new_start')}",
             )
+        elif msg.kind == "watch_frame":
+            # graftwatch delta frame: one-way ingest into the fleet
+            # time-series ring (no reply — the server's tick loop never
+            # blocks on the manager)
+            self.fleet.ingest(conn.sid, p)
         elif msg.kind in (
             "pause_reply", "resume_reply", "reset_reply", "snapshot_reply",
             "fault_reply", "metrics_reply", "flight_reply", "range_reply",
@@ -673,6 +683,13 @@ class ClusterManager:
             # recorder ring (payload relays e.g. {"last_n": n})
             return await self._fanout_wait(
                 "flight_dump", "flight_reply", req, extra=req.payload
+            )
+        if req.kind == "watch_series":
+            # graftwatch: answered straight from the manager's fleet
+            # ring — no server fan-out, so a limping replica can't
+            # stall the dashboard (its STALE frames are the signal)
+            return CtrlReply(
+                "watch_series", payloads={"fleet": self.fleet.export()}
             )
         return CtrlReply("unknown")
 

@@ -279,6 +279,81 @@ class TestDriver:
                    for r in ctrl.mutating())
 
 
+def _burn_row(alerting=True, fast=5.0, slow=5.0):
+    return {"burn": fast, "fast": fast, "slow": slow,
+            "alerting": alerting}
+
+
+class TestSloBurnSense:
+    """graftwatch burn alerts as an autopilot sense: inert when the
+    sense key is absent (pre-graftwatch byte-identity), and each
+    latched objective lowers through SLO_ACTUATORS under the same
+    streak/admission gates as native signals."""
+
+    def test_absent_sense_key_is_inert(self):
+        a, b = pol(seed=42), pol(seed=42)
+        seq = (
+            [base_senses()] * 3
+            + [base_senses(shed_rate=0.4)] * 6
+            + [base_senses()] * 4
+        )
+        for s in seq:
+            a.evaluate(dict(s))
+        for s in seq:
+            # a non-alerting burn payload must change nothing either
+            s = dict(s)
+            s["slo_burn"] = {"reply_p99": _burn_row(alerting=False)}
+            b.evaluate(s)
+        assert a.timeline() == b.timeline()
+        assert a.digest() == b.digest()
+        assert a.config_digest() == b.config_digest()
+
+    def test_reply_burn_streak_escalates_batch(self):
+        p = pol()
+        fired = []
+        for _ in range(5):
+            fired += p.evaluate(base_senses(
+                slo_burn={"reply_p99": _burn_row()},
+            ))
+        batch = [d for d in fired if d.actuator == "batch"]
+        assert len(batch) == 1
+        assert batch[0].arg == 4  # 2 -> 4 on the doubling ladder
+        assert batch[0].reason.startswith("slo:reply_p99")
+
+    def test_flapping_alert_never_fires(self):
+        p = pol()
+        fired = []
+        for i in range(30):
+            fired += p.evaluate(base_senses(
+                slo_burn={"reply_p99": _burn_row(alerting=i % 2 == 0)},
+            ))
+        assert fired == []  # latch must PERSIST a full streak
+
+    def test_wal_burn_demotes_the_leader(self):
+        p = pol()
+        fired = []
+        for _ in range(5):
+            fired += p.evaluate(base_senses(
+                slo_burn={"wal_fsync_lag": _burn_row()},
+            ))
+        moves = [d for d in fired if d.actuator == "lead_move"]
+        assert len(moves) == 1
+        assert moves[0].target == 0   # the sensed leader
+        assert moves[0].arg is None   # successor left to the kernel
+        assert moves[0].reason.startswith("slo:wal_fsync_lag")
+
+    def test_scan_burn_recommends_once_ever(self):
+        p = pol(cooldown_rounds=0)
+        fired = []
+        for _ in range(20):
+            fired += p.evaluate(base_senses(
+                slo_burn={"scan_starvation": _burn_row()},
+            ))
+        recs = [d for d in fired if d.actuator == "recommend"]
+        assert len(recs) == 1
+        assert recs[0].arg == {"scan_tier": "learner"}
+
+
 class TestBuildSenses:
     def _snap(self, sid, req=0, shed=0, heat=(), score=1.0, batch=4):
         gauges = {"health_score": score, "api_queue_depth": 0.0}

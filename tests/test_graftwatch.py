@@ -1,0 +1,240 @@
+"""graftwatch tests: the server-side delta-frame emitter (losslessness
+of the counter/histogram stream), the manager-side fleet ring
+(ingest/retention/export determinism), fleet-window alignment (merge
+semantics, partial windows, tier filtering), and the multi-window SLO
+burn-rate policy (latch/clear hysteresis, ratio and quantile
+objectives, pure-fold re-derivation).
+"""
+
+import json
+
+from summerset_tpu.host.graftwatch import (
+    DEFAULT_OBJECTIVES,
+    FleetSeries,
+    SloPolicy,
+    WatchEmitter,
+    base_name,
+    evaluate_series,
+    windows,
+)
+from summerset_tpu.host.telemetry import Histogram, MetricsRegistry
+
+
+def _mk_emitter(me=0, span=10, **kw):
+    reg = MetricsRegistry()
+    return reg, WatchEmitter(reg, me=me, span_ticks=span, **kw)
+
+
+# ------------------------------------------------------------- emitter ----
+class TestWatchEmitter:
+    def test_first_frame_is_cumulative(self):
+        reg, em = _mk_emitter()
+        reg.counter_add("api_requests_total", 7)
+        reg.observe("api_request_latency_us", 1000)
+        fr = em.frame(tick=25)
+        assert fr["widx"] == 2 and fr["span_ticks"] == 10
+        assert fr["counters"]["api_requests_total"] == 7
+        assert fr["hists"]["api_request_latency_us"]["count"] == 1
+
+    def test_frames_are_deltas_with_zeros_elided(self):
+        reg, em = _mk_emitter()
+        reg.counter_add("api_requests_total", 5)
+        reg.counter_add("api_shed", 2)
+        em.frame(tick=10)
+        reg.counter_add("api_requests_total", 3)  # api_shed unchanged
+        fr = em.frame(tick=20)
+        assert fr["counters"] == {"api_requests_total": 3}
+        assert fr["hists"] == {}  # no new samples -> no window entry
+
+    def test_stream_is_lossless(self):
+        """Merging every frame of a series reproduces the cumulative
+        registry — counters by summing deltas, histograms by merging
+        the window snapshots.  This is the invariant that lets the
+        committed SLO.json re-derive totals from the frames alone."""
+        reg, em = _mk_emitter()
+        frames = []
+        for t in range(1, 6):
+            reg.counter_add("commits_applied_total", t)
+            for v in (t * 10, t * 1000):
+                reg.observe("api_request_latency_us", v)
+            frames.append(em.frame(tick=t * 10))
+        total = sum(
+            fr["counters"].get("commits_applied_total", 0)
+            for fr in frames
+        )
+        assert total == reg.counter_value("commits_applied_total")
+        rebuilt = Histogram()
+        for fr in frames:
+            snap = fr["hists"].get("api_request_latency_us")
+            if snap:
+                rebuilt.merge(Histogram.from_snapshot(snap))
+        cum = reg.hist("api_request_latency_us")
+        assert rebuilt.count == cum.count
+        assert rebuilt.total == cum.total
+        assert rebuilt.buckets == cum.buckets
+
+    def test_widx_is_tick_derived_not_wallclock(self):
+        _, em = _mk_emitter(span=40)
+        assert em.frame(tick=0)["widx"] == 0
+        assert em.frame(tick=39)["widx"] == 0
+        assert em.frame(tick=40)["widx"] == 1
+        assert em.frame(tick=805)["widx"] == 20
+
+
+# -------------------------------------------------------- fleet series ----
+def _frame(sid, widx, counters=None, hists=None, tier="shard",
+           group=0, gauges=None, span=10):
+    return {
+        "v": 1, "sid": sid, "tier": tier, "group": group,
+        "widx": widx, "tick": widx * span, "span_ticks": span,
+        "counters": counters or {}, "gauges": gauges or {},
+        "hists": hists or {},
+    }
+
+
+def _lat_snap(values):
+    h = Histogram()
+    for v in values:
+        h.observe(v)
+    return h.snapshot()
+
+
+class TestFleetSeries:
+    def test_ingest_retention_and_export_determinism(self):
+        fs = FleetSeries(retain=8)
+        for w in range(20):
+            fs.ingest(1, _frame(1, w))
+        fs.ingest(0, _frame(0, 19))
+        ex = fs.export()
+        assert ex["frames_ingested"] == 21
+        assert fs.sids() == [0, 1]
+        by_sid = {s["sid"]: s for s in ex["series"]}
+        # bounded: only the newest `retain` frames survive per key
+        assert [f["widx"] for f in by_sid[1]["frames"]] == list(
+            range(12, 20))
+        # deterministic: series sorted by key, export JSON-able
+        assert [s["sid"] for s in ex["series"]] == [0, 1]
+        json.dumps(ex)
+
+    def test_windows_merge_counters_and_hists(self):
+        fs = FleetSeries()
+        fs.ingest(0, _frame(0, 5, counters={"api_requests_total": 10},
+                            hists={"api_request_latency_us":
+                                   _lat_snap([100, 200])}))
+        fs.ingest(1, _frame(1, 5, counters={"api_requests_total": 4},
+                            hists={"api_request_latency_us":
+                                   _lat_snap([300_000])}))
+        rows = windows(fs.export())
+        assert len(rows) == 1
+        w = rows[0]
+        assert w["widx"] == 5 and w["sids"] == [0, 1]
+        assert w["counters"]["api_requests_total"] == 14
+        h = w["hists"]["api_request_latency_us"]
+        assert h.count == 3  # fleet-merged window histogram
+        assert h.quantile(1.0) >= 200_000
+
+    def test_partial_windows_expose_missing_sids(self):
+        fs = FleetSeries()
+        fs.ingest(0, _frame(0, 1))
+        fs.ingest(1, _frame(1, 1))
+        fs.ingest(0, _frame(0, 2))  # sid 1 crashed: no frame for widx 2
+        rows = windows(fs.export())
+        assert [w["widx"] for w in rows] == [1, 2]
+        assert rows[0]["sids"] == [0, 1]
+        assert rows[1]["sids"] == [0]
+
+    def test_tier_filter_and_label_folding(self):
+        fs = FleetSeries()
+        fs.ingest(0, _frame(0, 3, counters={
+            "api_requests_total{g=0}": 2,
+            "api_requests_total{g=1}": 3,
+        }))
+        fs.ingest(9, _frame(9, 3, tier="proxy",
+                            counters={"proxy_routed": 8}))
+        assert base_name("api_requests_total{g=0}") == \
+            "api_requests_total"
+        all_rows = windows(fs.export())
+        # labeled counters fold into their base name fleet-wide
+        assert all_rows[0]["counters"]["api_requests_total"] == 5
+        assert all_rows[0]["counters"]["proxy_routed"] == 8
+        shard_only = windows(fs.export(), tier="shard")
+        assert "proxy_routed" not in shard_only[0]["counters"]
+        assert shard_only[0]["sids"] == [0]
+
+
+# ---------------------------------------------------------- SLO policy ----
+def _win(widx, lat=None, counters=None):
+    hists = {}
+    if lat:
+        h = Histogram()
+        for v in lat:
+            h.observe(v)
+        hists["api_request_latency_us"] = h
+    return {"widx": widx, "span_ticks": 10, "sids": [0],
+            "counters": counters or {}, "gauges": {}, "hists": hists}
+
+
+class TestSloPolicy:
+    def test_quantile_burn_zero_when_healthy_or_idle(self):
+        pol = SloPolicy(DEFAULT_OBJECTIVES)
+        row = pol.observe_window(_win(0, lat=[1000] * 100))
+        assert row["reply_p99"]["burn"] == 0.0
+        row = pol.observe_window(_win(1))  # idle window: no samples
+        assert row["reply_p99"]["burn"] == 0.0
+        assert not pol.status()["reply_p99"]["alerting"]
+
+    def test_alert_latches_on_sustained_burn_and_clears(self):
+        pol = SloPolicy(DEFAULT_OBJECTIVES, fast_windows=2,
+                        slow_windows=4, burn_hi=2.0, burn_clear=1.0)
+        good = [1000] * 100
+        # 3% of samples over the 250ms threshold: burn = .03/.01 = 3
+        bad = [400_000] * 3 + [1000] * 97
+        # steady-state first so the slow deque is full of zeros
+        for w in range(4):
+            pol.observe_window(_win(w, lat=good))
+        # one bad window must NOT latch: fast = (0+3)/2 < burn_hi
+        pol.observe_window(_win(4, lat=bad))
+        assert not pol.status()["reply_p99"]["alerting"]
+        # sustained burn: fast AND slow both cross burn_hi -> latch
+        pol.observe_window(_win(5, lat=bad))
+        assert not pol.status()["reply_p99"]["alerting"]  # slow 1.5
+        pol.observe_window(_win(6, lat=bad))
+        assert pol.status()["reply_p99"]["alerting"]
+        # stays latched while fast is between clear and hi thresholds…
+        pol.observe_window(_win(7, lat=good))
+        assert pol.status()["reply_p99"]["alerting"]  # fast 1.5
+        # …and clears once the fast mean drops below burn_clear
+        pol.observe_window(_win(8, lat=good))
+        assert not pol.status()["reply_p99"]["alerting"]
+
+    def test_ratio_objective_with_den_excludes_num(self):
+        pol = SloPolicy(DEFAULT_OBJECTIVES)
+        # 10 shed / (90 served + 10 shed) = 10% vs 5% budget -> burn 2
+        row = pol.observe_window(_win(0, counters={
+            "scan_shed": 10, "scan_served": 90,
+        }))
+        assert abs(row["scan_starvation"]["burn"] - 2.0) < 1e-6
+        # shed_rate's den already includes the num (requests_total)
+        row = pol.observe_window(_win(1, counters={
+            "api_shed": 5, "api_requests_total": 100,
+        }))
+        assert abs(row["shed_rate"]["burn"] - 1.0) < 1e-6
+
+    def test_ratio_zero_denominator_burns_zero(self):
+        pol = SloPolicy(DEFAULT_OBJECTIVES)
+        row = pol.observe_window(_win(0, counters={"scan_shed": 0}))
+        assert row["scan_starvation"]["burn"] == 0.0
+
+    def test_evaluate_series_is_deterministic_pure_fold(self):
+        fs = FleetSeries()
+        for w in range(6):
+            lat = [900_000] * 50 if 2 <= w <= 4 else [1000] * 50
+            fs.ingest(0, _frame(0, w, hists={
+                "api_request_latency_us": _lat_snap(lat)}))
+        ex = fs.export()
+        a = evaluate_series(ex, DEFAULT_OBJECTIVES)
+        b = evaluate_series(ex, DEFAULT_OBJECTIVES)
+        assert a == b  # same frames in => same verdicts out
+        assert a["n_windows"] == 6
+        burns = [r["reply_p99"]["burn"] for r in a["history"]]
+        assert burns[2] > 1.0 and burns[0] == 0.0

@@ -80,6 +80,93 @@ class TestHistogram:
         assert snap["count"] == 1 and snap["sum"] == 1 << 20
 
 
+class TestHistogramWindowEdges:
+    """Edge cases of the windowed (since/merge/snapshot) views — the
+    delta frames graftwatch streams are exactly these objects, so the
+    inverses must hold at the boundaries, not just mid-distribution."""
+
+    def test_empty_window_after_no_new_samples(self):
+        # a tick with no traffic produces an all-zero window; quantile
+        # and frac_over must read as "nothing", not divide by zero
+        h = Histogram()
+        for v in (5, 9, 14):
+            h.observe(v)
+        win = h.since(h.copy())
+        assert win.count == 0 and win.total == 0
+        assert not any(win.buckets)
+        assert win.quantile(0.99) == 0.0
+        assert win.frac_over(0) == 0.0
+
+    def test_single_sample_window(self):
+        h = Histogram()
+        for _ in range(100):
+            h.observe(8)
+        prev = h.copy()
+        h.observe(5000)
+        win = h.since(prev)
+        assert win.count == 1
+        # every quantile of a one-sample window is that sample's
+        # bucket, clamped into the inherited [vmin, vmax]
+        assert win.quantile(0.0) == win.quantile(1.0)
+        assert 2048 <= win.quantile(0.5) <= 8191
+
+    def test_quantile_clamps_at_bucket_extremes(self):
+        h = Histogram()
+        h.observe(1000)     # bucket 10 spans 512..1023
+        h.observe(1000)
+        # interpolation inside the bucket would sweep 512..1023, but
+        # the observed range is exactly [1000, 1000]
+        assert h.quantile(0.0) == 1000.0
+        assert h.quantile(1.0) == 1000.0
+        lo = Histogram()
+        lo.observe(0)
+        assert lo.quantile(0.5) == 0.0
+
+    def test_delta_snapshot_round_trip(self):
+        # prev.copy().merge(cur.since(prev)) == cur for count/sum/
+        # buckets — the graftwatch stream invariant: merging every
+        # delta frame of a series reproduces the cumulative registry
+        cur = Histogram()
+        for v in (3, 17, 900, 70000):
+            cur.observe(v)
+        prev = cur.copy()
+        for v in (1, 2, 1 << 22):
+            cur.observe(v)
+        rebuilt = prev.copy().merge(cur.since(prev))
+        assert rebuilt.count == cur.count
+        assert rebuilt.total == cur.total
+        assert rebuilt.buckets == cur.buckets
+
+    def test_snapshot_round_trip_through_json_keys(self):
+        h = Histogram()
+        for v in (6, 6, 300):
+            h.observe(v)
+        snap = json.loads(json.dumps(h.snapshot()))  # str bucket keys
+        back = Histogram.from_snapshot(snap)
+        assert back.count == h.count and back.total == h.total
+        assert back.buckets == h.buckets
+        assert back.vmin == h.vmin and back.vmax == h.vmax
+        empty = Histogram.from_snapshot({"count": 0, "sum": 0})
+        assert empty.vmin is None and empty.quantile(0.5) == 0.0
+
+    def test_merge_empty_window_is_noop(self):
+        h = Histogram()
+        h.observe(42)
+        before = h.snapshot()
+        h.merge(Histogram())
+        h.merge(None)
+        assert h.snapshot() == before
+
+    def test_frac_over_interpolates_and_saturates(self):
+        h = Histogram()
+        for _ in range(10):
+            h.observe(1000)  # bucket 512..1023
+        assert h.frac_over(1 << 20) == 0.0     # far above: none
+        assert h.frac_over(0) == 1.0           # below everything: all
+        mid = h.frac_over(512)                 # bucket lower bound
+        assert 0.0 < mid <= 1.0
+
+
 class TestRegistry:
     def _fill(self, reg):
         reg.counter_add("reqs")

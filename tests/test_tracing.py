@@ -107,6 +107,99 @@ class TestFlightRecorder:
         assert ts_ == sorted(ts_)
 
 
+# --------------------------------------- per-type reserves + drop ledger ----
+class TestDropAccounting:
+    """v2 recorder: rare types survive floods via per-type reserve
+    rings, and the per-type drop ledger always reconciles against the
+    scalar drop count (the trace_export accounting gate)."""
+
+    def test_reserve_keeps_rare_type_through_flood(self):
+        fr = FlightRecorder(capacity=16, reserve_per_type=4)
+        for _ in range(3):
+            fr.record("crash", reason="nemesis")
+        for i in range(500):
+            fr.record("tick", tick=i)
+        d = fr.dump()
+        kinds = [ev["type"] for ev in d["events"]]
+        # all 3 crash events washed out of the main ring long ago, yet
+        # the dump still carries them (reserve union), oldest-first
+        assert kinds.count("crash") == 3
+        assert "crash" not in d["dropped_by_type"]
+        ns = [ev["n"] for ev in d["events"]]
+        assert ns == sorted(ns)
+
+    def test_reserve_itself_overflows_honestly(self):
+        fr = FlightRecorder(capacity=8, reserve_per_type=2)
+        for i in range(10):
+            fr.record("crash", reason=str(i))
+        for i in range(100):
+            fr.record("tick", tick=i)
+        d = fr.dump()
+        kinds = [ev["type"] for ev in d["events"]]
+        assert kinds.count("crash") == 2  # reserve maxlen, not all 10
+        assert d["dropped_by_type"]["crash"] == 8
+
+    def test_ledger_reconciles_with_and_without_trim(self):
+        fr = FlightRecorder(capacity=16, reserve_per_type=2)
+        for i in range(40):
+            fr.record("tick", tick=i)
+        for i in range(40):
+            fr.record("frame_tx", peer=0, seq=i, nbytes=1)
+        for d in (fr.dump(), fr.dump(last_n=5), fr.dump(last_n=0)):
+            assert sum(d["recorded_by_type"].values()) == d["count"]
+            assert sum(d["dropped_by_type"].values()) == d["dropped"]
+            retained = {}
+            for ev in d["events"]:
+                retained[ev["type"]] = retained.get(ev["type"], 0) + 1
+            for t, rec in d["recorded_by_type"].items():
+                assert rec - retained.get(t, 0) == \
+                    d["dropped_by_type"].get(t, 0)
+
+    def test_validate_dumps_passes_clean_and_catches_tamper(self):
+        fr = FlightRecorder(capacity=16)
+        for i in range(100):
+            fr.record("tick", tick=i)
+        d = fr.dump()
+        assert trace_export.validate_dumps({0: d}) == []
+        bad = dict(d)
+        bad["dropped_by_type"] = {"tick": d["dropped"] - 1}
+        errs = trace_export.validate_dumps({0: bad})
+        assert errs and any("tick" in e for e in errs)
+
+    def test_publish_drops_is_delta_cursored(self):
+        fr = FlightRecorder(capacity=8, reserve_per_type=1, me=0)
+        reg = MetricsRegistry()
+        for i in range(20):
+            fr.record("tick", tick=i)
+        fr.publish_drops(reg)
+        first = reg.counter_value("trace_dropped_total", type="tick")
+        assert first > 0
+        # no new drops -> repeated scrapes add nothing
+        fr.publish_drops(reg)
+        assert reg.counter_value(
+            "trace_dropped_total", type="tick") == first
+        for i in range(10):
+            fr.record("tick", tick=i)
+        fr.publish_drops(reg)
+        assert reg.counter_value(
+            "trace_dropped_total", type="tick") == first + 10
+
+    def test_publish_drops_counts_reserve_survivors_as_retained(self):
+        fr = FlightRecorder(capacity=8, reserve_per_type=4)
+        for _ in range(4):
+            fr.record("crash", reason="x")
+        for i in range(50):
+            fr.record("tick", tick=i)
+        reg = MetricsRegistry()
+        fr.publish_drops(reg)
+        # every crash event still rides dumps via its reserve -> no
+        # crash drops published, only the tick evictions
+        assert reg.counter_value(
+            "trace_dropped_total", type="crash") == 0
+        assert reg.counter_value(
+            "trace_dropped_total", type="tick") > 0
+
+
 # ----------------------------------------------- SlotTraces lock regression
 class TestSlotTracesLocking:
     def test_concurrent_marks_never_double_observe(self):
